@@ -1,0 +1,25 @@
+"""TPU-native distributed data-parallel training framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of the reference
+parameter-server system (Jjjing2023/Distributed-Parameter-Server-for-ML-Training):
+
+Design mapping (see README.md for implementation status per subsystem):
+
+- sync data-parallel SGD      -> SPMD `shard_map` + `lax.pmean` over a named
+                                 ``data`` mesh axis (ref: src/parameter_server/
+                                 server.py:145-169 collapses into a compiled
+                                 all-reduce; no server process exists)
+- async bounded-staleness SGD -> host-CPU parameter store with per-worker device
+                                 steps (ref: server.py:171-186, 290-304)
+- gradient compression        -> reduced-precision all-reduce + quantization ops
+                                 (ref: worker.py:264-268 fp16 cast)
+- worker lifecycle            -> register/fetch/push/finished in-process API and
+                                 gRPC service for multi-host (ref:
+                                 src/communication/ps.proto:4-19)
+
+Import as::
+
+    import distributed_parameter_server_for_ml_training_tpu as dps
+"""
+
+__version__ = "0.1.0"

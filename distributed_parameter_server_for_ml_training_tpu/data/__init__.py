@@ -1,0 +1,29 @@
+"""Input pipelines: CIFAR-100 (disk or synthetic), sharding, augmentation."""
+
+from .cifar import (
+    CIFAR100_MEAN,
+    CIFAR100_STD,
+    Dataset,
+    augment_batch,
+    load_cifar100,
+    make_batches,
+    normalize,
+    shard_range,
+    standardize,
+    synthetic_cifar100,
+    to_float,
+)
+
+__all__ = [
+    "CIFAR100_MEAN",
+    "CIFAR100_STD",
+    "Dataset",
+    "augment_batch",
+    "load_cifar100",
+    "make_batches",
+    "normalize",
+    "shard_range",
+    "standardize",
+    "synthetic_cifar100",
+    "to_float",
+]

@@ -1,0 +1,5 @@
+"""Model zoo: CIFAR-style ResNets (flax.linen)."""
+
+from .resnet import ResNet, ResNet18, ResNet50, count_params
+
+__all__ = ["ResNet", "ResNet18", "ResNet50", "count_params"]

@@ -1,0 +1,164 @@
+"""CIFAR-style ResNet family in flax.linen.
+
+Architecture parity target (reference: src/parameter_server/server.py:21-76,
+src/workers/worker.py:21-76, baseline/baseline_training.py:37-95 — the same
+classes copy-pasted three times): 3x3 stem, stride 1, NO maxpool, four stages
+of BasicBlocks [2,2,2,2], BatchNorm everywhere, global average pool, Linear
+head. At ``num_classes=100`` the parameter count must be exactly 11,220,132
+(reference: baseline/results/baseline_summary.json ``model_specs.parameters``).
+
+TPU-first notes:
+- compute dtype is configurable (``dtype=jnp.bfloat16`` keeps the MXU fed;
+  parameters and BN statistics stay float32 via ``param_dtype``),
+- ``axis_name`` enables cross-replica BatchNorm statistics under ``shard_map``
+  — the reference accidentally froze BN running stats in distributed mode
+  (SURVEY.md §7 hard part (b)); here syncing them is the default sane choice
+  and freezing is reproducible by simply not passing the axis name.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Dtype = Any
+
+
+class BasicBlock(nn.Module):
+    """Two 3x3 convs + identity shortcut (1x1 conv when shape changes)."""
+
+    features: int
+    strides: int = 1
+    dtype: Dtype = jnp.float32
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            axis_name=self.axis_name,
+        )
+        conv = partial(
+            nn.Conv, use_bias=False, dtype=self.dtype, param_dtype=jnp.float32
+        )
+
+        residual = x
+        y = conv(self.features, (3, 3), strides=(self.strides, self.strides),
+                 padding=((1, 1), (1, 1)))(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.features, (3, 3), padding=((1, 1), (1, 1)))(y)
+        y = norm()(y)
+
+        if residual.shape[-1] != self.features or self.strides != 1:
+            residual = conv(self.features, (1, 1),
+                            strides=(self.strides, self.strides))(residual)
+            residual = norm()(residual)
+
+        return nn.relu(y + residual)
+
+
+class Bottleneck(nn.Module):
+    """1x1 -> 3x3 -> 1x1 bottleneck block (for ResNet-50)."""
+
+    features: int  # bottleneck width; output is 4x this
+    strides: int = 1
+    dtype: Dtype = jnp.float32
+    axis_name: str | None = None
+
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool) -> jax.Array:
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+            param_dtype=jnp.float32,
+            axis_name=self.axis_name,
+        )
+        conv = partial(
+            nn.Conv, use_bias=False, dtype=self.dtype, param_dtype=jnp.float32
+        )
+        out_features = self.features * self.expansion
+
+        residual = x
+        y = conv(self.features, (1, 1))(x)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(self.features, (3, 3), strides=(self.strides, self.strides),
+                 padding=((1, 1), (1, 1)))(y)
+        y = norm()(y)
+        y = nn.relu(y)
+        y = conv(out_features, (1, 1))(y)
+        y = norm()(y)
+
+        if residual.shape[-1] != out_features or self.strides != 1:
+            residual = conv(out_features, (1, 1),
+                            strides=(self.strides, self.strides))(residual)
+            residual = norm()(residual)
+
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    """CIFAR-style ResNet: 3x3 stem (no maxpool), stages doubling width."""
+
+    stage_sizes: Sequence[int]
+    block_cls: type = BasicBlock
+    num_classes: int = 100
+    num_filters: int = 64
+    dtype: Dtype = jnp.float32
+    axis_name: str | None = None
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.num_filters, (3, 3), padding=((1, 1), (1, 1)),
+                    use_bias=False, dtype=self.dtype, param_dtype=jnp.float32,
+                    name="stem_conv")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=self.dtype,
+                         param_dtype=jnp.float32, axis_name=self.axis_name,
+                         name="stem_bn")(x)
+        x = nn.relu(x)
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            for block in range(n_blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = self.block_cls(
+                    self.num_filters * 2**stage,
+                    strides=strides,
+                    dtype=self.dtype,
+                    axis_name=self.axis_name,
+                )(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def ResNet18(num_classes: int = 100, dtype: Dtype = jnp.float32,
+             axis_name: str | None = None) -> ResNet:
+    return ResNet(stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock,
+                  num_classes=num_classes, dtype=dtype, axis_name=axis_name)
+
+
+def ResNet50(num_classes: int = 1000, dtype: Dtype = jnp.float32,
+             axis_name: str | None = None) -> ResNet:
+    return ResNet(stage_sizes=(3, 4, 6, 3), block_cls=Bottleneck,
+                  num_classes=num_classes, dtype=dtype, axis_name=axis_name)
+
+
+def count_params(params) -> int:
+    return sum(p.size for p in jax.tree_util.tree_leaves(params))

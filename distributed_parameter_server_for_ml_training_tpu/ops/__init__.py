@@ -1,0 +1,19 @@
+"""Numerics ops: gradient compression and quantization."""
+
+from .compression import (
+    compress_for_allreduce,
+    decompress_from_allreduce,
+    fp16_compress,
+    fp16_decompress,
+    int8_quantize,
+    int8_dequantize,
+)
+
+__all__ = [
+    "compress_for_allreduce",
+    "decompress_from_allreduce",
+    "fp16_compress",
+    "fp16_decompress",
+    "int8_quantize",
+    "int8_dequantize",
+]

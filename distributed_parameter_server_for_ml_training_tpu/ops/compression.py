@@ -1,0 +1,82 @@
+"""Gradient compression.
+
+The reference's "compression" is an fp32->fp16 cast before pickling
+(src/workers/worker.py:264-268) and a cast back on the server
+(src/parameter_server/server.py:232-237) — ~50% wire bytes, logged at
+worker.py:292.
+
+Two TPU-native forms of the same capability:
+
+1. **Reduced-precision all-reduce** (sync path): cast gradients to
+   bfloat16/float16 before ``lax.pmean`` so the ICI collective moves half the
+   bytes, then restore fp32 for the optimizer. bfloat16 keeps fp32's exponent
+   range, so — unlike the reference's fp16 cast — it cannot overflow large
+   gradients. (Prior art for in-collective quantization: EQuARX; PAPERS.md.)
+
+2. **Wire codecs** (async PS path): fp16 cast (bit-for-bit the reference
+   semantics) and int8 per-tensor affine quantization (~75% bytes) for
+   host<->store transfers. These operate on numpy arrays because the async
+   store lives on the host CPU.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PyTree = Any
+
+_ALLREDUCE_DTYPES = {
+    "none": None,
+    "fp32": None,
+    "bf16": jnp.bfloat16,
+    "fp16": jnp.float16,
+}
+
+
+def compress_for_allreduce(grads: PyTree, mode: str = "bf16") -> PyTree:
+    """Cast gradients for the wire (the collective). No-op for 'none'."""
+    dtype = _ALLREDUCE_DTYPES[mode]
+    if dtype is None:
+        return grads
+    return jax.tree_util.tree_map(lambda g: g.astype(dtype), grads)
+
+
+def decompress_from_allreduce(grads: PyTree, mode: str = "bf16") -> PyTree:
+    """Restore fp32 after the collective (server.py:232-237 analogue)."""
+    if _ALLREDUCE_DTYPES[mode] is None:
+        return grads
+    return jax.tree_util.tree_map(lambda g: g.astype(jnp.float32), grads)
+
+
+# ---------------------------------------------------------------------------
+# Host-side wire codecs for the async parameter store.
+# ---------------------------------------------------------------------------
+
+def fp16_compress(tree: PyTree) -> PyTree:
+    """fp32 -> fp16 cast, exactly the reference's compress_gradients
+    (worker.py:264-268)."""
+    return jax.tree_util.tree_map(
+        lambda a: np.asarray(a, np.float32).astype(np.float16), tree)
+
+
+def fp16_decompress(tree: PyTree) -> PyTree:
+    """fp16 -> fp32, exactly decompress_gradients (server.py:232-237)."""
+    return jax.tree_util.tree_map(
+        lambda a: np.asarray(a).astype(np.float32), tree)
+
+
+def int8_quantize(a: np.ndarray) -> tuple[np.ndarray, np.float32]:
+    """Per-tensor symmetric int8 quantization: returns (q, scale)."""
+    a = np.asarray(a, np.float32)
+    amax = float(np.max(np.abs(a))) if a.size else 0.0
+    scale = np.float32(amax / 127.0) if amax > 0 else np.float32(1.0)
+    q = np.clip(np.rint(a / scale), -127, 127).astype(np.int8)
+    return q, scale
+
+
+def int8_dequantize(q: np.ndarray, scale: np.float32) -> np.ndarray:
+    return q.astype(np.float32) * np.float32(scale)

@@ -1,0 +1,6 @@
+"""Parallelism: device meshes and sync SPMD data parallelism."""
+
+from .mesh import make_mesh, worker_axis_size
+from .sync_dp import make_sync_dp_step, shard_batch
+
+__all__ = ["make_mesh", "worker_axis_size", "make_sync_dp_step", "shard_batch"]

@@ -1,0 +1,15 @@
+"""Training runtimes: single-chip baseline, sync SPMD, async PS workers."""
+
+from .train_state import TrainState, create_train_state
+from .optimizers import server_sgd, baseline_optimizer
+from .steps import make_train_step, make_eval_step, cross_entropy_loss
+
+__all__ = [
+    "TrainState",
+    "create_train_state",
+    "server_sgd",
+    "baseline_optimizer",
+    "make_train_step",
+    "make_eval_step",
+    "cross_entropy_loss",
+]

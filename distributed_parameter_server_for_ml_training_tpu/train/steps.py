@@ -1,0 +1,82 @@
+"""Jitted train/eval step factories (single-chip; the SPMD and async paths
+build on these).
+
+Reference parity: the worker hot loop zero_grad -> forward -> CE loss ->
+backward (src/workers/worker.py:333-348) plus the server apply
+(server.py:126-143) become ONE compiled XLA program: normalize + augment +
+fwd + bwd + update, fused by XLA, bfloat16 on the MXU when the model is so
+configured.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ..data.cifar import augment_batch, normalize, standardize, to_float
+from .train_state import TrainState
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean softmax cross-entropy with integer labels (worker.py:131 used
+    nn.CrossEntropyLoss)."""
+    return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
+
+
+def _forward_loss(state: TrainState, params, images, labels):
+    outputs, mutated = state.apply_fn(
+        {"params": params, "batch_stats": state.batch_stats},
+        images, train=True, mutable=["batch_stats"],
+    )
+    loss = cross_entropy_loss(outputs, labels)
+    return loss, (outputs, mutated["batch_stats"])
+
+
+def make_train_step(augment: bool = True) -> Callable:
+    """Build ``train_step(state, images_u8, labels, rng) -> (state, metrics)``.
+
+    ``images_u8`` is the raw uint8 batch; normalization and augmentation
+    happen on device inside the compiled program.
+    """
+
+    def train_step(state: TrainState, images_u8: jax.Array,
+                   labels: jax.Array, rng: jax.Array):
+        rng = jax.random.fold_in(rng, state.step)
+        # torchvision order (worker.py:145-154): ToTensor -> RandomCrop/Flip
+        # on raw pixels (zero pad = black) -> Normalize.
+        images = to_float(images_u8)
+        if augment:
+            images = augment_batch(rng, images)
+        images = standardize(images)
+
+        grad_fn = jax.value_and_grad(
+            lambda p: _forward_loss(state, p, images, labels), has_aux=True)
+        (loss, (logits, new_stats)), grads = grad_fn(state.params)
+
+        state = state.apply_gradients(grads=grads)
+        state = state.replace(batch_stats=new_stats)
+        accuracy = jnp.mean(jnp.argmax(logits, -1) == labels)
+        return state, {"loss": loss, "accuracy": accuracy}
+
+    return train_step
+
+
+def make_eval_step() -> Callable:
+    """Build ``eval_step(state, images_u8, labels) -> (correct, total)``.
+
+    Top-1 over the full test set, matching worker.py:313-331 /
+    baseline_training.py:181-199.
+    """
+
+    def eval_step(state: TrainState, images_u8: jax.Array, labels: jax.Array):
+        images = normalize(images_u8)
+        logits = state.apply_fn(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            images, train=False)
+        correct = jnp.sum(jnp.argmax(logits, -1) == labels)
+        return correct, labels.shape[0]
+
+    return eval_step

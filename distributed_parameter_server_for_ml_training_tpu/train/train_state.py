@@ -1,0 +1,35 @@
+"""Train state: params + optimizer state + BatchNorm statistics.
+
+The reference keeps canonical weights as a ``{name: np.ndarray}`` dict on the
+server (src/parameter_server/server.py:96) and reloads them into a torch
+module on every fetch (src/workers/worker.py:241-252). Here the canonical
+state is a single pytree, resident on device, threaded functionally through
+the compiled step.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import optax
+from flax import struct
+from flax.training import train_state
+
+
+class TrainState(train_state.TrainState):
+    batch_stats: Any = struct.field(default=None)
+
+
+def create_train_state(model: nn.Module, rng: jax.Array,
+                       tx: optax.GradientTransformation,
+                       input_shape=(1, 32, 32, 3)) -> TrainState:
+    variables = model.init(rng, jnp.ones(input_shape, jnp.float32), train=False)
+    return TrainState.create(
+        apply_fn=model.apply,
+        params=variables["params"],
+        batch_stats=variables.get("batch_stats", {}),
+        tx=tx,
+    )

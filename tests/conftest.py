@@ -1,0 +1,54 @@
+"""Test harness: 8 virtual CPU devices for multi-chip semantics tests.
+
+The reference could only test multi-node behavior by deploying to AWS
+(SURVEY.md §4); here a single process gets an 8-device CPU mesh. NOTE the
+axon site hook pins JAX_PLATFORMS=axon, so we must both set XLA_FLAGS before
+the first backend initialization and force the platform via jax.config.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def tiny_model():
+    """A small ResNet-ish model for fast tests (full ResNet-18 is slow on CPU)."""
+    from distributed_parameter_server_for_ml_training_tpu.models import ResNet
+
+    def make(axis_name=None):
+        return ResNet(stage_sizes=(1, 1), num_filters=8, num_classes=10,
+                      axis_name=axis_name)
+
+    return make
+
+
+@pytest.fixture()
+def rng():
+    return jax.random.PRNGKey(0)
+
+
+@pytest.fixture()
+def small_batch():
+    r = np.random.default_rng(0)
+    images = r.integers(0, 255, (16, 32, 32, 3), dtype=np.uint8)
+    labels = (np.arange(16) % 10).astype(np.int32)
+    return images, labels

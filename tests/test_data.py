@@ -1,0 +1,94 @@
+"""Data pipeline tests (reference: worker.py:140-197)."""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_parameter_server_for_ml_training_tpu.data import (
+    augment_batch, make_batches, normalize, shard_range, synthetic_cifar100)
+
+
+class TestShardRange:
+    def test_equal_split(self):
+        # 50000 over 4 workers: 12500 each (worker.py:166-179)
+        assert shard_range(50_000, 0, 4) == (0, 12_500)
+        assert shard_range(50_000, 3, 4) == (37_500, 50_000)
+
+    def test_last_worker_takes_remainder(self):
+        # 10 over 3: [0,3) [3,6) [6,10) — last worker gets the remainder
+        assert shard_range(10, 0, 3) == (0, 3)
+        assert shard_range(10, 1, 3) == (3, 6)
+        assert shard_range(10, 2, 3) == (6, 10)
+
+    def test_full_coverage_no_overlap(self):
+        for n, w in [(50_000, 4), (50_000, 7), (101, 8), (32, 32)]:
+            spans = [shard_range(n, i, w) for i in range(w)]
+            assert spans[0][0] == 0 and spans[-1][1] == n
+            for (a, b), (c, d) in zip(spans, spans[1:]):
+                assert b == c
+
+    def test_bad_worker_id(self):
+        with pytest.raises(ValueError):
+            shard_range(100, 4, 4)
+
+
+class TestSyntheticData:
+    def test_deterministic(self):
+        a = synthetic_cifar100(n_train=256, n_test=64)
+        b = synthetic_cifar100(n_train=256, n_test=64)
+        np.testing.assert_array_equal(a.x_train, b.x_train)
+        np.testing.assert_array_equal(a.y_train, b.y_train)
+
+    def test_shapes_and_classes(self):
+        d = synthetic_cifar100(n_train=500, n_test=100)
+        assert d.x_train.shape == (500, 32, 32, 3)
+        assert d.x_train.dtype == np.uint8
+        assert d.y_train.min() >= 0 and d.y_train.max() < 100
+        assert d.synthetic
+
+    def test_learnable_signal(self):
+        """Class templates must be separable — nearest-template classification
+        on raw pixels should beat chance by a wide margin."""
+        d = synthetic_cifar100(n_train=2000, n_test=200, num_classes=10)
+        x = d.x_train.reshape(len(d.x_train), -1).astype(np.float32)
+        centroids = np.stack([x[d.y_train == c].mean(0) for c in range(10)])
+        xt = d.x_test.reshape(len(d.x_test), -1).astype(np.float32)
+        pred = np.argmin(
+            ((xt[:, None] - centroids[None]) ** 2).sum(-1), axis=1)
+        assert (pred == d.y_test).mean() > 0.5
+
+
+class TestAugmentation:
+    def test_shapes_preserved(self):
+        x = jax.numpy.ones((8, 32, 32, 3))
+        y = augment_batch(jax.random.PRNGKey(0), x)
+        assert y.shape == x.shape
+
+    def test_normalize_range(self):
+        x = np.full((2, 32, 32, 3), 128, np.uint8)
+        y = np.asarray(normalize(jax.numpy.asarray(x)))
+        assert np.all(np.abs(y) < 3.0)
+
+    def test_augment_is_random_but_seeded(self):
+        x = jax.random.uniform(jax.random.PRNGKey(5), (4, 32, 32, 3))
+        a = augment_batch(jax.random.PRNGKey(1), x)
+        b = augment_batch(jax.random.PRNGKey(1), x)
+        c = augment_batch(jax.random.PRNGKey(2), x)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+
+class TestBatching:
+    def test_epoch_covers_shard(self):
+        x = np.arange(100)[:, None]
+        y = np.arange(100)
+        seen = []
+        for xb, yb in make_batches(x, y, 10, seed=0):
+            seen.extend(yb.tolist())
+        assert sorted(seen) == list(range(100))
+
+    def test_drop_remainder(self):
+        x = np.arange(25)[:, None]
+        y = np.arange(25)
+        batches = list(make_batches(x, y, 10))
+        assert len(batches) == 2
