@@ -1,0 +1,31 @@
+"""Parameter store: the async (bounded-staleness) half of the framework.
+
+SPMD cannot express per-worker asynchrony, so — per the north-star design
+(BASELINE.json) — async mode is hosted as a parameter store on the TPU host
+CPU. Worker threads drive jit-compiled local gradient steps on device and
+push/pull through the store's in-process API, which preserves the reference's
+4-RPC lifecycle (src/communication/ps.proto:4-19): register / fetch / push /
+finished. A gRPC service wraps the same store for multi-host deployments.
+"""
+
+from .semantics import (
+    staleness_weight,
+    mean_gradients,
+    sgd_apply,
+    DEFAULT_STALENESS_BOUND,
+)
+from .store import ParameterStore, StoreConfig
+from .worker import PSWorker, WorkerConfig, WorkerResult, run_workers
+
+__all__ = [
+    "ParameterStore",
+    "StoreConfig",
+    "PSWorker",
+    "WorkerConfig",
+    "WorkerResult",
+    "run_workers",
+    "staleness_weight",
+    "mean_gradients",
+    "sgd_apply",
+    "DEFAULT_STALENESS_BOUND",
+]

@@ -1,0 +1,66 @@
+"""The aggregation math, as pure unit-testable functions.
+
+Every function here reproduces a specific piece of the reference server's
+numerics bit-for-bit (SURVEY.md §4 names these the natural test seams):
+
+- :func:`staleness_weight`  == server.py:171-186 ``apply_gradients_async``
+- :func:`mean_gradients`    == server.py:145-169 ``aggregate_gradients_sync``
+- :func:`sgd_apply`         == server.py:126-143 ``apply_gradients``
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Mapping
+
+import numpy as np
+
+#: server.py:418 ``--staleness-bound`` default.
+DEFAULT_STALENESS_BOUND = 5
+
+#: server.py:178 decay constant and floor.
+STALENESS_DECAY = 0.1
+STALENESS_FLOOR = 0.1
+
+
+def staleness_weight(staleness: int, decay: float = STALENESS_DECAY,
+                     floor: float = STALENESS_FLOOR) -> float:
+    """Down-weighting for stale gradients: ``max(0.1, 1/(1+0.1*s))``
+    (server.py:178)."""
+    return max(floor, 1.0 / (1.0 + decay * float(staleness)))
+
+
+def mean_gradients(
+    grads_per_worker: Iterable[Mapping[str, np.ndarray]],
+) -> dict[str, np.ndarray]:
+    """Element-wise mean over workers, per parameter (server.py:145-169).
+
+    Every worker must supply the same parameter names; float32 accumulation.
+    """
+    grads_list = list(grads_per_worker)
+    if not grads_list:
+        raise ValueError("no gradients to aggregate")
+    names = set(grads_list[0])
+    for g in grads_list[1:]:
+        if set(g) != names:
+            raise ValueError("workers pushed mismatched parameter sets")
+    n = len(grads_list)
+    return {
+        k: np.sum([np.asarray(g[k], np.float32) for g in grads_list], axis=0)
+        / np.float32(n)
+        for k in grads_list[0]
+    }
+
+
+def sgd_apply(params: dict[str, np.ndarray],
+              grads: Mapping[str, np.ndarray],
+              lr: float, weight: float = 1.0) -> None:
+    """In-place plain SGD ``p -= lr * weight * g`` (server.py:133; the
+    async path additionally scales by the staleness weight, server.py:183).
+
+    Unknown gradient names are ignored, matching the reference's
+    ``if name in self.parameters`` guard (server.py:131).
+    """
+    scale = np.float32(lr * weight)
+    for name, g in grads.items():
+        if name in params:
+            params[name] -= scale * np.asarray(g, np.float32)

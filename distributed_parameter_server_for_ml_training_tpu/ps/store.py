@@ -1,0 +1,243 @@
+"""In-process parameter store with the reference server's exact semantics.
+
+This is the TPU re-hosting of ``src/parameter_server/server.py``: canonical
+parameters live on the host CPU as a flat ``{name: np.ndarray}`` dict
+(server.py:96), guarded by the same three-lock structure — ``param_lock``
+(apply + fetch-serialize, server.py:97), ``sync_lock`` (pending-gradient
+barrier, server.py:114), ``registration_lock`` (id assignment, server.py:103).
+
+Faithful behaviors reproduced deliberately (SURVEY.md appendix):
+
+- quirk 2: sync push returns immediately — no worker-side barrier; the round
+  completes whenever the count reaches ``total_workers`` (server.py:264-288),
+- quirk 3: a double push before the round completes OVERWRITES that worker's
+  pending entry while still incrementing ``gradients_received`` — a round can
+  complete with fewer than N distinct contributions (server.py:267-268).
+  ``strict_rounds=True`` opts into the corrected behavior (count distinct
+  workers instead),
+- quirk 4: ``fetched_step`` is the global step the worker last fetched, so
+  staleness = versions-behind (server.py:293-294, worker.py:299),
+- worker-count validation 1..32 (server.py:424-426),
+- ``last_seen`` tracked on fetch/push but never expired (server.py:219, 251),
+- final stats printed when the active-worker set empties (server.py:315-316).
+
+Wire codec: pushes are fp16-compressed by default — and fetches are NOT —
+matching the reference's asymmetry (push: worker.py:264-268 casts fp16;
+fetch: server.py:222 pickles fp32).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Mapping
+
+import numpy as np
+
+from ..ops.compression import fp16_compress, fp16_decompress
+from .semantics import (
+    DEFAULT_STALENESS_BOUND,
+    mean_gradients,
+    sgd_apply,
+    staleness_weight,
+)
+
+MAX_WORKERS = 32  # server.py:424-426
+
+
+@dataclass
+class StoreConfig:
+    mode: str = "sync"  # 'sync' | 'async' (server.py --mode)
+    total_workers: int = 4
+    learning_rate: float = 0.1  # server.py:84, 413
+    staleness_bound: int = DEFAULT_STALENESS_BOUND
+    push_codec: str = "fp16"  # 'none' | 'fp16' (reference pushes fp16)
+    fetch_codec: str = "none"  # reference fetches fp32 (server.py:222)
+    strict_rounds: bool = False  # True = corrected double-push semantics
+
+    def __post_init__(self):
+        if self.mode not in ("sync", "async"):
+            raise ValueError(f"mode must be sync|async, got {self.mode!r}")
+        if not 1 <= self.total_workers <= MAX_WORKERS:
+            raise ValueError(
+                f"total_workers must be 1..{MAX_WORKERS} (server.py:424-426),"
+                f" got {self.total_workers}")
+
+
+@dataclass
+class _Stats:
+    gradients_processed: int = 0
+    gradients_rejected: int = 0
+    total_parameter_updates: int = 0
+    staleness_values: list = field(default_factory=list)
+    update_times: deque = field(default_factory=lambda: deque(maxlen=100))
+    start_time: float = field(default_factory=time.time)
+
+
+class ParameterStore:
+    """Thread-safe canonical parameter holder + sync/async aggregator."""
+
+    def __init__(self, initial_params: Mapping[str, np.ndarray],
+                 config: StoreConfig | None = None):
+        self.config = config or StoreConfig()
+        self.parameters: dict[str, np.ndarray] = {
+            k: np.array(v, np.float32) for k, v in initial_params.items()
+        }
+        self.global_step = 0
+
+        self._param_lock = threading.Lock()
+        self._sync_lock = threading.Lock()
+        self._registration_lock = threading.Lock()
+
+        self._next_worker_id = 0
+        self.active_workers: set[int] = set()
+        self.last_seen: dict[int, float] = {}
+
+        self._pending: dict[int, dict[str, np.ndarray]] = {}
+        self._gradients_received = 0
+
+        self.stats = _Stats()
+        self._finished_event = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------- ps.proto:8
+
+    def register_worker(self, worker_name: str = "") -> tuple[int, int]:
+        """Sequential id assignment under the registration lock
+        (server.py:190-211). Returns (worker_id, total_workers)."""
+        with self._registration_lock:
+            worker_id = self._next_worker_id
+            self._next_worker_id += 1
+            self.active_workers.add(worker_id)
+            self.last_seen[worker_id] = time.time()
+        return worker_id, self.config.total_workers
+
+    def fetch(self, worker_id: int | None = None
+              ) -> tuple[dict[str, np.ndarray], int]:
+        """Copy of the canonical params + current global step
+        (server.py:213-237). Codec per config (reference: fp32, uncompressed).
+        """
+        with self._param_lock:
+            payload = {k: v.copy() for k, v in self.parameters.items()}
+            step = self.global_step
+        if worker_id is not None:
+            self.last_seen[worker_id] = time.time()
+        if self.config.fetch_codec == "fp16":
+            payload = fp16_compress(payload)
+        return payload, step
+
+    def push(self, worker_id: int, gradients: Mapping[str, np.ndarray],
+             fetched_step: int) -> bool:
+        """Push gradients (PushGradrients, ps.proto:12 — typo preserved in
+        the reference wire protocol; here the API is just named push).
+
+        ``fetched_step`` is the global step the worker last fetched — the
+        reference's ``local_step`` field actually carries this
+        (worker.py:299), making staleness = versions-behind.
+        Returns True iff the gradients were accepted (sync mode always
+        accepts, matching PushReply(received=True), server.py:286-288).
+        """
+        if self.config.push_codec == "fp16":
+            gradients = fp16_decompress(gradients)
+        else:
+            gradients = {k: np.asarray(v, np.float32)
+                         for k, v in gradients.items()}
+        self.last_seen[worker_id] = time.time()
+
+        if self.config.mode == "sync":
+            self._push_sync(worker_id, gradients)
+            return True
+        return self._push_async(worker_id, gradients, fetched_step)
+
+    def job_finished(self, worker_id: int) -> None:
+        """Remove from the active set; final stats fire when it empties
+        (server.py:306-318)."""
+        with self._registration_lock:
+            self.active_workers.discard(worker_id)
+            empty = not self.active_workers
+        if empty:
+            self._finished_event.set()
+
+    def wait_all_finished(self, timeout: float | None = None) -> bool:
+        return self._finished_event.wait(timeout)
+
+    # -- aggregation ---------------------------------------------------------
+
+    def _push_sync(self, worker_id: int, grads: dict[str, np.ndarray]) -> None:
+        """server.py:264-288: stash under sync_lock; when the round is full,
+        mean + apply + reset. No barrier — returns immediately."""
+        with self._sync_lock:
+            if self.config.strict_rounds:
+                # Corrected semantics: count distinct workers.
+                self._pending[worker_id] = grads
+                self._gradients_received = len(self._pending)
+            else:
+                # Faithful quirk 3: overwrite entry, increment count anyway.
+                self._pending[worker_id] = grads
+                self._gradients_received += 1
+
+            if self._gradients_received >= self.config.total_workers:
+                t0 = time.time()
+                mean = mean_gradients(self._pending.values())
+                with self._param_lock:
+                    sgd_apply(self.parameters, mean,
+                              self.config.learning_rate)
+                    self.global_step += 1
+                self.stats.total_parameter_updates += 1
+                self.stats.update_times.append(time.time() - t0)
+                self._pending.clear()
+                self._gradients_received = 0
+            self.stats.gradients_processed += 1
+
+    def _push_async(self, worker_id: int, grads: dict[str, np.ndarray],
+                    fetched_step: int) -> bool:
+        """server.py:290-304 + 171-186: bounded staleness with down-weighted
+        immediate apply."""
+        staleness = self.global_step - fetched_step
+        if staleness > self.config.staleness_bound:
+            self.stats.gradients_rejected += 1
+            return False
+        weight = staleness_weight(staleness)
+        t0 = time.time()
+        with self._param_lock:
+            sgd_apply(self.parameters, grads, self.config.learning_rate,
+                      weight=weight)
+            self.global_step += 1
+        self.stats.gradients_processed += 1
+        self.stats.total_parameter_updates += 1
+        self.stats.staleness_values.append(staleness)
+        self.stats.update_times.append(time.time() - t0)
+        return True
+
+    # -- observability -------------------------------------------------------
+
+    def metrics(self) -> dict:
+        """Final-statistics fields, matching the server's METRICS_JSON
+        (server.py:349-366; SURVEY.md §5.5)."""
+        elapsed = time.time() - self.stats.start_time
+        out = {
+            "mode": self.config.mode,
+            "total_workers": self.config.total_workers,
+            "total_training_time_seconds": round(elapsed, 2),
+            "global_steps_completed": self.global_step,
+            "total_parameter_updates": self.stats.total_parameter_updates,
+            "gradients_processed": self.stats.gradients_processed,
+            "average_update_time_seconds": (
+                round(float(np.mean(self.stats.update_times)), 6)
+                if self.stats.update_times else 0.0),
+            "updates_per_second": (
+                round(self.stats.total_parameter_updates / elapsed, 3)
+                if elapsed > 0 else 0.0),
+            "learning_rate": self.config.learning_rate,
+        }
+        if self.config.mode == "async":
+            sv = self.stats.staleness_values
+            out.update({
+                "staleness_bound": self.config.staleness_bound,
+                "gradients_rejected": self.stats.gradients_rejected,
+                "average_staleness": (round(float(np.mean(sv)), 3)
+                                      if sv else 0.0),
+                "max_staleness": int(max(sv)) if sv else 0,
+            })
+        return out

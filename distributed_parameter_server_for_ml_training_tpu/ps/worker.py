@@ -1,0 +1,221 @@
+"""Async-mode worker runtime: threads driving device-compiled local steps.
+
+Re-hosts the reference worker loop (src/workers/worker.py:350-403) against
+the in-process :class:`~.store.ParameterStore` (or a gRPC client with the
+same interface): register -> shard data by worker id -> per batch
+[fetch params if step%K==0] -> local fwd/bwd on the accelerator ->
+[push gradients if step%K==0] -> per-epoch full-test-set eval -> finished.
+
+K-step ("--sync-steps") semantics: the reference computes gradients on every
+batch but only pushes on ``batch_idx % K == 0`` batches — gradients from the
+other K-1 batches are DISCARDED (worker.py:339+376; SURVEY.md quirk 7), so
+K>1 trains on 1/K of the data. ``k_step_mode='faithful'`` reproduces that;
+``'accumulate'`` is the corrected local-SGD behavior (mean of the window's
+gradients pushed at the window end).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+
+from ..data.cifar import Dataset, make_batches, shard_range
+from ..train.steps import make_eval_step, make_grad_step
+from ..utils.pytree import flatten_params, unflatten_params
+from .store import ParameterStore
+
+
+@dataclass
+class WorkerConfig:
+    batch_size: int = 128      # worker.py:474-482 distributed defaults
+    num_epochs: int = 3
+    sync_steps: int = 1        # K; CLI default 1 (worker.py:468)
+    k_step_mode: str = "faithful"  # 'faithful' | 'accumulate'
+    augment: bool = True
+    eval_batch_size: int = 1000
+    eval_each_epoch: bool = True   # worker.py:393-394
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.k_step_mode not in ("faithful", "accumulate"):
+            raise ValueError(self.k_step_mode)
+        if self.sync_steps < 1:
+            raise ValueError("sync_steps must be >= 1")
+
+
+@dataclass
+class WorkerResult:
+    worker_id: int = -1
+    epoch_times: list = field(default_factory=list)
+    test_accuracies: list = field(default_factory=list)
+    local_steps_completed: int = 0
+    pushes_accepted: int = 0
+    pushes_rejected: int = 0
+    error: Exception | None = None
+
+    def metrics(self, total_workers: int, learning_rate: float,
+                config: WorkerConfig) -> dict:
+        """METRICS_JSON field parity with worker.py:421-434."""
+        return {
+            "worker_id": self.worker_id,
+            "total_workers": total_workers,
+            "total_training_time_seconds": round(sum(self.epoch_times), 2),
+            "average_epoch_time_seconds": (
+                round(float(np.mean(self.epoch_times)), 2)
+                if self.epoch_times else 0.0),
+            "epoch_times_seconds": [round(t, 2) for t in self.epoch_times],
+            "final_test_accuracy": (self.test_accuracies[-1]
+                                    if self.test_accuracies else 0.0),
+            "all_test_accuracies": self.test_accuracies,
+            "local_steps_completed": self.local_steps_completed,
+            "batch_size": config.batch_size,
+            "learning_rate": learning_rate,
+            "num_epochs": config.num_epochs,
+        }
+
+
+class PSWorker(threading.Thread):
+    """One logical worker. Runs as a thread; compute runs on the accelerator
+    via a shared jit-compiled grad step (one compile for all workers)."""
+
+    def __init__(self, store: ParameterStore, model, dataset: Dataset,
+                 config: WorkerConfig | None = None,
+                 grad_step=None, eval_step=None,
+                 worker_name: str = ""):
+        super().__init__(daemon=True)
+        self.store = store
+        self.model = model
+        self.dataset = dataset
+        self.config = config or WorkerConfig()
+        self.worker_name = worker_name
+        self.result = WorkerResult()
+        # Shared compiled functions may be passed in to avoid re-tracing per
+        # worker; otherwise built here.
+        self._grad_step = grad_step or make_grad_step(
+            model, augment=self.config.augment)
+        self._eval_step = eval_step or jax.jit(make_eval_step())
+
+    # -- the training loop (worker.py:350-403) ------------------------------
+
+    def run(self) -> None:
+        try:
+            self._run()
+        except Exception as e:  # surfaced via .result for the harness
+            self.result.error = e
+        finally:
+            if self.result.worker_id >= 0:
+                self.store.job_finished(self.result.worker_id)
+
+    def _run(self) -> None:
+        cfg = self.config
+        worker_id, total_workers = self.store.register_worker(self.worker_name)
+        self.result.worker_id = worker_id
+
+        # Contiguous shard by worker id (worker.py:166-179). Worker ids beyond
+        # total_workers (late re-registrations) wrap, unlike the reference
+        # where they'd skew coverage (SURVEY.md quirk 10).
+        lo, hi = shard_range(len(self.dataset.x_train),
+                             worker_id % total_workers, total_workers)
+        x_shard = self.dataset.x_train[lo:hi]
+        y_shard = self.dataset.y_train[lo:hi]
+
+        # Template structure for flat<->pytree conversion.
+        variables = self.model.init(
+            jax.random.PRNGKey(cfg.seed),
+            np.zeros((1, 32, 32, 3), np.float32), train=False)
+        batch_stats = variables["batch_stats"]
+        params = variables["params"]
+
+        rng = jax.random.PRNGKey(cfg.seed + worker_id)
+        fetched_step = 0
+        k = cfg.sync_steps
+        accum = None
+
+        for epoch in range(cfg.num_epochs):
+            t_epoch = time.time()
+            for batch_idx, (xb, yb) in enumerate(make_batches(
+                    x_shard, y_shard, cfg.batch_size,
+                    seed=cfg.seed * 1000 + epoch)):
+                boundary = batch_idx % k == 0
+                if boundary:
+                    flat, fetched_step = self.store.fetch(worker_id)
+                    params = unflatten_params(flat)
+
+                grads, batch_stats, loss, acc = self._grad_step(
+                    params, batch_stats, xb, yb, rng,
+                    self.result.local_steps_completed)
+                self.result.local_steps_completed += 1
+
+                if cfg.k_step_mode == "accumulate" and k > 1:
+                    g = jax.tree_util.tree_map(lambda a: a, grads)
+                    accum = g if accum is None else jax.tree_util.tree_map(
+                        lambda a, b: a + b, accum, g)
+                    window_end = (batch_idx % k == k - 1)
+                    if window_end:
+                        n = np.float32((batch_idx % k) + 1)
+                        push_tree = jax.tree_util.tree_map(
+                            lambda a: a / n, accum)
+                        accum = None
+                        self._push(worker_id, push_tree, fetched_step)
+                elif boundary:
+                    # Faithful: push THIS batch's gradients; the other K-1
+                    # batches' gradients are computed and dropped (quirk 7).
+                    self._push(worker_id, grads, fetched_step)
+
+            self.result.epoch_times.append(time.time() - t_epoch)
+            if cfg.eval_each_epoch:
+                self.result.test_accuracies.append(
+                    self.evaluate(params, batch_stats))
+
+    def _push(self, worker_id, grads_tree, fetched_step) -> None:
+        flat = flatten_params(jax.device_get(grads_tree))
+        if self.store.push(worker_id, flat, fetched_step):
+            self.result.pushes_accepted += 1
+        else:
+            self.result.pushes_rejected += 1
+
+    def evaluate(self, params, batch_stats) -> float:
+        """Full test-set top-1 (worker.py:313-331)."""
+        from ..train.train_state import TrainState  # light TrainState shim
+        import optax
+        state = TrainState.create(
+            apply_fn=self.model.apply, params=params,
+            batch_stats=batch_stats, tx=optax.identity())
+        correct = total = 0
+        for xb, yb in make_batches(self.dataset.x_test, self.dataset.y_test,
+                                   self.config.eval_batch_size,
+                                   shuffle=False, drop_remainder=False):
+            c, t = self._eval_step(state, xb, yb)
+            correct += int(c)
+            total += int(t)
+        return correct / max(total, 1)
+
+
+def run_workers(store: ParameterStore, model, dataset: Dataset,
+                n_workers: int, config: WorkerConfig | None = None,
+                timeout: float | None = None) -> list[WorkerResult]:
+    """Spawn N worker threads sharing one compiled step; join them all.
+
+    The in-process equivalent of launching N Fargate worker tasks
+    (terraform/main.tf:387-435).
+    """
+    config = config or WorkerConfig()
+    grad_step = make_grad_step(model, augment=config.augment)
+    eval_step = jax.jit(make_eval_step())
+    workers = [
+        PSWorker(store, model, dataset, config, grad_step=grad_step,
+                 eval_step=eval_step, worker_name=f"worker-{i}")
+        for i in range(n_workers)
+    ]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join(timeout)
+    for w in workers:
+        if w.result.error is not None:
+            raise w.result.error
+    return [w.result for w in workers]

@@ -64,6 +64,42 @@ def make_train_step(augment: bool = True) -> Callable:
     return train_step
 
 
+def make_grad_step(model, augment: bool = True) -> Callable:
+    """Build the *worker-local* step: forward/backward WITHOUT the update.
+
+    This is the async-mode analogue of the reference worker's
+    ``train_local_batch`` (worker.py:333-348): zero_grad -> forward -> CE
+    loss -> backward, with the parameter update left to the parameter store
+    (server.py:126-143). Returns
+    ``grad_step(params, batch_stats, images_u8, labels, rng, step)
+    -> (grads, new_batch_stats, loss, accuracy)``, jit-compiled once and
+    shared by all worker threads (same shapes => one executable).
+    """
+
+    @jax.jit
+    def grad_step(params, batch_stats, images_u8, labels, rng, step):
+        rng = jax.random.fold_in(rng, step)
+        images = to_float(images_u8)
+        if augment:
+            images = augment_batch(rng, images)
+        images = standardize(images)
+
+        def loss_fn(p):
+            outputs, mutated = model.apply(
+                {"params": p, "batch_stats": batch_stats},
+                images, train=True, mutable=["batch_stats"],
+            )
+            loss = cross_entropy_loss(outputs, labels)
+            return loss, (outputs, mutated["batch_stats"])
+
+        (loss, (logits, new_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        accuracy = jnp.mean(jnp.argmax(logits, -1) == labels)
+        return grads, new_stats, loss, accuracy
+
+    return grad_step
+
+
 def make_eval_step() -> Callable:
     """Build ``eval_step(state, images_u8, labels) -> (correct, total)``.
 

@@ -1,0 +1,5 @@
+"""Utilities: pytree<->flat-dict conversion, timing, structured metrics."""
+
+from .pytree import flatten_params, unflatten_params, tree_bytes
+
+__all__ = ["flatten_params", "unflatten_params", "tree_bytes"]

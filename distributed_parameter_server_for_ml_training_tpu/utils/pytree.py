@@ -1,0 +1,34 @@
+"""Pytree <-> flat ``{name: ndarray}`` dict conversion.
+
+The reference's canonical parameter format is a flat ``{param_name:
+np.ndarray}`` dict derived from a torch ``state_dict`` (server.py:96,
+worker.py:274-279); the wire format is that dict pickled. The async store
+keeps the same flat-dict shape (names are '/'-joined pytree paths), so
+store contents and payload logs are directly comparable to the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+from flax import traverse_util
+
+PyTree = Any
+
+
+def flatten_params(tree: PyTree) -> dict[str, np.ndarray]:
+    """Nested params pytree -> flat {'a/b/c': np.ndarray} dict."""
+    flat = traverse_util.flatten_dict(tree, sep="/")
+    return {k: np.asarray(v) for k, v in flat.items()}
+
+
+def unflatten_params(flat: Mapping[str, np.ndarray]) -> PyTree:
+    """Inverse of :func:`flatten_params`."""
+    return traverse_util.unflatten_dict(dict(flat), sep="/")
+
+
+def tree_bytes(flat: Mapping[str, np.ndarray]) -> int:
+    """Total payload size in bytes (the reference logs compressed sizes at
+    worker.py:292)."""
+    return sum(np.asarray(v).nbytes for v in flat.values())

@@ -1,0 +1,67 @@
+"""Unit tests for the aggregation math (SURVEY.md §4 seam (a))."""
+
+import numpy as np
+import pytest
+
+from distributed_parameter_server_for_ml_training_tpu.ps import (
+    DEFAULT_STALENESS_BOUND, mean_gradients, sgd_apply, staleness_weight)
+
+
+class TestStalenessWeight:
+    def test_fresh_gradient_full_weight(self):
+        assert staleness_weight(0) == 1.0
+
+    def test_reference_formula(self):
+        # server.py:178: max(0.1, 1/(1+0.1*s))
+        for s in range(0, 20):
+            assert staleness_weight(s) == pytest.approx(
+                max(0.1, 1.0 / (1.0 + 0.1 * s)))
+
+    def test_floor(self):
+        assert staleness_weight(1000) == 0.1
+
+    def test_monotone_decreasing(self):
+        ws = [staleness_weight(s) for s in range(10)]
+        assert all(a >= b for a, b in zip(ws, ws[1:]))
+
+    def test_default_bound_matches_reference(self):
+        assert DEFAULT_STALENESS_BOUND == 5  # server.py:418
+
+
+class TestMeanGradients:
+    def test_elementwise_mean(self):
+        g1 = {"w": np.array([1.0, 2.0]), "b": np.array([0.0])}
+        g2 = {"w": np.array([3.0, 4.0]), "b": np.array([2.0])}
+        m = mean_gradients([g1, g2])
+        np.testing.assert_allclose(m["w"], [2.0, 3.0])
+        np.testing.assert_allclose(m["b"], [1.0])
+
+    def test_single_worker_identity(self):
+        g = {"w": np.array([1.5, -2.0])}
+        np.testing.assert_allclose(mean_gradients([g])["w"], g["w"])
+
+    def test_mismatched_names_rejected(self):
+        with pytest.raises(ValueError):
+            mean_gradients([{"w": np.zeros(2)}, {"v": np.zeros(2)}])
+
+    def test_empty_rejected(self):
+        with pytest.raises(ValueError):
+            mean_gradients([])
+
+
+class TestSgdApply:
+    def test_plain_update(self):
+        p = {"w": np.array([1.0, 1.0], np.float32)}
+        sgd_apply(p, {"w": np.array([0.5, -0.5])}, lr=0.1)
+        np.testing.assert_allclose(p["w"], [0.95, 1.05])
+
+    def test_staleness_weight_scales(self):
+        p = {"w": np.array([1.0], np.float32)}
+        sgd_apply(p, {"w": np.array([1.0])}, lr=0.1, weight=0.5)
+        np.testing.assert_allclose(p["w"], [0.95])
+
+    def test_unknown_names_ignored(self):
+        # server.py:131 'if name in self.parameters'
+        p = {"w": np.array([1.0], np.float32)}
+        sgd_apply(p, {"nope": np.array([9.9])}, lr=0.1)
+        np.testing.assert_allclose(p["w"], [1.0])

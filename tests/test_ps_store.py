@@ -1,0 +1,172 @@
+"""Parameter-store state machine tests (SURVEY.md §4 seam (b)): the
+register/fetch/push/finish lifecycle against an in-process store — the
+integration tests the reference never had."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from distributed_parameter_server_for_ml_training_tpu.ps import (
+    ParameterStore, StoreConfig, staleness_weight)
+
+
+def make_store(**kw):
+    params = {"w": np.ones(4, np.float32), "b": np.zeros(2, np.float32)}
+    return ParameterStore(params, StoreConfig(**kw))
+
+
+def ones_grads(v=1.0):
+    return {"w": np.full(4, v, np.float32), "b": np.full(2, v, np.float32)}
+
+
+class TestRegistration:
+    def test_sequential_ids(self):
+        s = make_store(total_workers=4)
+        ids = [s.register_worker(f"w{i}")[0] for i in range(4)]
+        assert ids == [0, 1, 2, 3]  # server.py:193-194
+
+    def test_returns_total_workers(self):
+        s = make_store(total_workers=7)
+        assert s.register_worker()[1] == 7  # server.py:208-211
+
+    def test_concurrent_registration_unique_ids(self):
+        s = make_store(total_workers=32)
+        ids = []
+        lock = threading.Lock()
+
+        def reg():
+            wid, _ = s.register_worker()
+            with lock:
+                ids.append(wid)
+
+        threads = [threading.Thread(target=reg) for _ in range(32)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sorted(ids) == list(range(32))
+
+    def test_worker_count_validation(self):
+        # server.py:424-426: 1..32
+        with pytest.raises(ValueError):
+            StoreConfig(total_workers=0)
+        with pytest.raises(ValueError):
+            StoreConfig(total_workers=33)
+        StoreConfig(total_workers=32)
+
+
+class TestSyncAggregation:
+    def test_round_applies_mean(self):
+        s = make_store(mode="sync", total_workers=2, learning_rate=0.1,
+                       push_codec="none")
+        w0 = s.parameters["w"].copy()
+        s.push(0, ones_grads(1.0), 0)
+        np.testing.assert_array_equal(s.parameters["w"], w0)  # not yet
+        assert s.global_step == 0
+        s.push(1, ones_grads(3.0), 0)
+        # mean = 2.0, p -= 0.1*2.0
+        np.testing.assert_allclose(s.parameters["w"], w0 - 0.2)
+        assert s.global_step == 1
+
+    def test_no_barrier_push_returns_immediately(self):
+        # Quirk 2: PushReply(received=True) even while waiting (server.py:288)
+        s = make_store(mode="sync", total_workers=4, push_codec="none")
+        assert s.push(0, ones_grads(), 0) is True
+        assert s.global_step == 0
+
+    def test_faithful_double_push_completes_round(self):
+        # Quirk 3: dict overwrite + counter increment (server.py:267-268):
+        # one worker pushing twice completes a 2-worker round.
+        s = make_store(mode="sync", total_workers=2, push_codec="none")
+        s.push(0, ones_grads(1.0), 0)
+        s.push(0, ones_grads(5.0), 0)
+        assert s.global_step == 1
+        # only worker 0's LAST entry was pending -> mean over dict = 5.0
+        np.testing.assert_allclose(s.parameters["w"], 1.0 - 0.1 * 5.0)
+
+    def test_strict_rounds_requires_distinct_workers(self):
+        s = make_store(mode="sync", total_workers=2, push_codec="none",
+                       strict_rounds=True)
+        s.push(0, ones_grads(1.0), 0)
+        s.push(0, ones_grads(5.0), 0)
+        assert s.global_step == 0  # still waiting on worker 1
+        s.push(1, ones_grads(3.0), 0)
+        assert s.global_step == 1
+        np.testing.assert_allclose(s.parameters["w"], 1.0 - 0.1 * 4.0)
+
+    def test_fp16_push_codec_roundtrip(self):
+        # worker.py:264-268 / server.py:232-237
+        from distributed_parameter_server_for_ml_training_tpu.ops import (
+            fp16_compress)
+        s = make_store(mode="sync", total_workers=1, push_codec="fp16")
+        s.push(0, fp16_compress(ones_grads(0.123)), 0)
+        expected = 1.0 - 0.1 * np.float32(np.float16(0.123))
+        np.testing.assert_allclose(s.parameters["w"], expected, rtol=1e-6)
+
+
+class TestAsyncAggregation:
+    def test_fresh_gradient_applied_immediately(self):
+        s = make_store(mode="async", total_workers=2, push_codec="none")
+        assert s.push(0, ones_grads(1.0), 0) is True
+        assert s.global_step == 1
+        np.testing.assert_allclose(s.parameters["w"], 0.9)
+
+    def test_staleness_weighting(self):
+        s = make_store(mode="async", total_workers=2, push_codec="none")
+        for _ in range(3):  # advance global step to 3
+            s.push(0, ones_grads(0.0), s.global_step)
+        w_before = s.parameters["w"].copy()
+        s.push(1, ones_grads(1.0), 0)  # staleness 3
+        w = staleness_weight(3)
+        np.testing.assert_allclose(
+            s.parameters["w"], w_before - np.float32(0.1 * w), rtol=1e-6)
+
+    def test_rejection_beyond_bound(self):
+        # server.py:173: staleness > bound (default 5) -> rejected
+        s = make_store(mode="async", total_workers=2, push_codec="none",
+                       staleness_bound=5)
+        for _ in range(6):
+            s.push(0, ones_grads(0.0), s.global_step)
+        assert s.global_step == 6
+        w_before = s.parameters["w"].copy()
+        assert s.push(1, ones_grads(1.0), 0) is False  # staleness 6 > 5
+        np.testing.assert_array_equal(s.parameters["w"], w_before)
+        assert s.metrics()["gradients_rejected"] == 1
+
+    def test_staleness_exactly_at_bound_accepted(self):
+        s = make_store(mode="async", total_workers=2, push_codec="none",
+                       staleness_bound=5)
+        for _ in range(5):
+            s.push(0, ones_grads(0.0), s.global_step)
+        assert s.push(1, ones_grads(1.0), 0) is True  # staleness 5 == bound
+
+
+class TestLifecycle:
+    def test_finished_event_fires_when_all_done(self):
+        s = make_store(total_workers=2)
+        a, _ = s.register_worker()
+        b, _ = s.register_worker()
+        s.job_finished(a)
+        assert not s.wait_all_finished(timeout=0.01)
+        s.job_finished(b)
+        assert s.wait_all_finished(timeout=0.01)
+
+    def test_fetch_returns_copy(self):
+        s = make_store(push_codec="none")
+        payload, step = s.fetch()
+        payload["w"][:] = 99.0
+        assert s.parameters["w"][0] == 1.0
+
+    def test_metrics_fields_server_parity(self):
+        # server.py:349-366 field list (SURVEY.md §5.5)
+        s = make_store(mode="async", total_workers=2, push_codec="none")
+        s.push(0, ones_grads(), 0)
+        m = s.metrics()
+        for key in ["mode", "total_workers", "total_training_time_seconds",
+                    "global_steps_completed", "total_parameter_updates",
+                    "gradients_processed", "average_update_time_seconds",
+                    "updates_per_second", "learning_rate", "staleness_bound",
+                    "gradients_rejected", "average_staleness",
+                    "max_staleness"]:
+            assert key in m, key

@@ -1,0 +1,151 @@
+"""End-to-end async/sync parameter-server training with worker threads —
+the in-process replacement for the reference's deploy-to-Fargate-to-find-out
+verification (SURVEY.md §4)."""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_parameter_server_for_ml_training_tpu.data import (
+    synthetic_cifar100)
+from distributed_parameter_server_for_ml_training_tpu.ps import (
+    ParameterStore, StoreConfig, WorkerConfig, run_workers)
+from distributed_parameter_server_for_ml_training_tpu.utils import (
+    flatten_params, unflatten_params)
+
+
+@pytest.fixture(scope="module")
+def small_dataset():
+    return synthetic_cifar100(n_train=640, n_test=128, num_classes=10, seed=1)
+
+
+@pytest.fixture(scope="module")
+def model(tiny_model_module):
+    return tiny_model_module
+
+
+@pytest.fixture(scope="module")
+def tiny_model_module():
+    from distributed_parameter_server_for_ml_training_tpu.models import ResNet
+    return ResNet(stage_sizes=(1, 1), num_filters=8, num_classes=10)
+
+
+def init_flat(model, seed=0):
+    variables = model.init(jax.random.PRNGKey(seed),
+                           np.zeros((1, 32, 32, 3), np.float32), train=False)
+    return flatten_params(variables["params"])
+
+
+def test_async_workers_train(model, small_dataset):
+    store = ParameterStore(
+        init_flat(model),
+        StoreConfig(mode="async", total_workers=4, learning_rate=0.05))
+    results = run_workers(store, model, small_dataset, n_workers=4,
+                          config=WorkerConfig(batch_size=32, num_epochs=2,
+                                              augment=False))
+    assert len(results) == 4
+    assert {r.worker_id for r in results} == {0, 1, 2, 3}
+    assert all(r.local_steps_completed > 0 for r in results)
+    assert store.global_step > 0
+    m = store.metrics()
+    assert m["gradients_processed"] > 0
+    # every worker evaluated each epoch (worker.py:393-394)
+    assert all(len(r.test_accuracies) == 2 for r in results)
+
+
+def test_async_training_learns(model, small_dataset):
+    """Loss-over-time proxy: params move and final eval beats chance."""
+    store = ParameterStore(
+        init_flat(model),
+        StoreConfig(mode="async", total_workers=2, learning_rate=0.05))
+    results = run_workers(store, model, small_dataset, n_workers=2,
+                          config=WorkerConfig(batch_size=32, num_epochs=4,
+                                              augment=False))
+    final_accs = [r.test_accuracies[-1] for r in results]
+    assert np.mean(final_accs) > 0.15  # 10 classes, chance = 0.10
+
+
+def test_sync_store_mode_with_workers(model, small_dataset):
+    store = ParameterStore(
+        init_flat(model),
+        StoreConfig(mode="sync", total_workers=2, learning_rate=0.05))
+    results = run_workers(store, model, small_dataset, n_workers=2,
+                          config=WorkerConfig(batch_size=32, num_epochs=1,
+                                              augment=False))
+    assert store.global_step > 0
+    assert store.metrics()["total_parameter_updates"] > 0
+    assert all(r.error is None for r in results)
+
+
+def test_single_async_worker_equals_plain_sgd(model, small_dataset):
+    """With ONE worker, staleness is always 0 (weight 1.0), so async PS
+    training must equal a plain sequential SGD on the same batches —
+    the store *is* `p -= lr*g` (server.py:133)."""
+    from distributed_parameter_server_for_ml_training_tpu.train.steps import (
+        make_grad_step)
+
+    flat0 = init_flat(model)
+    lr = 0.05
+    store = ParameterStore(
+        dict(flat0), StoreConfig(mode="sync", total_workers=1,
+                                 learning_rate=lr, push_codec="none"))
+    cfg = WorkerConfig(batch_size=32, num_epochs=1, augment=False,
+                       eval_each_epoch=False, seed=0)
+    run_workers(store, model, small_dataset, n_workers=1, config=cfg)
+
+    # Manual replay: same shard (worker 0 of 1 = all data), same batch order.
+    from distributed_parameter_server_for_ml_training_tpu.data import (
+        make_batches)
+    variables = model.init(jax.random.PRNGKey(0),
+                           np.zeros((1, 32, 32, 3), np.float32), train=False)
+    params = unflatten_params(dict(flat0))
+    batch_stats = variables["batch_stats"]
+    grad_step = make_grad_step(model, augment=False)
+    rng = jax.random.PRNGKey(0)
+    step_count = 0
+    for xb, yb in make_batches(small_dataset.x_train, small_dataset.y_train,
+                               32, seed=0):
+        grads, batch_stats, _, _ = grad_step(params, batch_stats, xb, yb,
+                                             rng, step_count)
+        flat_g = flatten_params(jax.device_get(grads))
+        params_flat = flatten_params(jax.device_get(params))
+        for k in params_flat:
+            params_flat[k] = params_flat[k] - np.float32(lr) * flat_g[k]
+        params = unflatten_params(params_flat)
+        step_count += 1
+
+    for k, v in flatten_params(jax.device_get(params)).items():
+        np.testing.assert_allclose(store.parameters[k], v, rtol=1e-4,
+                                   atol=1e-5)
+
+
+def test_k_step_faithful_pushes_fraction(model, small_dataset):
+    """K=2 faithful mode: half the batches push (worker.py:367-377), the
+    other half's gradients are computed and discarded (quirk 7)."""
+    store = ParameterStore(
+        init_flat(model),
+        StoreConfig(mode="async", total_workers=1, learning_rate=0.05))
+    cfg = WorkerConfig(batch_size=32, num_epochs=1, sync_steps=2,
+                       k_step_mode="faithful", augment=False,
+                       eval_each_epoch=False)
+    results = run_workers(store, model, small_dataset, n_workers=1,
+                          config=cfg)
+    r = results[0]
+    n_batches = (len(small_dataset.x_train) // 32)
+    assert r.local_steps_completed == n_batches
+    assert r.pushes_accepted == (n_batches + 1) // 2
+
+
+def test_k_step_accumulate_pushes_window_mean(model, small_dataset):
+    store = ParameterStore(
+        init_flat(model),
+        StoreConfig(mode="async", total_workers=1, learning_rate=0.05))
+    cfg = WorkerConfig(batch_size=32, num_epochs=1, sync_steps=2,
+                       k_step_mode="accumulate", augment=False,
+                       eval_each_epoch=False)
+    results = run_workers(store, model, small_dataset, n_workers=1,
+                          config=cfg)
+    r = results[0]
+    n_batches = len(small_dataset.x_train) // 32
+    assert r.local_steps_completed == n_batches
+    assert r.pushes_accepted == n_batches // 2
