@@ -1,0 +1,247 @@
+"""Command-line interface: the reference's run recipes, mapped 1:1.
+
+Reference surface (SURVEY.md §2.18): argparse with env-var defaults —
+server: ``--mode/--workers/--lr/--port/--staleness-bound`` (env SERVER_MODE,
+TOTAL_WORKERS_EXPECTED, SERVER_PORT; server.py:405-433); worker:
+``--server/--worker-name/--epochs/--batch-size/--lr/--sync-steps`` (env
+PARAMETER_SERVER_ADDRESS; worker.py:455-482); plus baseline_training.py.
+
+Commands::
+
+    python -m distributed_parameter_server_for_ml_training_tpu.cli train \
+        --mode sync --workers 4 --epochs 3            # in-process cluster
+    python -m ....cli train --mode baseline           # single-chip baseline
+    python -m ....cli serve --mode async --workers 8  # gRPC PS (multi-host)
+    python -m ....cli worker --server host:8000       # gRPC remote worker
+
+The in-process ``train`` command replaces the reference's entire
+terraform/ECS deployment for single-host experiments: what took a Fargate
+cluster (terraform/main.tf) is N mesh slots (sync) or N threads (async).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+
+def _env(name: str, default, cast=str):
+    v = os.environ.get(name)
+    return cast(v) if v is not None else default
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="distributed_parameter_server_for_ml_training_tpu",
+        description="TPU-native sync/async data-parallel training")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    def add_platform(q):
+        q.add_argument("--platform", choices=["default", "cpu"],
+                       default="default",
+                       help="force the JAX backend (the axon site hook pins "
+                            "JAX_PLATFORMS, so env overrides don't work; "
+                            "'cpu' is needed when another process holds the "
+                            "TPU, e.g. multi-process serve/worker runs)")
+
+    def add_common(q):
+        add_platform(q)
+        q.add_argument("--lr", type=float,
+                       default=_env("LEARNING_RATE", 0.1, float),
+                       help="server SGD learning rate (server.py:413)")
+        q.add_argument("--epochs", type=int,
+                       default=_env("NUM_EPOCHS", 3, int))
+        q.add_argument("--batch-size", type=int,
+                       default=_env("BATCH_SIZE", 128, int),
+                       help="per-worker batch size (worker.py:462)")
+        q.add_argument("--data-dir", default=os.environ.get("CIFAR100_DIR"))
+        q.add_argument("--synthetic", action="store_true",
+                       help="force the synthetic dataset (no-network envs)")
+        q.add_argument("--num-train", type=int, default=None,
+                       help="truncate train set (quick runs)")
+        q.add_argument("--num-test", type=int, default=None,
+                       help="truncate test set (quick runs)")
+        q.add_argument("--no-augment", action="store_true")
+        q.add_argument("--dtype", choices=["bfloat16", "float32"],
+                       default="bfloat16")
+        q.add_argument("--seed", type=int, default=0)
+        q.add_argument("--emit-metrics", action="store_true",
+                       help="print METRICS_JSON lines (server.py:367)")
+
+    t = sub.add_parser("train", help="in-process training run")
+    t.add_argument("--mode", choices=["baseline", "sync", "async"],
+                   default=_env("SERVER_MODE", "sync"))
+    t.add_argument("--workers", type=int,
+                   default=_env("TOTAL_WORKERS_EXPECTED", 4, int))
+    t.add_argument("--staleness-bound", type=int,
+                   default=_env("STALENESS_BOUND", 5, int))
+    t.add_argument("--sync-steps", type=int,
+                   default=_env("SYNC_STEPS", 1, int),
+                   help="K-step local SGD interval (worker.py:468)")
+    t.add_argument("--k-step-mode", choices=["faithful", "accumulate"],
+                   default="faithful")
+    t.add_argument("--compression", choices=["none", "bf16", "fp16"],
+                   default="bf16", help="sync all-reduce precision")
+    t.add_argument("--strict-rounds", action="store_true",
+                   help="corrected sync-round semantics (vs quirk 3)")
+    t.add_argument("--plot", default=None, help="save a results plot (png)")
+    add_common(t)
+
+    s = sub.add_parser("serve", help="gRPC parameter server (multi-host)")
+    s.add_argument("--mode", choices=["sync", "async"],
+                   default=_env("SERVER_MODE", "sync"))
+    s.add_argument("--workers", type=int,
+                   default=_env("TOTAL_WORKERS_EXPECTED", 4, int))
+    s.add_argument("--port", type=int, default=_env("SERVER_PORT", 8000, int))
+    s.add_argument("--staleness-bound", type=int,
+                   default=_env("STALENESS_BOUND", 5, int))
+    s.add_argument("--lr", type=float,
+                   default=_env("LEARNING_RATE", 0.1, float))
+    s.add_argument("--num-classes", type=int, default=100)
+    s.add_argument("--seed", type=int, default=0)
+    s.add_argument("--emit-metrics", action="store_true")
+    add_platform(s)
+
+    w = sub.add_parser("worker", help="gRPC remote worker")
+    w.add_argument("--server",
+                   default=_env("PARAMETER_SERVER_ADDRESS",
+                                "localhost:8000"),
+                   help="PS address (worker.py:457-459)")
+    w.add_argument("--worker-name", default=_env("WORKER_NAME", ""))
+    w.add_argument("--sync-steps", type=int,
+                   default=_env("SYNC_STEPS", 1, int))
+    w.add_argument("--k-step-mode", choices=["faithful", "accumulate"],
+                   default="faithful")
+    add_common(w)
+
+    return p
+
+
+def _load_dataset(args):
+    from .data import load_cifar100, synthetic_cifar100
+
+    if getattr(args, "synthetic", False):
+        ds = synthetic_cifar100()
+    else:
+        ds = load_cifar100(getattr(args, "data_dir", None))
+    if getattr(args, "num_train", None):
+        ds.x_train = ds.x_train[:args.num_train]
+        ds.y_train = ds.y_train[:args.num_train]
+    if getattr(args, "num_test", None):
+        ds.x_test = ds.x_test[:args.num_test]
+        ds.y_test = ds.y_test[:args.num_test]
+    return ds
+
+
+def cmd_train(args) -> int:
+    dataset = _load_dataset(args)
+    if dataset.synthetic:
+        print("note: CIFAR-100 not found on disk; using the synthetic "
+              "dataset", file=sys.stderr)
+
+    if args.mode == "baseline":
+        from .train.baseline import BaselineConfig, BaselineTrainer
+        cfg = BaselineConfig(batch_size=args.batch_size,
+                             num_epochs=args.epochs,
+                             learning_rate=args.lr,
+                             augment=not args.no_augment,
+                             dtype=args.dtype, seed=args.seed)
+        trainer = BaselineTrainer(dataset, cfg)
+        trainer.train(plot_path=args.plot,
+                      emit_metrics=args.emit_metrics)
+        return 0
+
+    from .train.distributed import (AsyncTrainer, DistributedConfig,
+                                    SyncTrainer)
+    cfg = DistributedConfig(
+        mode=args.mode, num_workers=args.workers, learning_rate=args.lr,
+        num_epochs=args.epochs, batch_size=args.batch_size,
+        sync_steps=args.sync_steps, k_step_mode=args.k_step_mode,
+        staleness_bound=args.staleness_bound, compression=args.compression,
+        strict_rounds=args.strict_rounds, augment=not args.no_augment,
+        dtype=args.dtype, seed=args.seed)
+    trainer = (SyncTrainer if args.mode == "sync" else AsyncTrainer)(
+        dataset, cfg)
+    metrics = trainer.train(emit_metrics=args.emit_metrics)
+    print(f"done: {metrics}", file=sys.stderr)
+    return 0
+
+
+def cmd_serve(args) -> int:
+    import time
+
+    import jax
+    import numpy as np
+
+    from .comms.service import serve
+    from .models import ResNet18
+    from .ps.store import ParameterStore, StoreConfig
+    from .utils.metrics import emit_metrics_json
+    from .utils.pytree import flatten_params
+
+    model = ResNet18(num_classes=args.num_classes)
+    variables = model.init(jax.random.PRNGKey(args.seed),
+                           np.zeros((1, 32, 32, 3), np.float32), train=False)
+    store = ParameterStore(
+        flatten_params(variables["params"]),
+        StoreConfig(mode=args.mode, total_workers=args.workers,
+                    learning_rate=args.lr,
+                    staleness_bound=args.staleness_bound))
+    server, port = serve(store, port=args.port)
+    print(f"parameter server up on :{port} "
+          f"(mode={args.mode}, workers={args.workers})", file=sys.stderr)
+    try:
+        # server.py:399-403 sleep-forever loop, but exiting cleanly once all
+        # registered workers report JobFinished.
+        while not store.wait_all_finished(timeout=1.0):
+            pass
+        time.sleep(0.5)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop(grace=2.0)
+    if args.emit_metrics:
+        emit_metrics_json(store.metrics())
+    return 0
+
+
+def cmd_worker(args) -> int:
+    from .comms.client import RemoteStore
+    from .models import ResNet18
+    from .ps.worker import PSWorker, WorkerConfig
+    from .utils.metrics import emit_metrics_json
+
+    dataset = _load_dataset(args)
+    store = RemoteStore(args.server)
+    import jax.numpy as jnp
+    dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
+    model = ResNet18(num_classes=100, dtype=dtype)
+    cfg = WorkerConfig(batch_size=args.batch_size, num_epochs=args.epochs,
+                       sync_steps=args.sync_steps,
+                       k_step_mode=args.k_step_mode,
+                       augment=not args.no_augment, seed=args.seed)
+    worker = PSWorker(store, model, dataset, cfg,
+                      worker_name=args.worker_name)
+    worker.start()
+    worker.join()
+    if worker.result.error is not None:
+        raise worker.result.error
+    if args.emit_metrics:
+        emit_metrics_json(worker.result.metrics(
+            total_workers=0, learning_rate=args.lr, config=cfg))
+    store.close()
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if getattr(args, "platform", "default") == "cpu":
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    return {"train": cmd_train, "serve": cmd_serve,
+            "worker": cmd_worker}[args.command](args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,20 @@
+"""Multi-host communication: wire codec + gRPC parameter service.
+
+The reference's L2 (src/communication/): a 4-RPC gRPC service with tensors as
+opaque pickled bytes (ps.proto:4-19, worker.py:289). Here the same lifecycle
+is exposed over gRPC for DCN/multi-host deployments — but with a safe
+length-prefixed tensor codec instead of pickle, and the TPU-native sync path
+(XLA collectives over ICI) not using this service at all.
+"""
+
+from .wire import encode_tensor_dict, decode_tensor_dict
+from .service import ParameterService, serve
+from .client import RemoteStore
+
+__all__ = [
+    "encode_tensor_dict",
+    "decode_tensor_dict",
+    "ParameterService",
+    "serve",
+    "RemoteStore",
+]

@@ -1,0 +1,85 @@
+"""gRPC client: a remote ParameterStore with the in-process interface.
+
+`RemoteStore` duck-types :class:`~..ps.store.ParameterStore`'s worker-facing
+API (register_worker / fetch / push / job_finished), so
+:class:`~..ps.worker.PSWorker` runs unchanged against a server on another
+host — the reference's worker/server split (worker.py:199-231) without
+Fargate.
+
+Reference parity: registration retries 5x with exponential backoff
+(worker.py:215-229); fp16 push compression happens client-side
+(worker.py:264-268) when the server's codec asks for it; channel options
+match worker.py:203-209.
+"""
+
+from __future__ import annotations
+
+import time
+
+import grpc
+import numpy as np
+
+from .service import GRPC_OPTIONS, SERVICE_NAME, pack_msg, unpack_msg
+
+
+class RemoteStore:
+    """Client-side stand-in for ParameterStore over gRPC."""
+
+    def __init__(self, address: str = "localhost:8000",
+                 register_retries: int = 5):
+        self.address = address
+        self.register_retries = register_retries
+        self._channel = grpc.insecure_channel(address, options=GRPC_OPTIONS)
+        ident = lambda b: b  # noqa: E731
+        self._call = {
+            name: self._channel.unary_unary(
+                f"/{SERVICE_NAME}/{name}",
+                request_serializer=ident, response_deserializer=ident)
+            for name in ["RegisterWorker", "PushGradrients",
+                         "FetchParameters", "JobFinished"]
+        }
+        #: filled in at registration from the server's config; PSWorker reads
+        #: this to apply the fp16 cast client-side (worker.py:264-268).
+        self.push_codec = "none"
+
+    def register_worker(self, worker_name: str = "") -> tuple[int, int]:
+        """Retry x5 with exponential backoff (worker.py:215-229)."""
+        delay = 1.0
+        last_err = None
+        for attempt in range(self.register_retries):
+            try:
+                reply, _ = unpack_msg(self._call["RegisterWorker"](
+                    pack_msg({"worker_name": worker_name})))
+                self.push_codec = reply.get("push_codec", "none")
+                return int(reply["worker_id"]), int(reply["total_workers"])
+            except grpc.RpcError as e:
+                last_err = e
+                time.sleep(delay)
+                delay *= 2
+        raise ConnectionError(
+            f"registration failed after {self.register_retries} attempts: "
+            f"{last_err}")
+
+    def fetch(self, worker_id: int | None = None
+              ) -> tuple[dict[str, np.ndarray], int]:
+        from .wire import decode_tensor_dict
+        meta = {} if worker_id is None else {"worker_id": worker_id}
+        reply = self._call["FetchParameters"](pack_msg(meta))
+        rmeta, payload = unpack_msg(reply)
+        return decode_tensor_dict(payload), int(rmeta["global_step"])
+
+    def push(self, worker_id: int, gradients: dict, fetched_step: int) -> bool:
+        """Encode and send as-is: the caller (PSWorker._push) applies the
+        codec, so compressed bytes hit the wire exactly once."""
+        from .wire import encode_tensor_dict
+        reply = self._call["PushGradrients"](pack_msg(
+            {"worker_id": worker_id, "fetched_step": fetched_step},
+            encode_tensor_dict(gradients)))
+        rmeta, _ = unpack_msg(reply)
+        return bool(rmeta["accepted"])
+
+    def job_finished(self, worker_id: int) -> None:
+        self._call["JobFinished"](pack_msg({"worker_id": worker_id}))
+
+    def close(self) -> None:
+        self._channel.close()

@@ -1,0 +1,125 @@
+"""gRPC parameter service: the reference wire protocol, re-hosted.
+
+Serves a :class:`~..ps.store.ParameterStore` over gRPC for multi-host (DCN)
+deployments. Protocol parity with src/communication/ps.proto:4-19 — the same
+four unary-unary RPCs under the same service name, including the load-bearing
+wire-protocol typo ``PushGradrients`` (ps.proto:12; SURVEY.md quirk 1):
+
+    /ps.ParameterServer/RegisterWorker
+    /ps.ParameterServer/PushGradrients
+    /ps.ParameterServer/FetchParameters
+    /ps.ParameterServer/JobFinished
+
+Implemented with gRPC generic handlers (no protoc codegen): messages are a
+JSON envelope + optional tensor payload (comms/wire.py) instead of the
+reference's pickled bytes inside protobuf (worker.py:289) — same opacity on
+the wire, none of pickle's code execution.
+
+Channel/server tuning parity (server.py:372-381): 500 MB max message sizes,
+keepalive 30 s / 5 s timeout, permit-without-calls, ThreadPoolExecutor(20).
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+from concurrent import futures
+
+import grpc
+
+from ..ps.store import ParameterStore
+from .wire import decode_tensor_dict, encode_tensor_dict
+
+SERVICE_NAME = "ps.ParameterServer"
+
+# server.py:372-378 / worker.py:203-209
+GRPC_OPTIONS = [
+    ("grpc.max_send_message_length", 500 * 1024 * 1024),
+    ("grpc.max_receive_message_length", 500 * 1024 * 1024),
+    ("grpc.keepalive_time_ms", 30_000),
+    ("grpc.keepalive_timeout_ms", 5_000),
+    ("grpc.keepalive_permit_without_calls", 1),
+]
+
+
+def pack_msg(meta: dict, payload: bytes = b"") -> bytes:
+    header = json.dumps(meta).encode("utf-8")
+    return struct.pack("<I", len(header)) + header + payload
+
+
+def unpack_msg(data: bytes) -> tuple[dict, bytes]:
+    (hlen,) = struct.unpack_from("<I", data, 0)
+    meta = json.loads(data[4:4 + hlen].decode("utf-8"))
+    return meta, data[4 + hlen:]
+
+
+class ParameterService:
+    """Generic-handler implementation of the 4-RPC lifecycle."""
+
+    def __init__(self, store: ParameterStore):
+        self.store = store
+
+    # -- RPC bodies (request bytes -> reply bytes) --------------------------
+
+    def register_worker(self, request: bytes, ctx) -> bytes:
+        meta, _ = unpack_msg(request)
+        worker_id, total = self.store.register_worker(
+            meta.get("worker_name", ""))
+        return pack_msg({
+            "worker_id": worker_id,
+            "total_workers": total,
+            # Client needs the server's codec/mode to compress correctly.
+            "push_codec": self.store.config.push_codec,
+            "mode": self.store.config.mode,
+            "learning_rate": self.store.config.learning_rate,
+        })
+
+    def push_gradrients(self, request: bytes, ctx) -> bytes:
+        meta, payload = unpack_msg(request)
+        grads = decode_tensor_dict(payload)
+        accepted = self.store.push(int(meta["worker_id"]), grads,
+                                   int(meta["fetched_step"]))
+        return pack_msg({"received": True, "accepted": accepted,
+                         "global_step": self.store.global_step})
+
+    def fetch_parameters(self, request: bytes, ctx) -> bytes:
+        meta, _ = unpack_msg(request)
+        wid = meta.get("worker_id")
+        params, step = self.store.fetch(None if wid is None else int(wid))
+        return pack_msg({"global_step": step}, encode_tensor_dict(params))
+
+    def job_finished(self, request: bytes, ctx) -> bytes:
+        meta, _ = unpack_msg(request)
+        self.store.job_finished(int(meta["worker_id"]))
+        return pack_msg({"acknowledged": True})
+
+    # -- wiring --------------------------------------------------------------
+
+    def handlers(self) -> grpc.GenericRpcHandler:
+        ident = lambda b: b  # noqa: E731 — bytes pass through untouched
+        method_map = {
+            "RegisterWorker": self.register_worker,
+            "PushGradrients": self.push_gradrients,  # quirk 1, on purpose
+            "FetchParameters": self.fetch_parameters,
+            "JobFinished": self.job_finished,
+        }
+        return grpc.method_handlers_generic_handler(SERVICE_NAME, {
+            name: grpc.unary_unary_rpc_method_handler(
+                fn, request_deserializer=ident, response_serializer=ident)
+            for name, fn in method_map.items()
+        })
+
+
+def serve(store: ParameterStore, port: int = 8000,
+          max_rpc_workers: int = 20) -> tuple[grpc.Server, int]:
+    """Start the service (server.py:370-393). Returns (server, bound_port) —
+    pass port=0 to pick a free port. Callers own shutdown. ThreadPool of 20
+    reproduces the reference's cap — including its quirk 9 (20 < the
+    32-worker max)."""
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_rpc_workers),
+        options=GRPC_OPTIONS)
+    server.add_generic_rpc_handlers((ParameterService(store).handlers(),))
+    bound = server.add_insecure_port(f"[::]:{port}")
+    server.start()
+    return server, bound

@@ -101,6 +101,12 @@ class ParameterStore:
         self.stats = _Stats()
         self._finished_event = threading.Event()
 
+    @property
+    def push_codec(self) -> str:
+        """Codec workers must apply before pushing (worker.py:264-268 did the
+        fp16 cast on the worker side)."""
+        return self.config.push_codec
+
     # -- lifecycle ---------------------------------------------------- ps.proto:8
 
     def register_worker(self, worker_name: str = "") -> tuple[int, int]:

@@ -173,6 +173,11 @@ class PSWorker(threading.Thread):
 
     def _push(self, worker_id, grads_tree, fetched_step) -> None:
         flat = flatten_params(jax.device_get(grads_tree))
+        # Worker-side compression (worker.py:264-268): the store/service
+        # advertises its codec; the cast happens here, once, before the wire.
+        if getattr(self.store, "push_codec", "none") == "fp16":
+            from ..ops.compression import fp16_compress
+            flat = fp16_compress(flat)
         if self.store.push(worker_id, flat, fetched_step):
             self.result.pushes_accepted += 1
         else:

@@ -1,0 +1,166 @@
+"""Single-machine baseline trainer (reference: baseline/baseline_training.py).
+
+Same recipe — ResNet-18/CIFAR-100, batch 128, SGD(momentum 0.9, wd 5e-4),
+MultiStepLR([10,15], gamma 0.1), per-epoch train/test metrics and plots
+(baseline_training.py:201-260) — but the epoch body is one jit-compiled
+device program per batch instead of a Python/torch CPU loop; the reference
+needed ~17 min/epoch on an M1 CPU (BASELINE.md), a v5e chip does it in ~3 s.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.cifar import Dataset, make_batches
+from ..models import ResNet18
+from ..utils.metrics import emit_metrics_json
+from .optimizers import baseline_optimizer, server_sgd
+from .steps import make_eval_step, make_train_step
+from .train_state import create_train_state
+
+
+@dataclass
+class BaselineConfig:
+    batch_size: int = 128          # baseline_training.py:203
+    num_epochs: int = 3            # baseline_training.py:204
+    learning_rate: float = 0.1     # baseline_training.py:205
+    momentum: float = 0.9          # baseline_training.py:223
+    weight_decay: float = 5e-4
+    milestones: tuple = (10, 15)   # baseline_training.py:224
+    gamma: float = 0.1
+    augment: bool = True
+    num_classes: int = 100
+    dtype: str = "bfloat16"        # TPU-first default; 'float32' for parity
+    plain_sgd: bool = False        # True = the distributed server optimizer
+    seed: int = 0
+
+
+@dataclass
+class TrainingMetrics:
+    """Per-epoch records (baseline_training.py:97-147 TrainingMetrics)."""
+
+    epochs: list = field(default_factory=list)
+    train_losses: list = field(default_factory=list)
+    train_accuracies: list = field(default_factory=list)
+    test_accuracies: list = field(default_factory=list)
+    epoch_times: list = field(default_factory=list)
+
+    def add_epoch(self, epoch, loss, train_acc, test_acc, seconds):
+        self.epochs.append(epoch)
+        self.train_losses.append(float(loss))
+        self.train_accuracies.append(float(train_acc))
+        self.test_accuracies.append(float(test_acc))
+        self.epoch_times.append(float(seconds))
+
+    def plot_results(self, path: str) -> None:
+        """4-panel summary plot (baseline_training.py:110-147)."""
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        fig, axes = plt.subplots(2, 2, figsize=(12, 8))
+        axes[0, 0].plot(self.epochs, self.train_losses, "o-")
+        axes[0, 0].set_title("Training loss")
+        axes[0, 1].plot(self.epochs, self.train_accuracies, "o-",
+                        label="train")
+        axes[0, 1].plot(self.epochs, self.test_accuracies, "s-", label="test")
+        axes[0, 1].set_title("Accuracy (%)")
+        axes[0, 1].legend()
+        axes[1, 0].bar(self.epochs, self.epoch_times)
+        axes[1, 0].set_title("Epoch time (s)")
+        axes[1, 1].axis("off")
+        summary = (f"final test acc: "
+                   f"{self.test_accuracies[-1]:.2f}%\n"
+                   f"total time: {sum(self.epoch_times):.1f}s"
+                   if self.epochs else "no epochs")
+        axes[1, 1].text(0.1, 0.5, summary, fontsize=12)
+        for ax in axes.flat:
+            ax.set_xlabel("epoch")
+        fig.tight_layout()
+        fig.savefig(path, dpi=120)
+        plt.close(fig)
+
+
+class BaselineTrainer:
+    """The reference's baseline_training.py main loop as a class."""
+
+    def __init__(self, dataset: Dataset, config: BaselineConfig | None = None,
+                 model=None):
+        self.config = cfg = config or BaselineConfig()
+        self.dataset = dataset
+        steps_per_epoch = max(
+            1, len(dataset.x_train) // cfg.batch_size)
+        dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        self.model = model or ResNet18(num_classes=cfg.num_classes,
+                                       dtype=dtype)
+        tx = (server_sgd(cfg.learning_rate) if cfg.plain_sgd
+              else baseline_optimizer(
+                  cfg.learning_rate, cfg.momentum, cfg.weight_decay,
+                  cfg.milestones, cfg.gamma, steps_per_epoch))
+        self.state = create_train_state(
+            self.model, jax.random.PRNGKey(cfg.seed), tx)
+        self._train_step = jax.jit(make_train_step(augment=cfg.augment),
+                                   donate_argnums=0)
+        self._eval_step = jax.jit(make_eval_step())
+        self.metrics = TrainingMetrics()
+
+    def train_epoch(self, epoch: int) -> tuple[float, float]:
+        """One epoch (baseline_training.py:149-179). Returns (loss, acc%)."""
+        cfg = self.config
+        rng = jax.random.PRNGKey(cfg.seed + 1)
+        losses, accs = [], []
+        for xb, yb in make_batches(self.dataset.x_train,
+                                   self.dataset.y_train, cfg.batch_size,
+                                   seed=cfg.seed * 997 + epoch):
+            self.state, m = self._train_step(self.state, xb, yb, rng)
+            losses.append(m["loss"])
+            accs.append(m["accuracy"])
+        losses = [float(x) for x in losses]
+        accs = [float(x) for x in accs]
+        return float(np.mean(losses)), 100.0 * float(np.mean(accs))
+
+    def test_epoch(self) -> float:
+        """Full test-set top-1 in % (baseline_training.py:181-199)."""
+        correct = total = 0
+        for xb, yb in make_batches(self.dataset.x_test, self.dataset.y_test,
+                                   1000, shuffle=False,
+                                   drop_remainder=False):
+            c, t = self._eval_step(self.state, xb, yb)
+            correct += int(c)
+            total += int(t)
+        return 100.0 * correct / max(total, 1)
+
+    def train(self, plot_path: str | None = None,
+              emit_metrics: bool = False) -> TrainingMetrics:
+        cfg = self.config
+        for epoch in range(1, cfg.num_epochs + 1):
+            t0 = time.time()
+            loss, train_acc = self.train_epoch(epoch)
+            test_acc = self.test_epoch()
+            dt = time.time() - t0
+            self.metrics.add_epoch(epoch, loss, train_acc, test_acc, dt)
+            print(f"epoch {epoch}/{cfg.num_epochs}: loss {loss:.4f} "
+                  f"train {train_acc:.2f}% test {test_acc:.2f}% "
+                  f"({dt:.1f}s)")
+        if plot_path:
+            self.metrics.plot_results(plot_path)
+        if emit_metrics:
+            emit_metrics_json({
+                "role": "baseline",
+                "num_epochs": cfg.num_epochs,
+                "batch_size": cfg.batch_size,
+                "learning_rate": cfg.learning_rate,
+                "total_training_time_seconds": round(
+                    sum(self.metrics.epoch_times), 2),
+                "epoch_times_seconds": [round(t, 2)
+                                        for t in self.metrics.epoch_times],
+                "final_test_accuracy": self.metrics.test_accuracies[-1],
+                "all_test_accuracies": self.metrics.test_accuracies,
+                "final_train_loss": self.metrics.train_losses[-1],
+            })
+        return self.metrics

@@ -1,0 +1,177 @@
+"""Distributed trainer drivers: the run recipes of the reference, in-process.
+
+`SyncTrainer` is the TPU-native sync mode: N logical workers = N mesh slots,
+one SPMD step per global batch (parallel/sync_dp.py). It subsumes the
+reference's server+N-worker deployment for sync runs — there is no server.
+
+`AsyncTrainer` wires the host-CPU ParameterStore to N worker threads
+(ps/worker.py), reproducing the async_Nworkers experiment configs
+(EXPERIMENT_GUIDE.md:95-111).
+
+Both emit the METRICS_JSON lines the reference's ETL expects (SURVEY.md §5.5).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+
+from ..data.cifar import Dataset, make_batches
+from ..models import ResNet18
+from ..parallel.mesh import make_mesh
+from ..parallel.sync_dp import make_sync_dp_step, shard_batch
+from ..ps.store import ParameterStore, StoreConfig
+from ..ps.worker import WorkerConfig, run_workers
+from ..utils.metrics import emit_metrics_json
+from ..utils.pytree import flatten_params
+from .optimizers import server_sgd
+from .steps import make_eval_step
+from .train_state import create_train_state
+
+
+@dataclass
+class DistributedConfig:
+    mode: str = "sync"             # SERVER_MODE (server.py:407-417)
+    num_workers: int = 4           # TOTAL_WORKERS_EXPECTED
+    learning_rate: float = 0.1     # server lr (server.py:413)
+    num_epochs: int = 3            # worker.py:466 default
+    batch_size: int = 128          # per worker (worker.py:462)
+    sync_steps: int = 1            # K (worker.py:468)
+    k_step_mode: str = "faithful"
+    staleness_bound: int = 5       # server.py:418
+    compression: str = "bf16"      # sync all-reduce dtype
+    strict_rounds: bool = False
+    augment: bool = True
+    num_classes: int = 100
+    dtype: str = "bfloat16"
+    seed: int = 0
+
+
+class SyncTrainer:
+    """Sync data-parallel training over a device mesh (no server process)."""
+
+    def __init__(self, dataset: Dataset, config: DistributedConfig | None = None):
+        self.config = cfg = config or DistributedConfig()
+        self.dataset = dataset
+        self.mesh = make_mesh(cfg.num_workers)
+        import jax.numpy as jnp
+        dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        self.model = ResNet18(num_classes=cfg.num_classes, dtype=dtype,
+                              axis_name="data")
+        self.state = create_train_state(
+            self.model, jax.random.PRNGKey(cfg.seed),
+            server_sgd(cfg.learning_rate))
+        self._step = make_sync_dp_step(self.mesh,
+                                       compression=cfg.compression,
+                                       augment=cfg.augment)
+        self._eval_step = jax.jit(make_eval_step())
+        self.epoch_times: list[float] = []
+        self.test_accuracies: list[float] = []
+        self.global_steps = 0
+
+    def train(self, emit_metrics: bool = False) -> dict:
+        cfg = self.config
+        global_batch = cfg.batch_size * cfg.num_workers
+        rng = jax.random.PRNGKey(cfg.seed + 1)
+        t_start = time.time()
+        for epoch in range(cfg.num_epochs):
+            t0 = time.time()
+            losses = []
+            for xb, yb in make_batches(self.dataset.x_train,
+                                       self.dataset.y_train, global_batch,
+                                       seed=cfg.seed * 997 + epoch):
+                bi, bl = shard_batch(self.mesh, (xb, yb))
+                self.state, m = self._step(self.state, bi, bl, rng)
+                losses.append(m["loss"])
+                self.global_steps += 1
+            acc = self.evaluate()
+            self.epoch_times.append(time.time() - t0)
+            self.test_accuracies.append(acc)
+            print(f"[sync x{cfg.num_workers}] epoch {epoch + 1}: "
+                  f"loss {float(np.mean([float(l) for l in losses])):.4f} "
+                  f"test {acc:.2%} ({self.epoch_times[-1]:.1f}s)")
+        total = time.time() - t_start
+
+        server_metrics = {
+            "mode": "sync",
+            "total_workers": cfg.num_workers,
+            "total_training_time_seconds": round(total, 2),
+            "global_steps_completed": self.global_steps,
+            "total_parameter_updates": self.global_steps,
+            "gradients_processed": self.global_steps * cfg.num_workers,
+            "average_update_time_seconds": round(
+                total / max(self.global_steps, 1), 6),
+            "updates_per_second": round(self.global_steps / total, 3),
+            "learning_rate": cfg.learning_rate,
+        }
+        if emit_metrics:
+            emit_metrics_json(server_metrics)
+            for wid in range(cfg.num_workers):
+                emit_metrics_json({
+                    "worker_id": wid,
+                    "total_workers": cfg.num_workers,
+                    "total_training_time_seconds": round(total, 2),
+                    "average_epoch_time_seconds": round(
+                        float(np.mean(self.epoch_times)), 2),
+                    "epoch_times_seconds": [round(t, 2)
+                                            for t in self.epoch_times],
+                    "final_test_accuracy": self.test_accuracies[-1],
+                    "all_test_accuracies": self.test_accuracies,
+                    "local_steps_completed": self.global_steps,
+                    "batch_size": cfg.batch_size,
+                    "learning_rate": cfg.learning_rate,
+                    "num_epochs": cfg.num_epochs,
+                })
+        return server_metrics
+
+    def evaluate(self) -> float:
+        correct = total = 0
+        for xb, yb in make_batches(self.dataset.x_test, self.dataset.y_test,
+                                   1000, shuffle=False,
+                                   drop_remainder=False):
+            c, t = self._eval_step(self.state, xb, yb)
+            correct += int(c)
+            total += int(t)
+        return correct / max(total, 1)
+
+
+class AsyncTrainer:
+    """Async bounded-staleness training: host-CPU store + N worker threads."""
+
+    def __init__(self, dataset: Dataset, config: DistributedConfig | None = None):
+        self.config = cfg = config or DistributedConfig()
+        self.dataset = dataset
+        import jax.numpy as jnp
+        dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        self.model = ResNet18(num_classes=cfg.num_classes, dtype=dtype)
+        variables = self.model.init(
+            jax.random.PRNGKey(cfg.seed),
+            np.zeros((1, 32, 32, 3), np.float32), train=False)
+        self.store = ParameterStore(
+            flatten_params(variables["params"]),
+            StoreConfig(mode=cfg.mode, total_workers=cfg.num_workers,
+                        learning_rate=cfg.learning_rate,
+                        staleness_bound=cfg.staleness_bound,
+                        strict_rounds=cfg.strict_rounds))
+
+    def train(self, emit_metrics: bool = False) -> dict:
+        cfg = self.config
+        results = run_workers(
+            self.store, self.model, self.dataset, cfg.num_workers,
+            WorkerConfig(batch_size=cfg.batch_size,
+                         num_epochs=cfg.num_epochs,
+                         sync_steps=cfg.sync_steps,
+                         k_step_mode=cfg.k_step_mode,
+                         augment=cfg.augment, seed=cfg.seed))
+        server_metrics = self.store.metrics()
+        if emit_metrics:
+            emit_metrics_json(server_metrics)
+            wc = WorkerConfig(batch_size=cfg.batch_size,
+                              num_epochs=cfg.num_epochs)
+            for r in results:
+                emit_metrics_json(r.metrics(cfg.num_workers,
+                                            cfg.learning_rate, wc))
+        return server_metrics
