@@ -1,0 +1,7 @@
+"""Experiment/analysis layer (reference L5: scripts/)."""
+
+from .parse_logs import aggregate_worker_metrics, parse_experiment
+from .visualize import ExperimentVisualizer
+
+__all__ = ["aggregate_worker_metrics", "parse_experiment",
+           "ExperimentVisualizer"]

@@ -1,0 +1,97 @@
+"""Log -> experiment-JSON ETL (reference: scripts/parse_cloudwatch_logs.py).
+
+The reference shells out to ``aws logs filter-log-events`` and regex-extracts
+``METRICS_JSON:`` lines (parse_cloudwatch_logs.py:61-121). Here logs are
+local files or strings (there is no CloudWatch in the loop), but the
+aggregation semantics are reproduced exactly
+(parse_cloudwatch_logs.py:125-177):
+
+- server metrics pass through,
+- worker totals: MAX total time across workers (the slowest worker defines
+  the run), MEAN epoch time, MEAN final accuracy,
+- per-epoch: max/avg/min across workers,
+- raw per-worker records preserved under ``raw_worker_metrics``.
+
+Output schema matches ``experiment_results/*.json`` (e.g.
+sync_4workers.json) so the visualizer — ours or the reference's — can read
+either's files.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Iterable
+
+import numpy as np
+
+from ..utils.metrics import parse_metrics_lines
+
+
+def _is_worker(m: dict) -> bool:
+    return "worker_id" in m
+
+
+def aggregate_worker_metrics(workers: list[dict]) -> dict:
+    """parse_cloudwatch_logs.py:125-177 semantics."""
+    if not workers:
+        return {}
+    total_times = [w.get("total_training_time_seconds", 0.0) for w in workers]
+    epoch_means = [w.get("average_epoch_time_seconds", 0.0) for w in workers]
+    final_accs = [w.get("final_test_accuracy", 0.0) for w in workers]
+
+    n_epochs = max((len(w.get("epoch_times_seconds", [])) for w in workers),
+                   default=0)
+    per_epoch = []
+    for e in range(n_epochs):
+        times = [w["epoch_times_seconds"][e] for w in workers
+                 if len(w.get("epoch_times_seconds", [])) > e]
+        accs = [w["all_test_accuracies"][e] for w in workers
+                if len(w.get("all_test_accuracies", [])) > e]
+        per_epoch.append({
+            "epoch": e + 1,
+            "max_time": float(np.max(times)) if times else 0.0,
+            "avg_time": float(np.mean(times)) if times else 0.0,
+            "min_time": float(np.min(times)) if times else 0.0,
+            "max_accuracy": float(np.max(accs)) if accs else 0.0,
+            "avg_accuracy": float(np.mean(accs)) if accs else 0.0,
+            "min_accuracy": float(np.min(accs)) if accs else 0.0,
+        })
+
+    return {
+        "num_workers": len(workers),
+        # the slowest worker defines the run's wall clock
+        "total_training_time_seconds": float(np.max(total_times)),
+        "average_epoch_time_seconds": float(np.mean(epoch_means)),
+        "average_final_accuracy": float(np.mean(final_accs)),
+        "per_epoch": per_epoch,
+    }
+
+
+def parse_experiment(logs: str | Iterable[str],
+                     experiment_name: str = "experiment") -> dict:
+    """Full log text (possibly many processes' stdout) -> experiment record."""
+    metrics = parse_metrics_lines(logs)
+    server = next((m for m in metrics
+                   if not _is_worker(m) and "mode" in m), None)
+    workers = [m for m in metrics if _is_worker(m)]
+    return {
+        "experiment_name": experiment_name,
+        "server_metrics": server or {},
+        "worker_metrics_aggregated": aggregate_worker_metrics(workers),
+        "raw_worker_metrics": workers,
+    }
+
+
+def parse_log_files(paths: list[str], experiment_name: str,
+                    out_path: str | None = None) -> dict:
+    texts = []
+    for p in paths:
+        with open(p) as f:
+            texts.append(f.read())
+    record = parse_experiment("\n".join(texts), experiment_name)
+    if out_path:
+        os.makedirs(os.path.dirname(out_path) or ".", exist_ok=True)
+        with open(out_path, "w") as f:
+            json.dump(record, f, indent=2)
+    return record

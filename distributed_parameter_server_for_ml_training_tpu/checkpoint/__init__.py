@@ -1,0 +1,17 @@
+"""Checkpoint/resume — a first-class gap-fill (SURVEY.md §5.4).
+
+The reference holds parameters only in server RAM (server.py:96) and lists
+"checkpointing to S3" as future work (DEPLOYMENT.md:309). Here both canonical
+state holders checkpoint natively:
+
+- device train states (params + optimizer state + BN stats + step) via Orbax,
+- the async ParameterStore via a simple npz + JSON snapshot.
+"""
+
+from .manager import (
+    CheckpointManager,
+    restore_store,
+    save_store,
+)
+
+__all__ = ["CheckpointManager", "save_store", "restore_store"]

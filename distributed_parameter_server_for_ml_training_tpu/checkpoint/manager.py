@@ -1,0 +1,149 @@
+"""Checkpoint managers: Orbax for train states, npz for the host store."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+
+import jax
+import numpy as np
+
+from ..ps.store import ParameterStore
+
+
+class CheckpointManager:
+    """Orbax-backed checkpointing of :class:`~..train.train_state.TrainState`.
+
+    Saves params / opt_state / batch_stats / step; keeps the newest
+    ``max_to_keep`` checkpoints. Restore returns a state built on the caller's
+    template (so apply_fn/tx survive).
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self._mgr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(max_to_keep=max_to_keep),
+        )
+
+    def save(self, state, step: int | None = None, wait: bool = True) -> int:
+        import orbax.checkpoint as ocp
+
+        step = int(state.step) if step is None else int(step)
+        payload = {
+            "params": jax.device_get(state.params),
+            "opt_state": jax.device_get(state.opt_state),
+            "batch_stats": jax.device_get(state.batch_stats),
+            "step": step,
+        }
+        self._mgr.save(step, args=ocp.args.StandardSave(payload))
+        if wait:
+            self._mgr.wait_until_finished()
+        return step
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, template_state, step: int | None = None):
+        """Restore into a state template (returns a new TrainState)."""
+        import orbax.checkpoint as ocp
+
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        target = {
+            "params": jax.device_get(template_state.params),
+            "opt_state": jax.device_get(template_state.opt_state),
+            "batch_stats": jax.device_get(template_state.batch_stats),
+            "step": 0,
+        }
+        restored = self._mgr.restore(
+            step, args=ocp.args.StandardRestore(target))
+        return template_state.replace(
+            params=restored["params"],
+            opt_state=restored["opt_state"],
+            batch_stats=restored["batch_stats"],
+            step=restored["step"],
+        )
+
+    def close(self):
+        self._mgr.close()
+
+
+# -- async store snapshots ----------------------------------------------------
+
+def save_store(store: ParameterStore, directory: str) -> str:
+    """Atomic snapshot of the ParameterStore: params npz + metadata JSON.
+
+    Enables the <30 s recovery the reference targeted but never built
+    (baseline_summary.json distributed_system_targets; SURVEY.md §4).
+    """
+    os.makedirs(directory, exist_ok=True)
+    step = store.global_step
+    with store._param_lock:  # consistent (params, step) pair
+        arrays = {k: v.copy() for k, v in store.parameters.items()}
+        step = store.global_step
+    tmp = os.path.join(directory, ".tmp.npz")
+    np.savez(tmp, **arrays)
+    final = os.path.join(directory, f"store_{step:08d}.npz")
+    os.replace(tmp, final)
+    meta = {
+        "global_step": step,
+        "mode": store.config.mode,
+        "total_workers": store.config.total_workers,
+        "learning_rate": store.config.learning_rate,
+        "staleness_bound": store.config.staleness_bound,
+    }
+    with open(os.path.join(directory, f"store_{step:08d}.json"), "w") as f:
+        json.dump(meta, f)
+    return final
+
+
+def restore_store(store: ParameterStore, directory: str,
+                  step: int | None = None) -> int:
+    """Load the newest (or given-step) snapshot into the store. Returns the
+    restored global step."""
+    snaps = sorted(f for f in os.listdir(directory)
+                   if f.startswith("store_") and f.endswith(".npz"))
+    if not snaps:
+        raise FileNotFoundError(f"no store snapshots in {directory}")
+    if step is not None:
+        name = f"store_{step:08d}.npz"
+        if name not in snaps:
+            raise FileNotFoundError(name)
+    else:
+        name = snaps[-1]
+    data = np.load(os.path.join(directory, name))
+    with open(os.path.join(directory,
+                           name.replace(".npz", ".json"))) as f:
+        meta = json.load(f)
+    with store._param_lock:
+        store.parameters = {k: np.array(data[k], np.float32) for k in
+                            data.files}
+        store.global_step = int(meta["global_step"])
+    return store.global_step
+
+
+class PeriodicStoreCheckpointer(threading.Thread):
+    """Background thread snapshotting the store every ``interval`` seconds."""
+
+    def __init__(self, store: ParameterStore, directory: str,
+                 interval: float = 30.0):
+        super().__init__(daemon=True)
+        self.store = store
+        self.directory = directory
+        self.interval = interval
+        self._stop = threading.Event()
+
+    def run(self):
+        while not self._stop.wait(self.interval):
+            save_store(self.store, self.directory)
+
+    def stop(self, final_snapshot: bool = True):
+        self._stop.set()
+        if final_snapshot:
+            save_store(self.store, self.directory)

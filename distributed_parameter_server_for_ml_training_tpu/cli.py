@@ -86,6 +86,11 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--strict-rounds", action="store_true",
                    help="corrected sync-round semantics (vs quirk 3)")
     t.add_argument("--plot", default=None, help="save a results plot (png)")
+    t.add_argument("--checkpoint-dir", default=None,
+                   help="save checkpoints each epoch (gap-fill, SURVEY §5.4)")
+    t.add_argument("--resume", action="store_true",
+                   help="resume from the newest checkpoint in "
+                        "--checkpoint-dir")
     add_common(t)
 
     s = sub.add_parser("serve", help="gRPC parameter server (multi-host)")
@@ -149,7 +154,9 @@ def cmd_train(args) -> int:
                              dtype=args.dtype, seed=args.seed)
         trainer = BaselineTrainer(dataset, cfg)
         trainer.train(plot_path=args.plot,
-                      emit_metrics=args.emit_metrics)
+                      emit_metrics=args.emit_metrics,
+                      checkpoint_dir=args.checkpoint_dir,
+                      resume=args.resume)
         return 0
 
     from .train.distributed import (AsyncTrainer, DistributedConfig,

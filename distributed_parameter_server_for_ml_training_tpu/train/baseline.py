@@ -136,9 +136,23 @@ class BaselineTrainer:
         return 100.0 * correct / max(total, 1)
 
     def train(self, plot_path: str | None = None,
-              emit_metrics: bool = False) -> TrainingMetrics:
+              emit_metrics: bool = False,
+              checkpoint_dir: str | None = None,
+              resume: bool = False) -> TrainingMetrics:
         cfg = self.config
-        for epoch in range(1, cfg.num_epochs + 1):
+        mgr = None
+        start_epoch = 1
+        if checkpoint_dir:
+            from ..checkpoint import CheckpointManager
+            mgr = CheckpointManager(checkpoint_dir)
+            if resume and mgr.latest_step() is not None:
+                self.state = mgr.restore(self.state)
+                steps_per_epoch = max(
+                    1, len(self.dataset.x_train) // cfg.batch_size)
+                start_epoch = int(self.state.step) // steps_per_epoch + 1
+                print(f"resumed from step {int(self.state.step)} "
+                      f"(epoch {start_epoch})")
+        for epoch in range(start_epoch, cfg.num_epochs + 1):
             t0 = time.time()
             loss, train_acc = self.train_epoch(epoch)
             test_acc = self.test_epoch()
@@ -147,6 +161,10 @@ class BaselineTrainer:
             print(f"epoch {epoch}/{cfg.num_epochs}: loss {loss:.4f} "
                   f"train {train_acc:.2f}% test {test_acc:.2f}% "
                   f"({dt:.1f}s)")
+            if mgr is not None:
+                mgr.save(self.state)
+        if mgr is not None:
+            mgr.close()
         if plot_path:
             self.metrics.plot_results(plot_path)
         if emit_metrics:

@@ -1,0 +1,64 @@
+"""Tracing/profiling — a first-class gap-fill (SURVEY.md §5.1).
+
+The reference's only instrumentation is ``time.time()`` deltas kept in a
+``deque(maxlen=100)`` (server.py:121, 140-141). Here:
+
+- :class:`StepTimer` reproduces that rolling-window timing (for parity in
+  the store/trainers),
+- :func:`trace` exposes real XLA-level profiling via ``jax.profiler`` —
+  the produced trace directory opens in TensorBoard/Perfetto and shows MXU
+  utilization, HBM traffic, and collective time per step,
+- :func:`annotate` tags host-side regions so they appear on the trace.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import deque
+
+import jax
+
+
+class StepTimer:
+    """Rolling-window step timing (server.py:121 deque(maxlen=100))."""
+
+    def __init__(self, window: int = 100):
+        self.times = deque(maxlen=window)
+        self._t0 = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.times.append(time.perf_counter() - self._t0)
+
+    @property
+    def mean(self) -> float:
+        return sum(self.times) / len(self.times) if self.times else 0.0
+
+    @property
+    def last(self) -> float:
+        return self.times[-1] if self.times else 0.0
+
+
+@contextlib.contextmanager
+def trace(logdir: str):
+    """Capture a jax.profiler trace around a code region::
+
+        with trace('/tmp/trace'):
+            for _ in range(10):
+                state, m = step(state, batch, key)
+            jax.block_until_ready(state)
+    """
+    jax.profiler.start_trace(logdir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def annotate(name: str):
+    """Named region that shows up on the profiler timeline."""
+    return jax.profiler.TraceAnnotation(name)

@@ -1,0 +1,106 @@
+"""Analysis-layer tests (reference L5: scripts/)."""
+
+import json
+import os
+
+from distributed_parameter_server_for_ml_training_tpu.analysis import (
+    ExperimentVisualizer, aggregate_worker_metrics, parse_experiment)
+
+
+def worker_line(wid, total_time, epoch_times, accs):
+    return ("METRICS_JSON: " + json.dumps({
+        "worker_id": wid, "total_workers": 2,
+        "total_training_time_seconds": total_time,
+        "average_epoch_time_seconds": total_time / len(epoch_times),
+        "epoch_times_seconds": epoch_times,
+        "final_test_accuracy": accs[-1],
+        "all_test_accuracies": accs,
+        "local_steps_completed": 10, "batch_size": 128,
+        "learning_rate": 0.1, "num_epochs": len(epoch_times)}))
+
+
+SERVER_LINE = ("METRICS_JSON: " + json.dumps({
+    "mode": "sync", "total_workers": 2,
+    "total_training_time_seconds": 100.0,
+    "global_steps_completed": 20, "total_parameter_updates": 20,
+    "gradients_processed": 40, "average_update_time_seconds": 0.01,
+    "updates_per_second": 0.2, "learning_rate": 0.1}))
+
+
+def test_parse_experiment_full_pipeline():
+    log = "\n".join([
+        "noise line", SERVER_LINE,
+        worker_line(0, 90.0, [45.0, 45.0], [0.10, 0.20]),
+        "more noise",
+        worker_line(1, 100.0, [50.0, 50.0], [0.12, 0.24]),
+    ])
+    rec = parse_experiment(log, "sync_2workers")
+    assert rec["server_metrics"]["mode"] == "sync"
+    agg = rec["worker_metrics_aggregated"]
+    # slowest worker defines the run (parse_cloudwatch_logs.py:125-177)
+    assert agg["total_training_time_seconds"] == 100.0
+    assert agg["num_workers"] == 2
+    assert abs(agg["average_final_accuracy"] - 0.22) < 1e-9
+    assert agg["per_epoch"][0]["max_time"] == 50.0
+    assert agg["per_epoch"][0]["min_time"] == 45.0
+    assert abs(agg["per_epoch"][1]["avg_accuracy"] - 0.22) < 1e-9
+    assert len(rec["raw_worker_metrics"]) == 2
+
+
+def test_aggregate_empty():
+    assert aggregate_worker_metrics([]) == {}
+
+
+def test_visualizer_end_to_end(tmp_path):
+    # two experiments -> comparison + scaling plots + summary table
+    for name, mode, workers, t, acc in [
+            ("sync_2workers", "sync", 2, 100.0, 0.22),
+            ("async_2workers", "async", 2, 80.0, 0.20),
+            ("sync_4workers", "sync", 4, 60.0, 0.21)]:
+        log = "\n".join([
+            "METRICS_JSON: " + json.dumps({
+                "mode": mode, "total_workers": workers,
+                "total_training_time_seconds": t,
+                "global_steps_completed": 10,
+                "total_parameter_updates": 10, "gradients_processed": 10,
+                "average_update_time_seconds": 0.1,
+                "updates_per_second": 1.0, "learning_rate": 0.1}),
+            worker_line(0, t, [t / 2, t / 2], [acc / 2, acc]),
+        ])
+        rec = parse_experiment(log, name)
+        with open(tmp_path / f"{name}.json", "w") as f:
+            json.dump(rec, f)
+
+    viz = ExperimentVisualizer(str(tmp_path))
+    assert len(viz.experiments) == 3
+    viz.plot_sync_vs_async(str(tmp_path / "comparison.png"))
+    viz.plot_scaling_analysis(str(tmp_path / "scaling.png"))
+    assert os.path.getsize(tmp_path / "comparison.png") > 1000
+    assert os.path.getsize(tmp_path / "scaling.png") > 1000
+    table = viz.summary_table()
+    assert "sync_4workers" in table and "async_2workers" in table
+
+
+def test_reads_reference_schema(tmp_path):
+    """Backwards-compat: the reference's recorded experiment JSON shape
+    (experiment_results/sync_4workers.json) loads fine."""
+    rec = {
+        "experiment_name": "ref_style",
+        "server_metrics": {"mode": "sync", "total_workers": 4,
+                           "total_training_time_seconds": 2128.9},
+        "worker_metrics_aggregated": {
+            "num_workers": 4,
+            "total_training_time_seconds": 2128.9,
+            "average_epoch_time_seconds": 700.0,
+            "average_final_accuracy": 0.035,
+            "per_epoch": [{"epoch": 1, "max_time": 700, "avg_time": 690,
+                           "min_time": 680, "max_accuracy": 0.03,
+                           "avg_accuracy": 0.028, "min_accuracy": 0.02}],
+        },
+        "raw_worker_metrics": [],
+    }
+    with open(tmp_path / "ref.json", "w") as f:
+        json.dump(rec, f)
+    viz = ExperimentVisualizer(str(tmp_path))
+    viz.plot_scaling_analysis(str(tmp_path / "s.png"))
+    assert "ref_style" in viz.summary_table()

@@ -1,0 +1,70 @@
+"""Checkpoint/resume tests (gap-fill, SURVEY.md §5.4)."""
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_parameter_server_for_ml_training_tpu.checkpoint import (
+    CheckpointManager, restore_store, save_store)
+from distributed_parameter_server_for_ml_training_tpu.ps import (
+    ParameterStore, StoreConfig)
+from distributed_parameter_server_for_ml_training_tpu.train import (
+    create_train_state, make_train_step, server_sgd)
+
+
+def test_train_state_roundtrip(tmp_path, tiny_model, small_batch):
+    model = tiny_model()
+    state = create_train_state(model, jax.random.PRNGKey(0), server_sgd(0.1))
+    step = jax.jit(make_train_step(augment=False))
+    images, labels = small_batch
+    for _ in range(3):
+        state, _ = step(state, images, labels, jax.random.PRNGKey(1))
+
+    mgr = CheckpointManager(str(tmp_path / "ckpt"))
+    saved_step = mgr.save(state)
+    assert saved_step == 3
+
+    template = create_train_state(model, jax.random.PRNGKey(7),
+                                  server_sgd(0.1))
+    restored = mgr.restore(template)
+    assert int(restored.step) == 3
+    for a, b in zip(jax.tree_util.tree_leaves(state.params),
+                    jax.tree_util.tree_leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # training continues from the restored state
+    state2, _ = step(restored, images, labels, jax.random.PRNGKey(1))
+    assert int(state2.step) == 4
+    mgr.close()
+
+
+def test_max_to_keep(tmp_path, tiny_model):
+    model = tiny_model()
+    state = create_train_state(model, jax.random.PRNGKey(0), server_sgd(0.1))
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2)
+    for s in [1, 2, 3]:
+        mgr.save(state, step=s)
+    assert mgr.latest_step() == 3
+    mgr.close()
+
+
+def test_store_snapshot_roundtrip(tmp_path):
+    store = ParameterStore({"w": np.ones(4, np.float32)},
+                           StoreConfig(mode="async", total_workers=2,
+                                       push_codec="none"))
+    store.push(0, {"w": np.full(4, 0.5, np.float32)}, 0)
+    save_store(store, str(tmp_path))
+
+    other = ParameterStore({"w": np.zeros(4, np.float32)},
+                           StoreConfig(mode="async", total_workers=2))
+    restored_step = restore_store(other, str(tmp_path))
+    assert restored_step == 1
+    np.testing.assert_allclose(other.parameters["w"], 1.0 - 0.1 * 0.5)
+    # resumed store keeps accepting pushes with correct staleness math
+    assert other.push(0, {"w": np.zeros(4, np.float16)}, 1) is True
+
+
+def test_restore_missing_raises(tmp_path):
+    store = ParameterStore({"w": np.ones(2, np.float32)})
+    with pytest.raises(FileNotFoundError):
+        restore_store(store, str(tmp_path))
