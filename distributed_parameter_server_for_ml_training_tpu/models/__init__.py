@@ -1,5 +1,7 @@
-"""Model zoo: CIFAR-style ResNets (flax.linen)."""
+"""Model zoo: CIFAR-style ResNets and ViT (flax.linen)."""
 
 from .resnet import ResNet, ResNet18, ResNet50, count_params
+from .vit import ViT, ViT_B16, ViT_Tiny
 
-__all__ = ["ResNet", "ResNet18", "ResNet50", "count_params"]
+__all__ = ["ResNet", "ResNet18", "ResNet50", "count_params",
+           "ViT", "ViT_B16", "ViT_Tiny"]

@@ -1,0 +1,153 @@
+"""Vision Transformer (ViT) in flax.linen — the non-conv MXU path.
+
+Covers the driver-added ViT-B/16 / CIFAR-100 config (BASELINE.json
+configs[4]). The reference has no transformer at all (its model layer is the
+copy-pasted ResNet-18, SURVEY.md §2.6), so this file is net-new capability,
+designed TPU-first:
+
+- all compute lands on the MXU as large batched matmuls (patch embed as a
+  strided conv, fused qkv projection, einsum attention),
+- compute dtype configurable (bfloat16 default path), params fp32,
+- kernels are laid out so Megatron-style tensor parallelism is a pure
+  sharding decision (parallel/tensor.py): qkv & mlp-in split column-wise on
+  the 'model' axis, out & mlp-out row-wise — XLA inserts the all-reduces,
+- attention can run ring-parallel over a sequence axis (parallel/
+  ring_attention.py) for long-context training; at CIFAR resolution the
+  sequence is tiny (2x2 patches + cls = 5 tokens) and runs dense.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Dtype = Any
+
+
+class MlpBlock(nn.Module):
+    mlp_dim: int
+    out_dim: int
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Dense(self.mlp_dim, dtype=self.dtype, param_dtype=jnp.float32,
+                     name="fc1")(x)
+        x = nn.gelu(x)
+        x = nn.Dense(self.out_dim, dtype=self.dtype, param_dtype=jnp.float32,
+                     name="fc2")(x)
+        return x
+
+
+class SelfAttention(nn.Module):
+    """Multi-head self-attention with a fused qkv projection.
+
+    einsum formulation keeps everything MXU-shaped; the qkv/out kernels are
+    the TP split points (see parallel/tensor.py rules).
+    """
+
+    num_heads: int
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, d = x.shape
+        assert d % self.num_heads == 0, (d, self.num_heads)
+        head_dim = d // self.num_heads
+
+        qkv = nn.Dense(3 * d, dtype=self.dtype, param_dtype=jnp.float32,
+                       name="qkv")(x)
+        qkv = qkv.reshape(b, t, 3, self.num_heads, head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+        scale = 1.0 / np.sqrt(head_dim)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        probs = probs.astype(self.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, t, d)
+        return nn.Dense(d, dtype=self.dtype, param_dtype=jnp.float32,
+                        name="out")(out)
+
+
+class EncoderBlock(nn.Module):
+    num_heads: int
+    mlp_ratio: int = 4
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                         name="ln1")(x)
+        x = x + SelfAttention(self.num_heads, dtype=self.dtype,
+                              name="attn")(y)
+        y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                         name="ln2")(x)
+        x = x + MlpBlock(self.mlp_ratio * d, d, dtype=self.dtype,
+                         name="mlp")(y)
+        return x
+
+
+class ViT(nn.Module):
+    """ViT with a CLS token and learned position embeddings."""
+
+    patch_size: int = 16
+    hidden_dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    mlp_ratio: int = 4
+    num_classes: int = 100
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        b, h, w, c = x.shape
+        assert h % self.patch_size == 0 and w % self.patch_size == 0, (
+            f"image {h}x{w} not divisible by patch {self.patch_size}")
+        x = x.astype(self.dtype)
+        # Patch embedding: conv with stride == kernel == patch size, i.e. one
+        # matmul per patch on the MXU.
+        x = nn.Conv(self.hidden_dim,
+                    (self.patch_size, self.patch_size),
+                    strides=(self.patch_size, self.patch_size),
+                    padding="VALID", dtype=self.dtype,
+                    param_dtype=jnp.float32, name="patch_embed")(x)
+        x = x.reshape(b, -1, self.hidden_dim)
+        n_tokens = x.shape[1] + 1
+
+        cls = self.param("cls_token", nn.initializers.zeros,
+                         (1, 1, self.hidden_dim), jnp.float32)
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (b, 1, self.hidden_dim)).astype(self.dtype),
+             x], axis=1)
+        pos = self.param("pos_embed",
+                         nn.initializers.normal(stddev=0.02),
+                         (1, n_tokens, self.hidden_dim), jnp.float32)
+        x = x + pos.astype(self.dtype)
+
+        for i in range(self.depth):
+            x = EncoderBlock(self.num_heads, self.mlp_ratio,
+                             dtype=self.dtype, name=f"block_{i}")(x)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                         name="ln_final")(x)
+        x = x[:, 0]  # CLS token
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+def ViT_B16(num_classes: int = 100, dtype: Dtype = jnp.float32) -> ViT:
+    """ViT-B/16: 12 layers, 768 hidden, 12 heads (~85.7M params)."""
+    return ViT(patch_size=16, hidden_dim=768, depth=12, num_heads=12,
+               num_classes=num_classes, dtype=dtype)
+
+
+def ViT_Tiny(num_classes: int = 100, dtype: Dtype = jnp.float32,
+             patch_size: int = 4) -> ViT:
+    """Small ViT for tests and CIFAR-resolution runs (32/4 -> 64 tokens)."""
+    return ViT(patch_size=patch_size, hidden_dim=192, depth=4, num_heads=3,
+               num_classes=num_classes, dtype=dtype)

@@ -1,6 +1,21 @@
-"""Parallelism: device meshes and sync SPMD data parallelism."""
+"""Parallelism: device meshes, sync SPMD data parallelism, tensor
+parallelism (GSPMD sharding rules), ring-attention sequence parallelism."""
 
 from .mesh import make_mesh, worker_axis_size
+from .ring_attention import (dense_attention, make_ring_attention,
+                             ring_attention_local)
 from .sync_dp import make_sync_dp_step, shard_batch
+from .tensor import param_shardings, shard_train_state, tp_spec_for_path
 
-__all__ = ["make_mesh", "worker_axis_size", "make_sync_dp_step", "shard_batch"]
+__all__ = [
+    "make_mesh",
+    "worker_axis_size",
+    "make_sync_dp_step",
+    "shard_batch",
+    "make_ring_attention",
+    "ring_attention_local",
+    "dense_attention",
+    "param_shardings",
+    "shard_train_state",
+    "tp_spec_for_path",
+]

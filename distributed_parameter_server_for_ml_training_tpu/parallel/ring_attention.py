@@ -1,0 +1,101 @@
+"""Ring attention: sequence/context parallelism over a mesh axis.
+
+Net-new capability (the reference has no sequence parallelism of any kind —
+SURVEY.md §5.7). Long sequences are sharded along a ``seq`` mesh axis; each
+device holds a [B, T/N, H, D] slice of q/k/v. K/V blocks rotate around the
+ring via ``lax.ppermute`` (one ICI hop per step, overlapping compute with the
+neighbor transfer) while each device accumulates attention for its resident
+queries with the online-softmax (flash-attention) merge, so the full [T, T]
+score matrix never materializes anywhere.
+
+Equivalent math to dense softmax attention (tests assert allclose); memory
+per device is O(T/N) instead of O(T^2).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+_NEG_INF = -1e30
+
+
+def _merge(m, l, o, logits, v_blk):
+    """Online-softmax merge of one K/V block into the running (m, l, o)."""
+    m_blk = jnp.max(logits, axis=-1)                      # [B,H,Tq]
+    m_new = jnp.maximum(m, m_blk)
+    p = jnp.exp(logits - m_new[..., None])                # [B,H,Tq,Tk]
+    alpha = jnp.exp(m - m_new)                            # [B,H,Tq]
+    l_new = l * alpha + jnp.sum(p, axis=-1)
+    o_new = o * alpha[..., None] + jnp.einsum(
+        "bhqk,bkhd->bhqd", p, v_blk)
+    return m_new, l_new, o_new
+
+
+def ring_attention_local(q, k, v, *, axis_name: str, axis_size: int,
+                         causal: bool = False):
+    """Per-shard body; call inside ``shard_map`` over ``axis_name``.
+
+    q/k/v: [B, T_local, H, D] (this shard's slice). Returns [B, T_local, H, D].
+    """
+    b, t_local, h, d = q.shape
+    scale = 1.0 / np.sqrt(d)
+    my = jax.lax.axis_index(axis_name)
+
+    qf = q.astype(jnp.float32)
+    m = jnp.full((b, h, t_local), _NEG_INF, jnp.float32)
+    l = jnp.zeros((b, h, t_local), jnp.float32)
+    o = jnp.zeros((b, h, t_local, d), jnp.float32)
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    kk, vv = k.astype(jnp.float32), v.astype(jnp.float32)
+
+    for step in range(axis_size):
+        # After `step` rotations we hold the block that started on shard
+        # (my - step) mod N.
+        src = (my - step) % axis_size
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kk) * scale
+        if causal:
+            q_pos = my * t_local + jnp.arange(t_local)        # global rows
+            k_pos = src * t_local + jnp.arange(t_local)       # global cols
+            mask = q_pos[:, None] >= k_pos[None, :]           # [Tq,Tk]
+            logits = jnp.where(mask[None, None], logits, _NEG_INF)
+        m, l, o = _merge(m, l, o, logits, vv)
+        if step != axis_size - 1:
+            kk = jax.lax.ppermute(kk, axis_name, perm)
+            vv = jax.lax.ppermute(vv, axis_name, perm)
+
+    out = o / jnp.maximum(l, 1e-30)[..., None]                # [B,H,Tq,D]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)   # [B,Tq,H,D]
+
+
+def make_ring_attention(mesh: Mesh, axis: str = "data",
+                        causal: bool = False) -> Callable:
+    """Jitted ``fn(q, k, v) -> out`` over sequence-sharded [B, T, H, D]."""
+    axis_size = mesh.shape[axis]
+    body = partial(ring_attention_local, axis_name=axis,
+                   axis_size=axis_size, causal=causal)
+    spec = P(None, axis)  # shard the T dimension
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return jax.jit(fn)
+
+
+def dense_attention(q, k, v, causal: bool = False):
+    """Reference dense softmax attention (for tests / single-device)."""
+    d = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk",
+                        q.astype(jnp.float32),
+                        k.astype(jnp.float32)) / np.sqrt(d)
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)

@@ -79,12 +79,13 @@ def make_sync_dp_step(mesh: Mesh, *, axis: str = DATA_AXIS,
         images = standardize(images)
 
         def loss_fn(params):
+            from ..train.steps import _variables
             outputs, mutated = state.apply_fn(
-                {"params": params, "batch_stats": state.batch_stats},
+                _variables(params, state.batch_stats),
                 images, train=True, mutable=["batch_stats"],
             )
             loss = cross_entropy_loss(outputs, labels)
-            return loss, (outputs, mutated["batch_stats"])
+            return loss, (outputs, mutated.get("batch_stats", {}))
 
         (loss, (logits, new_stats)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(state.params)

@@ -1,0 +1,103 @@
+"""Tensor parallelism as sharding rules (GSPMD/pjit style).
+
+Net-new capability (the reference has no model sharding of any kind —
+SURVEY.md §2 parallelism checklist, TP row). Megatron-style split for the
+transformer blocks in models/vit.py:
+
+- column-parallel: attention qkv kernel and MLP fc1 kernel split on their
+  OUTPUT dim over the ``model`` mesh axis (each shard computes a slice of
+  heads / hidden units); their biases split the same way,
+- row-parallel: attention out kernel and MLP fc2 kernel split on their INPUT
+  dim (the partial products are summed by an XLA-inserted all-reduce); their
+  biases stay replicated,
+- everything else (embeddings, layernorms, head) replicated.
+
+We only *annotate* placements (NamedSharding per parameter path); XLA
+inserts the collectives and overlaps them with compute. No manual
+psum/all_gather appears anywhere in the model code.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any
+
+import jax
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils.pytree import flatten_params
+from .mesh import MODEL_AXIS
+
+PyTree = Any
+
+# (path regex, spec builder) — first match wins. Specs are for 2-D kernels
+# [in, out] / 1-D biases of the ViT naming scheme (models/vit.py).
+_TP_RULES: list[tuple[str, P]] = [
+    (r".*attn/qkv/kernel$", P(None, MODEL_AXIS)),   # column
+    (r".*attn/qkv/bias$", P(MODEL_AXIS)),
+    (r".*attn/out/kernel$", P(MODEL_AXIS, None)),   # row
+    (r".*mlp/fc1/kernel$", P(None, MODEL_AXIS)),    # column
+    (r".*mlp/fc1/bias$", P(MODEL_AXIS)),
+    (r".*mlp/fc2/kernel$", P(MODEL_AXIS, None)),    # row
+]
+
+
+def tp_spec_for_path(path: str) -> P:
+    for pattern, spec in _TP_RULES:
+        if re.match(pattern, path):
+            return spec
+    return P()  # replicated
+
+
+def param_shardings(params: PyTree, mesh: Mesh) -> PyTree:
+    """NamedSharding pytree matching ``params`` under the TP rules."""
+    flat = flatten_params(params)
+    specs = {k: tp_spec_for_path(k) for k in flat}
+    from ..utils.pytree import unflatten_params
+    spec_tree = unflatten_params(specs)
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s), spec_tree,
+        is_leaf=lambda x: isinstance(x, P))
+
+
+def shard_train_state(state, mesh: Mesh):
+    """Place a TrainState on the mesh: params per TP rules, optimizer state
+    mirroring its corresponding parameter, step/scalars replicated."""
+    p_shard = param_shardings(state.params, mesh)
+    replicated = NamedSharding(mesh, P())
+
+    params = jax.tree_util.tree_map(jax.device_put, state.params, p_shard)
+
+    def place_opt(x):
+        # optax.sgd momentum (trace) state mirrors the param tree; anything
+        # param-shaped gets the param's sharding, scalars replicate.
+        return x
+
+    # opt_state: momentum/trace entries have the same tree structure as
+    # params — map shardings where structures align, else replicate.
+    def put_like_params(subtree):
+        try:
+            return jax.tree_util.tree_map(jax.device_put, subtree, p_shard)
+        except (ValueError, TypeError):
+            return jax.tree_util.tree_map(
+                lambda x: jax.device_put(x, replicated), subtree)
+
+    opt_state = tuple(
+        type(entry)(**{
+            f: put_like_params(getattr(entry, f))
+            for f in entry._fields
+        }) if hasattr(entry, "_fields") and entry._fields else
+        jax.tree_util.tree_map(lambda x: jax.device_put(x, replicated), entry)
+        for entry in state.opt_state
+    ) if isinstance(state.opt_state, tuple) else jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, replicated), state.opt_state)
+
+    batch_stats = jax.tree_util.tree_map(
+        lambda x: jax.device_put(x, replicated), state.batch_stats)
+    return state.replace(
+        params=params,
+        opt_state=opt_state,
+        batch_stats=batch_stats,
+        step=jax.device_put(state.step, replicated),
+    )

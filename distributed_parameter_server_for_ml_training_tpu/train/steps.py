@@ -26,13 +26,21 @@ def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return optax.softmax_cross_entropy_with_integer_labels(logits, labels).mean()
 
 
+def _variables(params, batch_stats):
+    """BatchNorm-free models (ViT) carry an empty batch_stats collection."""
+    v = {"params": params}
+    if batch_stats:
+        v["batch_stats"] = batch_stats
+    return v
+
+
 def _forward_loss(state: TrainState, params, images, labels):
     outputs, mutated = state.apply_fn(
-        {"params": params, "batch_stats": state.batch_stats},
+        _variables(params, state.batch_stats),
         images, train=True, mutable=["batch_stats"],
     )
     loss = cross_entropy_loss(outputs, labels)
-    return loss, (outputs, mutated["batch_stats"])
+    return loss, (outputs, mutated.get("batch_stats", {}))
 
 
 def make_train_step(augment: bool = True) -> Callable:
@@ -86,11 +94,11 @@ def make_grad_step(model, augment: bool = True) -> Callable:
 
         def loss_fn(p):
             outputs, mutated = model.apply(
-                {"params": p, "batch_stats": batch_stats},
+                _variables(p, batch_stats),
                 images, train=True, mutable=["batch_stats"],
             )
             loss = cross_entropy_loss(outputs, labels)
-            return loss, (outputs, mutated["batch_stats"])
+            return loss, (outputs, mutated.get("batch_stats", {}))
 
         (loss, (logits, new_stats)), grads = jax.value_and_grad(
             loss_fn, has_aux=True)(params)
@@ -110,7 +118,7 @@ def make_eval_step() -> Callable:
     def eval_step(state: TrainState, images_u8: jax.Array, labels: jax.Array):
         images = normalize(images_u8)
         logits = state.apply_fn(
-            {"params": state.params, "batch_stats": state.batch_stats},
+            _variables(state.params, state.batch_stats),
             images, train=False)
         correct = jnp.sum(jnp.argmax(logits, -1) == labels)
         return correct, labels.shape[0]

@@ -1,0 +1,71 @@
+"""Ring-attention (sequence parallelism) correctness tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_parameter_server_for_ml_training_tpu.parallel import (
+    dense_attention, make_mesh, make_ring_attention)
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    rng = np.random.default_rng(0)
+    shape = (2, 64, 4, 16)  # [B, T, H, D], T sharded 8 ways -> 8 per shard
+    q = jnp.asarray(rng.normal(size=shape), jnp.float32)
+    k = jnp.asarray(rng.normal(size=shape), jnp.float32)
+    v = jnp.asarray(rng.normal(size=shape), jnp.float32)
+    return q, k, v
+
+
+def test_ring_equals_dense(devices, qkv):
+    q, k, v = qkv
+    mesh = make_mesh(8)
+    ring = make_ring_attention(mesh, axis="data")
+    out_ring = ring(q, k, v)
+    out_dense = dense_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out_ring), np.asarray(out_dense),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_causal_equals_dense(devices, qkv):
+    q, k, v = qkv
+    mesh = make_mesh(8)
+    ring = make_ring_attention(mesh, axis="data", causal=True)
+    out_ring = ring(q, k, v)
+    out_dense = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out_ring), np.asarray(out_dense),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_ring_bf16_inputs(devices, qkv):
+    q, k, v = (x.astype(jnp.bfloat16) for x in qkv)
+    mesh = make_mesh(8)
+    out = make_ring_attention(mesh)(q, k, v)
+    assert out.dtype == jnp.bfloat16
+    ref = dense_attention(q, k, v)
+    np.testing.assert_allclose(np.asarray(out, dtype=np.float32),
+                               np.asarray(ref, dtype=np.float32),
+                               rtol=0.05, atol=0.05)
+
+
+def test_ring_gradients_flow(devices, qkv):
+    """Differentiability: ring attention must be trainable end-to-end."""
+    q, k, v = qkv
+    mesh = make_mesh(8)
+    ring = make_ring_attention(mesh)
+
+    def loss(q):
+        return jnp.sum(ring(q, k, v) ** 2)
+
+    g = jax.grad(loss)(q)
+    assert g.shape == q.shape
+    assert np.isfinite(np.asarray(g)).all()
+
+    def loss_dense(q):
+        return jnp.sum(dense_attention(q, k, v) ** 2)
+
+    g_ref = jax.grad(loss_dense)(q)
+    np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                               rtol=1e-3, atol=1e-3)
