@@ -1,0 +1,15 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+The reference's native code all lived in pip deps (grpc C-core, libtorch —
+SURVEY.md §2.9). Here the host-side runtime hot paths are in-repo C++
+(native/ps_core.cpp): a contiguous-arena parameter store with seqlock
+fetches and fused fp16-decode + staleness-weighted SGD pushes, plus a
+multithreaded fp16 codec. Python binds with ctypes (no pybind11 in this
+environment); everything degrades gracefully to the pure-Python/numpy
+implementations when the library isn't built.
+"""
+
+from .bindings import load_library, native_available
+from .store import NativeParameterStore
+
+__all__ = ["load_library", "native_available", "NativeParameterStore"]

@@ -1,0 +1,99 @@
+"""ctypes bindings for native/ps_core.cpp (builds on demand with make)."""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_LIB = None
+_LIB_LOCK = threading.Lock()
+
+_NATIVE_DIR = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), "..", "..", "native"))
+_SO_PATH = os.path.join(_NATIVE_DIR, "libps_core.so")
+
+
+def _build() -> bool:
+    if not os.path.isfile(os.path.join(_NATIVE_DIR, "Makefile")):
+        return False
+    try:
+        subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
+                       capture_output=True, timeout=120)
+        return os.path.isfile(_SO_PATH)
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def load_library() -> ctypes.CDLL | None:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _LIB
+    with _LIB_LOCK:
+        if _LIB is not None:
+            return _LIB
+        if not os.path.isfile(_SO_PATH) and not _build():
+            return None
+        lib = ctypes.CDLL(_SO_PATH)
+
+        u16p = ctypes.POINTER(ctypes.c_uint16)
+        f32p = ctypes.POINTER(ctypes.c_float)
+        i64 = ctypes.c_int64
+
+        lib.dps_fp32_to_fp16.argtypes = [f32p, u16p, i64]
+        lib.dps_fp16_to_fp32.argtypes = [u16p, f32p, i64]
+        lib.dps_store_create.argtypes = [i64, f32p, ctypes.c_float]
+        lib.dps_store_create.restype = ctypes.c_void_p
+        lib.dps_store_destroy.argtypes = [ctypes.c_void_p]
+        lib.dps_store_step.argtypes = [ctypes.c_void_p]
+        lib.dps_store_step.restype = i64
+        lib.dps_store_rejected.argtypes = [ctypes.c_void_p]
+        lib.dps_store_rejected.restype = i64
+        lib.dps_store_fetch.argtypes = [ctypes.c_void_p, f32p]
+        lib.dps_store_fetch.restype = i64
+        lib.dps_store_push_fp16.argtypes = [ctypes.c_void_p, u16p, i64, i64]
+        lib.dps_store_push_fp16.restype = i64
+        lib.dps_store_push_fp32.argtypes = [ctypes.c_void_p, f32p, i64, i64]
+        lib.dps_store_push_fp32.restype = i64
+        _LIB = lib
+        return _LIB
+
+
+def native_available() -> bool:
+    return load_library() is not None
+
+
+def _f32p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _u16p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16))
+
+
+def fp32_to_fp16(src: np.ndarray) -> np.ndarray:
+    """Multithreaded fp32->fp16 cast (worker.py:264-268's compression, in
+    C++). Falls back to numpy when the library is absent."""
+    lib = load_library()
+    src = np.ascontiguousarray(src, np.float32)
+    if lib is None:
+        return src.astype(np.float16)
+    out = np.empty(src.shape, np.uint16)
+    lib.dps_fp32_to_fp16(_f32p(src.reshape(-1)), _u16p(out.reshape(-1)),
+                         src.size)
+    return out.view(np.float16)
+
+
+def fp16_to_fp32(src: np.ndarray) -> np.ndarray:
+    lib = load_library()
+    src = np.ascontiguousarray(src)
+    if src.dtype != np.float16:
+        raise TypeError(src.dtype)
+    if lib is None:
+        return src.astype(np.float32)
+    out = np.empty(src.shape, np.float32)
+    lib.dps_fp16_to_fp32(_u16p(src.view(np.uint16).reshape(-1)),
+                         _f32p(out.reshape(-1)), src.size)
+    return out

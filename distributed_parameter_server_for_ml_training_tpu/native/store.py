@@ -1,0 +1,182 @@
+"""Native-backed parameter store (async hot path in C++).
+
+API-compatible with :class:`~..ps.store.ParameterStore` for the worker-facing
+surface (register_worker / fetch / push / job_finished / metrics), so
+:class:`~..ps.worker.PSWorker`, the gRPC service, and the trainers accept it
+interchangeably. The arena layout (one flat float buffer + a name->slice
+index) is what lets C++ do the whole push in one multithreaded pass.
+
+Async mode only — the sync TPU path has no server at all (parallel/sync_dp),
+and the Python store covers sync-store experiments.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Mapping
+
+import numpy as np
+
+from ..ps.semantics import DEFAULT_STALENESS_BOUND
+from ..ps.store import MAX_WORKERS, StoreConfig, _Stats
+from .bindings import _f32p, _u16p, load_library
+
+
+class NativeParameterStore:
+    """ParameterStore drop-in with the C++ core under the hot path."""
+
+    def __init__(self, initial_params: Mapping[str, np.ndarray],
+                 config: StoreConfig | None = None):
+        self.config = config or StoreConfig(mode="async")
+        if self.config.mode != "async":
+            raise ValueError(
+                "NativeParameterStore supports async mode only; the sync "
+                "mode is the SPMD path (parallel/sync_dp.py) or the Python "
+                "store")
+        lib = load_library()
+        if lib is None:
+            raise RuntimeError("native library unavailable; build native/ "
+                               "or use ParameterStore")
+        self._lib = lib
+
+        # Flat arena + index.
+        self._index: dict[str, tuple[int, tuple[int, ...]]] = {}
+        offset = 0
+        for name, arr in initial_params.items():
+            arr = np.asarray(arr, np.float32)
+            self._index[name] = (offset, arr.shape)
+            offset += arr.size
+        self._size = offset
+        arena = np.empty(self._size, np.float32)
+        for name, arr in initial_params.items():
+            off, shape = self._index[name]
+            arena[off:off + int(np.prod(shape, dtype=np.int64))] = np.asarray(
+                arr, np.float32).reshape(-1)
+        self._handle = lib.dps_store_create(
+            self._size, _f32p(arena), float(self.config.learning_rate))
+
+        self._registration_lock = threading.Lock()
+        self._next_worker_id = 0
+        self.active_workers: set[int] = set()
+        self.last_seen: dict[int, float] = {}
+        self.stats = _Stats()
+        self._finished_event = threading.Event()
+
+    # -- properties mirroring ParameterStore ---------------------------------
+
+    @property
+    def push_codec(self) -> str:
+        return self.config.push_codec
+
+    @property
+    def global_step(self) -> int:
+        return int(self._lib.dps_store_step(self._handle))
+
+    @property
+    def parameters(self) -> dict[str, np.ndarray]:
+        """Name->array view of a consistent snapshot (copy)."""
+        flat, _ = self._fetch_flat()
+        return self._unpack(flat)
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def register_worker(self, worker_name: str = "") -> tuple[int, int]:
+        with self._registration_lock:
+            worker_id = self._next_worker_id
+            self._next_worker_id += 1
+            self.active_workers.add(worker_id)
+            self.last_seen[worker_id] = time.time()
+        return worker_id, self.config.total_workers
+
+    def _fetch_flat(self) -> tuple[np.ndarray, int]:
+        out = np.empty(self._size, np.float32)
+        step = int(self._lib.dps_store_fetch(self._handle, _f32p(out)))
+        return out, step
+
+    def _unpack(self, flat: np.ndarray) -> dict[str, np.ndarray]:
+        out = {}
+        for name, (off, shape) in self._index.items():
+            n = int(np.prod(shape, dtype=np.int64))
+            out[name] = flat[off:off + n].reshape(shape)
+        return out
+
+    def fetch(self, worker_id: int | None = None
+              ) -> tuple[dict[str, np.ndarray], int]:
+        flat, step = self._fetch_flat()
+        if worker_id is not None:
+            self.last_seen[worker_id] = time.time()
+        return self._unpack(flat), step
+
+    def _pack(self, gradients: Mapping[str, np.ndarray],
+              dtype) -> np.ndarray:
+        flat = np.empty(self._size, dtype)
+        for name, (off, shape) in self._index.items():
+            g = np.ascontiguousarray(gradients[name], dtype)
+            n = int(np.prod(shape, dtype=np.int64))
+            flat[off:off + n] = g.reshape(-1)
+        return flat
+
+    def push(self, worker_id: int, gradients: Mapping[str, np.ndarray],
+             fetched_step: int) -> bool:
+        self.last_seen[worker_id] = time.time()
+        t0 = time.time()
+        bound = int(self.config.staleness_bound)
+        before = self.global_step
+        if self.config.push_codec == "fp16":
+            flat = self._pack(gradients, np.float16)
+            new_step = int(self._lib.dps_store_push_fp16(
+                self._handle, _u16p(flat.view(np.uint16)),
+                int(fetched_step), bound))
+        else:
+            flat = self._pack(gradients, np.float32)
+            new_step = int(self._lib.dps_store_push_fp32(
+                self._handle, _f32p(flat), int(fetched_step), bound))
+        if new_step < 0:
+            self.stats.gradients_rejected += 1
+            return False
+        self.stats.gradients_processed += 1
+        self.stats.total_parameter_updates += 1
+        self.stats.staleness_values.append(before - int(fetched_step))
+        self.stats.update_times.append(time.time() - t0)
+        return True
+
+    def job_finished(self, worker_id: int) -> None:
+        with self._registration_lock:
+            self.active_workers.discard(worker_id)
+            empty = not self.active_workers
+        if empty:
+            self._finished_event.set()
+
+    def wait_all_finished(self, timeout: float | None = None) -> bool:
+        return self._finished_event.wait(timeout)
+
+    def metrics(self) -> dict:
+        elapsed = time.time() - self.stats.start_time
+        sv = self.stats.staleness_values
+        return {
+            "mode": "async",
+            "backend": "native",
+            "total_workers": self.config.total_workers,
+            "total_training_time_seconds": round(elapsed, 2),
+            "global_steps_completed": self.global_step,
+            "total_parameter_updates": self.stats.total_parameter_updates,
+            "gradients_processed": self.stats.gradients_processed,
+            "average_update_time_seconds": (
+                round(float(np.mean(self.stats.update_times)), 6)
+                if self.stats.update_times else 0.0),
+            "updates_per_second": (
+                round(self.stats.total_parameter_updates / elapsed, 3)
+                if elapsed > 0 else 0.0),
+            "learning_rate": self.config.learning_rate,
+            "staleness_bound": self.config.staleness_bound,
+            "gradients_rejected": self.stats.gradients_rejected,
+            "average_staleness": (round(float(np.mean(sv)), 3) if sv else 0.0),
+            "max_staleness": int(max(sv)) if sv else 0,
+        }
+
+    def __del__(self):
+        try:
+            self._lib.dps_store_destroy(self._handle)
+        except Exception:
+            pass

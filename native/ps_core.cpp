@@ -1,0 +1,220 @@
+// Native parameter-store core.
+//
+// The host-side hot path of the async parameter server: the reference spent
+// it in Python pickle + numpy temporaries (server.py:222 re-pickles ~45 MB
+// per fetch; server.py:232-237 allocates a full fp32 copy per push before a
+// second pass applies SGD). Here:
+//
+//   - parameters live in ONE contiguous float arena (single allocation; the
+//     Python side keeps {name -> (offset, shape)} and exposes zero-copy
+//     numpy views for reads),
+//   - push applies fused fp16-decode + staleness-weighted SGD in a single
+//     multithreaded pass over the arena (no intermediate fp32 gradient
+//     buffer at all),
+//   - the staleness rule is the reference's exactly: reject if
+//     global_step - fetched_step > bound, else weight
+//     max(0.1, 1/(1+0.1*s)) (server.py:171-186),
+//   - a seqlock-style version counter lets fetches copy the arena without
+//     blocking pushes (readers retry if a push raced them), replacing the
+//     reference's exclusive param_lock on the fetch path (server.py:221).
+//
+// Built as a plain shared library; Python binds via ctypes (no pybind11 in
+// this environment).
+
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// scalar fp16 <-> fp32 (IEEE 754 half), portable bit manipulation
+static inline float half_to_float(uint16_t h) {
+  uint32_t sign = (uint32_t)(h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1Fu;
+  uint32_t mant = h & 0x3FFu;
+  uint32_t bits;
+  if (exp == 0) {
+    if (mant == 0) {
+      bits = sign;  // +-0
+    } else {  // subnormal: value = mant * 2^-24; normalize so the implicit
+              // bit lands in place — exponent is 2^(-15-shift), biased 127.
+      int shift = 0;
+      while (!(mant & 0x400u)) {
+        mant <<= 1;
+        ++shift;
+      }
+      mant &= 0x3FFu;
+      bits = sign | ((uint32_t)(127 - 15 + 1 - shift) << 23) | (mant << 13);
+    }
+  } else if (exp == 0x1F) {
+    bits = sign | 0x7F800000u | (mant << 13);  // inf / nan
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (mant << 13);
+  }
+  float out;
+  std::memcpy(&out, &bits, 4);
+  return out;
+}
+
+static inline uint16_t float_to_half(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  uint32_t sign = (bits >> 16) & 0x8000u;
+  int32_t exp = (int32_t)((bits >> 23) & 0xFFu) - 127 + 15;
+  uint32_t mant = bits & 0x7FFFFFu;
+  if (exp <= 0) {  // underflow -> subnormal or zero (round-to-nearest-even)
+    if (exp < -10) return (uint16_t)sign;
+    mant |= 0x800000u;
+    int shift = 14 - exp;
+    uint16_t sub = (uint16_t)(mant >> shift);
+    uint32_t rem = mant & ((1u << shift) - 1);
+    uint32_t half_point = 1u << (shift - 1);
+    if (rem > half_point || (rem == half_point && (sub & 1))) ++sub;
+    return (uint16_t)(sign | sub);
+  }
+  if (exp >= 0x1F) {  // overflow -> inf; nan keeps payload bit
+    if (((bits >> 23) & 0xFFu) == 0xFFu && mant)
+      return (uint16_t)(sign | 0x7E00u);  // nan
+    return (uint16_t)(sign | 0x7C00u);
+  }
+  uint16_t out = (uint16_t)(sign | (exp << 10) | (mant >> 13));
+  uint32_t rem = mant & 0x1FFFu;
+  if (rem > 0x1000u || (rem == 0x1000u && (out & 1))) ++out;
+  return out;
+}
+
+static void parallel_for(int64_t n, int64_t grain,
+                         const std::function<void(int64_t, int64_t)>& body) {
+  unsigned hw = std::thread::hardware_concurrency();
+  int64_t nthreads = std::max<int64_t>(
+      1, std::min<int64_t>(hw ? hw : 1, n / grain));
+  if (nthreads <= 1) {
+    body(0, n);
+    return;
+  }
+  std::vector<std::thread> ts;
+  int64_t chunk = (n + nthreads - 1) / nthreads;
+  ts.reserve(nthreads);
+  for (int64_t t = 0; t < nthreads; ++t) {
+    int64_t lo = t * chunk, hi = std::min(n, lo + chunk);
+    if (lo >= hi) break;
+    ts.emplace_back([&body, lo, hi] { body(lo, hi); });
+  }
+  for (auto& t : ts) t.join();
+}
+
+struct Store {
+  std::vector<float> params;
+  std::mutex write_lock;               // serializes pushes (param_lock role)
+  std::atomic<int64_t> version{0};     // seqlock: odd = write in progress
+  std::atomic<int64_t> global_step{0};
+  std::atomic<int64_t> rejected{0};
+  float lr;
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---- fp16 codec (multithreaded) -------------------------------------------
+
+void dps_fp32_to_fp16(const float* src, uint16_t* dst, int64_t n) {
+  parallel_for(n, 1 << 16, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) dst[i] = float_to_half(src[i]);
+  });
+}
+
+void dps_fp16_to_fp32(const uint16_t* src, float* dst, int64_t n) {
+  parallel_for(n, 1 << 16, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) dst[i] = half_to_float(src[i]);
+  });
+}
+
+// ---- store lifecycle -------------------------------------------------------
+
+void* dps_store_create(int64_t n, const float* init, float lr) {
+  auto* s = new Store();
+  s->params.assign(init, init + n);
+  s->lr = lr;
+  return s;
+}
+
+void dps_store_destroy(void* h) { delete static_cast<Store*>(h); }
+
+int64_t dps_store_step(void* h) {
+  return static_cast<Store*>(h)->global_step.load();
+}
+
+int64_t dps_store_rejected(void* h) {
+  return static_cast<Store*>(h)->rejected.load();
+}
+
+// Seqlock fetch: copy the arena + step without blocking writers. Retries
+// until it observes a stable version. Returns the global step of the copy.
+int64_t dps_store_fetch(void* h, float* out) {
+  auto* s = static_cast<Store*>(h);
+  const int64_t n = (int64_t)s->params.size();
+  while (true) {
+    int64_t v0 = s->version.load(std::memory_order_acquire);
+    if (v0 & 1) continue;  // write in progress
+    int64_t step = s->global_step.load(std::memory_order_acquire);
+    std::memcpy(out, s->params.data(), n * sizeof(float));
+    std::atomic_thread_fence(std::memory_order_acquire);
+    if (s->version.load(std::memory_order_acquire) == v0) return step;
+  }
+}
+
+// Fused fp16-decode + staleness-weighted SGD apply (async push).
+// Returns the new global step, or -1 if rejected by the staleness bound.
+int64_t dps_store_push_fp16(void* h, const uint16_t* grads,
+                            int64_t fetched_step, int64_t bound) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->write_lock);
+  int64_t staleness = s->global_step.load() - fetched_step;
+  if (bound >= 0 && staleness > bound) {
+    s->rejected.fetch_add(1);
+    return -1;
+  }
+  double w = 1.0 / (1.0 + 0.1 * (double)staleness);  // server.py:178
+  if (w < 0.1) w = 0.1;
+  const float scale = (float)(s->lr * w);
+  float* p = s->params.data();
+  const int64_t n = (int64_t)s->params.size();
+  s->version.fetch_add(1, std::memory_order_acq_rel);  // odd: writing
+  parallel_for(n, 1 << 15, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i)
+      p[i] -= scale * half_to_float(grads[i]);
+  });
+  s->version.fetch_add(1, std::memory_order_acq_rel);  // even: stable
+  return s->global_step.fetch_add(1) + 1;
+}
+
+// fp32 variant (push_codec='none'), same semantics.
+int64_t dps_store_push_fp32(void* h, const float* grads,
+                            int64_t fetched_step, int64_t bound) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->write_lock);
+  int64_t staleness = s->global_step.load() - fetched_step;
+  if (bound >= 0 && staleness > bound) {
+    s->rejected.fetch_add(1);
+    return -1;
+  }
+  double w = 1.0 / (1.0 + 0.1 * (double)staleness);
+  if (w < 0.1) w = 0.1;
+  const float scale = (float)(s->lr * w);
+  float* p = s->params.data();
+  const int64_t n = (int64_t)s->params.size();
+  s->version.fetch_add(1, std::memory_order_acq_rel);
+  parallel_for(n, 1 << 15, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) p[i] -= scale * grads[i];
+  });
+  s->version.fetch_add(1, std::memory_order_acq_rel);
+  return s->global_step.fetch_add(1) + 1;
+}
+
+}  // extern "C"
