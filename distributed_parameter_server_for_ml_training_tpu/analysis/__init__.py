@@ -1,7 +1,8 @@
 """Experiment/analysis layer (reference L5: scripts/)."""
 
 from .parse_logs import aggregate_worker_metrics, parse_experiment
+from .runner import run_cell, run_matrix
 from .visualize import ExperimentVisualizer
 
 __all__ = ["aggregate_worker_metrics", "parse_experiment",
-           "ExperimentVisualizer"]
+           "ExperimentVisualizer", "run_cell", "run_matrix"]

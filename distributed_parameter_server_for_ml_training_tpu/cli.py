@@ -108,6 +108,17 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--emit-metrics", action="store_true")
     add_platform(s)
 
+    e = sub.add_parser("experiments",
+                       help="run the sync/async x workers matrix "
+                            "(reference §6 tables) and plot")
+    e.add_argument("--modes", default="sync,async")
+    e.add_argument("--worker-counts", default="4,8")
+    e.add_argument("--out-dir", default="experiments/results")
+    e.add_argument("--backend", choices=["python", "native"],
+                   default="python")
+    e.add_argument("--no-plots", action="store_true")
+    add_common(e)
+
     w = sub.add_parser("worker", help="gRPC remote worker")
     w.add_argument("--server",
                    default=_env("PARAMETER_SERVER_ADDRESS",
@@ -241,13 +252,27 @@ def cmd_worker(args) -> int:
     return 0
 
 
+def cmd_experiments(args) -> int:
+    from .analysis import run_matrix
+
+    dataset = _load_dataset(args)
+    run_matrix(dataset, args.out_dir,
+               modes=tuple(args.modes.split(",")),
+               worker_counts=tuple(int(x)
+                                   for x in args.worker_counts.split(",")),
+               epochs=args.epochs, batch_size=args.batch_size, lr=args.lr,
+               backend=args.backend, plots=not args.no_plots,
+               augment=not args.no_augment, seed=args.seed)
+    return 0
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if getattr(args, "platform", "default") == "cpu":
         import jax
         jax.config.update("jax_platforms", "cpu")
-    return {"train": cmd_train, "serve": cmd_serve,
-            "worker": cmd_worker}[args.command](args)
+    return {"train": cmd_train, "serve": cmd_serve, "worker": cmd_worker,
+            "experiments": cmd_experiments}[args.command](args)
 
 
 if __name__ == "__main__":

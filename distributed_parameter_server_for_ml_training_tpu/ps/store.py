@@ -56,6 +56,11 @@ class StoreConfig:
     push_codec: str = "fp16"  # 'none' | 'fp16' (reference pushes fp16)
     fetch_codec: str = "none"  # reference fetches fp32 (server.py:222)
     strict_rounds: bool = False  # True = corrected double-push semantics
+    # Membership expiry. The reference tracks last_seen but NEVER expires
+    # workers (server.py:219, 251) — restarted workers pollute membership
+    # (SURVEY.md quirk 10). None reproduces that; a number of seconds turns
+    # on the corrected behavior via expire_stale_workers().
+    worker_timeout: float | None = None
 
     def __post_init__(self):
         if self.mode not in ("sync", "async"):
@@ -167,6 +172,24 @@ class ParameterStore:
 
     def wait_all_finished(self, timeout: float | None = None) -> bool:
         return self._finished_event.wait(timeout)
+
+    def expire_stale_workers(self) -> list[int]:
+        """Failure detection (corrected semantics; no-op when
+        ``worker_timeout`` is None, which is the faithful default): drop
+        workers not seen within the timeout — liveness comes from pushes,
+        fetches, and the heartbeat ping (ps/worker.py)."""
+        if self.config.worker_timeout is None:
+            return []
+        cutoff = time.time() - self.config.worker_timeout
+        with self._registration_lock:
+            stale = [w for w in self.active_workers
+                     if self.last_seen.get(w, 0.0) < cutoff]
+            for w in stale:
+                self.active_workers.discard(w)
+            empty = not self.active_workers
+        if stale and empty:
+            self._finished_event.set()
+        return stale
 
     # -- aggregation ---------------------------------------------------------
 

@@ -39,6 +39,11 @@ class WorkerConfig:
     eval_batch_size: int = 1000
     eval_each_epoch: bool = True   # worker.py:393-394
     seed: int = 0
+    # Liveness ping via periodic fetch. The reference WROTE this (30 s
+    # FetchParameters ping, worker.py:112-119) but never ran it — the loop
+    # was dead code (SURVEY.md quirk 8). 0 disables; set e.g. 30.0 to enable
+    # the capability the reference intended.
+    heartbeat_interval: float = 0.0
 
     def __post_init__(self):
         if self.k_step_mode not in ("faithful", "accumulate"):
@@ -55,6 +60,7 @@ class WorkerResult:
     local_steps_completed: int = 0
     pushes_accepted: int = 0
     pushes_rejected: int = 0
+    heartbeats: int = 0
     error: Exception | None = None
 
     def metrics(self, total_workers: int, learning_rate: float,
@@ -102,18 +108,35 @@ class PSWorker(threading.Thread):
     # -- the training loop (worker.py:350-403) ------------------------------
 
     def run(self) -> None:
+        self._done = threading.Event()
         try:
             self._run()
         except Exception as e:  # surfaced via .result for the harness
             self.result.error = e
         finally:
+            self._done.set()
             if self.result.worker_id >= 0:
                 self.store.job_finished(self.result.worker_id)
+
+    def _heartbeat_loop(self, worker_id: int, interval: float) -> None:
+        """Liveness ping: periodic fetch (the reference's intended
+        health_check_loop, worker.py:112-119, implemented for real)."""
+        while not self._done.wait(interval):
+            try:
+                self.store.fetch(worker_id)
+                self.result.heartbeats += 1
+            except Exception:
+                pass  # transient failures are what registration retry is for
 
     def _run(self) -> None:
         cfg = self.config
         worker_id, total_workers = self.store.register_worker(self.worker_name)
         self.result.worker_id = worker_id
+        if cfg.heartbeat_interval > 0:
+            threading.Thread(
+                target=self._heartbeat_loop,
+                args=(worker_id, cfg.heartbeat_interval),
+                daemon=True).start()
 
         # Contiguous shard by worker id (worker.py:166-179). Worker ids beyond
         # total_workers (late re-registrations) wrap, unlike the reference

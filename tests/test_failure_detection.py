@@ -1,0 +1,58 @@
+"""Liveness/failure-detection tests (SURVEY.md §5.3 and quirks 8/10)."""
+
+import time
+
+import numpy as np
+
+from distributed_parameter_server_for_ml_training_tpu.data import (
+    synthetic_cifar100)
+from distributed_parameter_server_for_ml_training_tpu.ps import (
+    ParameterStore, PSWorker, StoreConfig, WorkerConfig)
+from distributed_parameter_server_for_ml_training_tpu.utils import (
+    flatten_params)
+
+
+def test_heartbeat_pings_store(tiny_model):
+    """The reference's health_check_loop was dead code (worker.py:112-126
+    shadowed); here the capability actually runs."""
+    import jax
+    model = tiny_model()
+    variables = model.init(jax.random.PRNGKey(0),
+                           np.zeros((1, 32, 32, 3), np.float32), train=False)
+    store = ParameterStore(flatten_params(variables["params"]),
+                           StoreConfig(mode="async", total_workers=1,
+                                       learning_rate=0.05))
+    ds = synthetic_cifar100(n_train=256, n_test=32, num_classes=10)
+    w = PSWorker(store, model, ds,
+                 WorkerConfig(batch_size=32, num_epochs=2, augment=False,
+                              eval_each_epoch=False,
+                              heartbeat_interval=0.05))
+    w.start()
+    w.join(timeout=120)
+    assert w.result.error is None
+    assert w.result.heartbeats > 0
+
+
+def test_faithful_mode_never_expires():
+    # server.py:219,251: last_seen tracked but never expired (quirk 10)
+    store = ParameterStore({"w": np.ones(2, np.float32)},
+                           StoreConfig(total_workers=2))
+    wid, _ = store.register_worker()
+    store.last_seen[wid] = time.time() - 10_000
+    assert store.expire_stale_workers() == []
+    assert wid in store.active_workers
+
+
+def test_corrected_expiry():
+    store = ParameterStore({"w": np.ones(2, np.float32)},
+                           StoreConfig(total_workers=2, worker_timeout=1.0))
+    a, _ = store.register_worker()
+    b, _ = store.register_worker()
+    store.last_seen[a] = time.time() - 5.0  # stale
+    stale = store.expire_stale_workers()
+    assert stale == [a]
+    assert store.active_workers == {b}
+    # expiring the last worker fires the finished event
+    store.last_seen[b] = time.time() - 5.0
+    store.expire_stale_workers()
+    assert store.wait_all_finished(timeout=0.01)
