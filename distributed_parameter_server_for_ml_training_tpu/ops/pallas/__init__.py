@@ -1,0 +1,9 @@
+"""Pallas TPU kernels for the framework's hot numerics ops."""
+
+from .quantize import (
+    dequantize_int8,
+    quantize_dequantize_int8,
+    quantize_int8,
+)
+
+__all__ = ["quantize_int8", "dequantize_int8", "quantize_dequantize_int8"]

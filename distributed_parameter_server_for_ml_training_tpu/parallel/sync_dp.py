@@ -43,6 +43,27 @@ from ..train.train_state import TrainState
 from .mesh import DATA_AXIS
 
 
+def _int8_allreduce_mean(grads, axis: str):
+    """Quantized gradient averaging: one flattened int8 quantization (Pallas
+    on TPU), an all_gather of int8 values + per-block scales, and a local
+    fused dequantize+mean. Moves ~1/4 of fp32's bytes over ICI."""
+    from jax.flatten_util import ravel_pytree
+
+    from ..ops.pallas.quantize import LANES, dequantize_int8, quantize_int8
+
+    flat, unravel = ravel_pytree(grads)
+    values, scales = quantize_int8(flat)            # [rows,128], [blocks]
+    v_all = jax.lax.all_gather(values, axis)        # [N, rows, 128]
+    s_all = jax.lax.all_gather(scales, axis)        # [N, blocks]
+    n_workers, rows, _ = v_all.shape
+    padded = rows * LANES
+    deq = dequantize_int8(v_all.reshape(n_workers * rows, LANES),
+                          s_all.reshape(-1),
+                          (n_workers * padded,))
+    mean_flat = deq.reshape(n_workers, padded).mean(axis=0)[:flat.size]
+    return unravel(mean_flat)
+
+
 def shard_batch(mesh: Mesh, batch, axis: str = DATA_AXIS):
     """Place host arrays onto the mesh, batch dim split along ``axis``.
 
@@ -91,11 +112,17 @@ def make_sync_dp_step(mesh: Mesh, *, axis: str = DATA_AXIS,
             loss_fn, has_aux=True)(state.params)
 
         # == server.py:145-169 aggregate_gradients_sync, as one all-reduce,
-        # with the fp16-cast compression analogue (worker.py:264-268) applied
-        # on the wire.
-        grads = compress_for_allreduce(grads, compression)
-        grads = jax.lax.pmean(grads, axis)
-        grads = decompress_from_allreduce(grads, compression)
+        # with compression on the wire (the reference cast fp16,
+        # worker.py:264-268):
+        #   bf16/fp16 -> reduced-precision pmean (half the ICI bytes)
+        #   int8      -> Pallas block-quantize + all_gather + dequant-mean
+        #                (quarter the bytes; EQuARX-style)
+        if compression == "int8":
+            grads = _int8_allreduce_mean(grads, axis)
+        else:
+            grads = compress_for_allreduce(grads, compression)
+            grads = jax.lax.pmean(grads, axis)
+            grads = decompress_from_allreduce(grads, compression)
 
         # == server.py:126-143 apply_gradients, replicated on every worker.
         state = state.apply_gradients(grads=grads)
