@@ -2,6 +2,8 @@
 parallelism (GSPMD sharding rules), ring-attention sequence parallelism."""
 
 from .mesh import make_mesh, worker_axis_size
+from .moe import init_moe_params, make_moe_ffn
+from .pipeline import make_pipeline_apply, stack_stage_params
 from .ring_attention import (dense_attention, make_ring_attention,
                              ring_attention_local)
 from .sync_dp import make_sync_dp_step, shard_batch
@@ -18,4 +20,8 @@ __all__ = [
     "param_shardings",
     "shard_train_state",
     "tp_spec_for_path",
+    "make_pipeline_apply",
+    "stack_stage_params",
+    "make_moe_ffn",
+    "init_moe_params",
 ]

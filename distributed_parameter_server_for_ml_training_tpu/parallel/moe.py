@@ -1,0 +1,119 @@
+"""Expert parallelism: Switch-style top-1 MoE FFN over an ``expert`` axis.
+
+Net-new capability (the reference has no MoE — SURVEY.md §2 checklist, EP
+row). One expert per mesh slot; each device routes its resident tokens,
+packs them into capacity-limited per-expert buffers, and two
+``lax.all_to_all`` hops move tokens to their expert and back:
+
+    route (local) -> dispatch [E, C, D] -> all_to_all -> my expert's FFN on
+    [N, C, D] -> all_to_all back -> gate * combine (dropped tokens -> 0)
+
+Capacity C bounds memory and keeps shapes static (XLA requirement); tokens
+beyond an expert's capacity are dropped, which is standard Switch behavior —
+in a transformer the residual connection carries them through unchanged.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+EXPERT_AXIS = "expert"
+
+
+def init_moe_params(rng, d_model: int, d_hidden: int, n_experts: int):
+    """Router + stacked per-expert FFN params ([E, ...]; shard on 'expert')."""
+    k1, k2, k3 = jax.random.split(rng, 3)
+    scale1 = 1.0 / jnp.sqrt(d_model)
+    scale2 = 1.0 / jnp.sqrt(d_hidden)
+    return {
+        "router": jax.random.normal(k1, (d_model, n_experts)) * scale1,
+        "w1": jax.random.normal(k2, (n_experts, d_model, d_hidden)) * scale1,
+        "b1": jnp.zeros((n_experts, d_hidden)),
+        "w2": jax.random.normal(k3, (n_experts, d_hidden, d_model)) * scale2,
+        "b2": jnp.zeros((n_experts, d_model)),
+    }
+
+
+def _moe_body(params, tokens, *, axis_name: str, axis_size: int,
+              capacity: int):
+    """shard_map body. params: router replicated + my expert's slice [1,...].
+    tokens: [n_local, D]. Returns [n_local, D]."""
+    n, d = tokens.shape
+    e = axis_size
+
+    # -- route locally (top-1 / Switch) --------------------------------------
+    logits = tokens @ params["router"]          # [n, E]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)     # [n]
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=1)[:, 0]
+
+    # position of each token within its expert's send buffer
+    onehot = jax.nn.one_hot(expert_idx, e, dtype=jnp.int32)      # [n, E]
+    pos = jnp.cumsum(onehot, axis=0) * onehot - 1                # [n, E]
+    pos = jnp.max(pos, axis=1)                                   # [n]
+    keep = pos < capacity
+
+    # -- dispatch [E, C, D] --------------------------------------------------
+    safe_pos = jnp.clip(pos, 0, capacity - 1)
+    dispatch = jnp.zeros((e, capacity, d), tokens.dtype)
+    dispatch = dispatch.at[expert_idx, safe_pos].add(
+        tokens * keep[:, None].astype(tokens.dtype))
+
+    # -- to experts, compute, and back ---------------------------------------
+    recv = jax.lax.all_to_all(dispatch, axis_name, split_axis=0,
+                              concat_axis=0, tiled=False)        # [N, C, D]
+    w1 = params["w1"][0]
+    b1 = params["b1"][0]
+    w2 = params["w2"][0]
+    b2 = params["b2"][0]
+    h = jax.nn.gelu(recv @ w1 + b1)
+    out = h @ w2 + b2                                            # [N, C, D]
+    back = jax.lax.all_to_all(out, axis_name, split_axis=0,
+                              concat_axis=0, tiled=False)        # [E, C, D]
+
+    # -- combine -------------------------------------------------------------
+    gathered = back[expert_idx, safe_pos]                        # [n, D]
+    mask = (keep.astype(tokens.dtype) * gate.astype(tokens.dtype))[:, None]
+    return gathered * mask
+
+
+def make_moe_ffn(mesh: Mesh, capacity: int,
+                 axis: str = EXPERT_AXIS) -> Callable:
+    """Build ``fn(params, tokens[B, D]) -> [B, D]`` with tokens sharded on
+    the expert axis and experts one-per-slot. Differentiable."""
+    axis_size = mesh.shape[axis]
+    body = partial(_moe_body, axis_name=axis, axis_size=axis_size,
+                   capacity=capacity)
+    param_specs = {
+        "router": P(),            # replicated
+        "w1": P(axis), "b1": P(axis),
+        "w2": P(axis), "b2": P(axis),
+    }
+    sharded = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(param_specs, P(axis)),
+        out_specs=P(axis),
+        check_vma=False,
+    )
+    return jax.jit(sharded)
+
+
+def dense_reference(params, tokens, capacity: int | None = None):
+    """Single-device reference: every token through its top-1 expert (no
+    capacity drops unless ``capacity`` given per-expert-per-shard semantics
+    are not modeled — use generous capacity in comparisons)."""
+    logits = tokens @ params["router"]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert_idx = jnp.argmax(probs, axis=-1)
+    gate = jnp.take_along_axis(probs, expert_idx[:, None], axis=1)[:, 0]
+    h = jax.nn.gelu(jnp.einsum("nd,ndh->nh", tokens,
+                               params["w1"][expert_idx])
+                    + params["b1"][expert_idx])
+    out = jnp.einsum("nh,nhd->nd", h, params["w2"][expert_idx]) \
+        + params["b2"][expert_idx]
+    return out * gate[:, None].astype(tokens.dtype)

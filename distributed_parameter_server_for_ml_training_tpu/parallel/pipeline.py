@@ -1,0 +1,105 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a ``stage``
+mesh axis.
+
+Net-new capability (the reference has no pipeline parallelism — SURVEY.md §2
+checklist). Design:
+
+- the model is S identical stages; stage s's parameters live only on mesh
+  slot s (each leaf stacked [S, ...] and sharded P('stage') — the shard_map
+  body sees its own [1, ...] slice),
+- M microbatches flow through a ring of ``ppermute`` hops: at tick t, stage
+  s processes microbatch t-s; the whole schedule is S+M-1 ticks, every
+  device executing every tick (SPMD) with validity masking,
+- jax autodiff differentiates straight through the unrolled schedule (the
+  transpose of ppermute is the reverse ppermute), so pipelined *training*
+  falls out for free — no hand-written backward schedule.
+
+The input batch is replicated; outputs are returned replicated (each
+microbatch's result is psum-broadcast from the last stage).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+STAGE_AXIS = "stage"
+
+
+def _pipeline_body(stage_params, x_mb, *, stage_fn: Callable,
+                   axis_name: str, axis_size: int):
+    """shard_map body. stage_params: this stage's [1, ...] param slice.
+    x_mb: [M, mb, ...] microbatches (replicated). Returns [M, mb, ...]
+    outputs (replicated via psum from the last stage)."""
+    s = jax.lax.axis_index(axis_name)
+    n_stages = axis_size
+    m = x_mb.shape[0]
+    my_params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
+
+    perm_fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    carry = jnp.zeros_like(x_mb[0])  # activation arriving at my stage
+    outputs = jnp.zeros_like(x_mb)
+
+    for t in range(n_stages + m - 1):
+        mb_idx = t - s  # which microbatch my stage works on this tick
+        active = (mb_idx >= 0) & (mb_idx < m)
+        # Stage 0 reads fresh input; later stages use the carried activation.
+        fresh = x_mb[jnp.clip(mb_idx, 0, m - 1)]
+        x_in = jnp.where(s == 0, fresh, carry)
+        y = stage_fn(my_params, x_in)
+        y = jnp.where(active, y, jnp.zeros_like(y))
+
+        # The last stage's finished microbatch is broadcast to everyone
+        # (psum over one-hot contribution), keeping outputs replicated.
+        is_last = s == n_stages - 1
+        contribution = jnp.where(active & is_last, y, jnp.zeros_like(y))
+        contribution = jax.lax.psum(contribution, axis_name)
+        out_idx = t - (n_stages - 1)  # static: which microbatch finished
+        if 0 <= out_idx < m:
+            outputs = outputs.at[out_idx].add(contribution)
+
+        # Ship activations one stage forward for the next tick.
+        carry = jax.lax.ppermute(y, axis_name, perm_fwd)
+
+    return outputs
+
+
+def stack_stage_params(per_stage_params: list) -> jax.Array:
+    """[S] list of same-structure param trees -> stacked tree [S, ...]."""
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_stage_params)
+
+
+def make_pipeline_apply(mesh: Mesh, stage_fn: Callable,
+                        num_microbatches: int,
+                        axis: str = STAGE_AXIS) -> Callable:
+    """Build ``apply(stacked_params, x) -> y`` running the pipeline.
+
+    ``stage_fn(params, x) -> y`` is one stage (shapes preserved). ``x`` is
+    the full batch [B, ...]; it is split into ``num_microbatches`` equal
+    microbatches internally. Differentiable w.r.t. params and x.
+    """
+    axis_size = mesh.shape[axis]
+    body = partial(_pipeline_body, stage_fn=stage_fn, axis_name=axis,
+                   axis_size=axis_size)
+    sharded = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=(P(axis), P()),   # params stacked on stage axis; x replicated
+        out_specs=P(),
+        check_vma=False,
+    )
+
+    @jax.jit
+    def apply(stacked_params, x):
+        b = x.shape[0]
+        assert b % num_microbatches == 0, (b, num_microbatches)
+        mb = b // num_microbatches
+        x_mb = x.reshape(num_microbatches, mb, *x.shape[1:])
+        y_mb = sharded(stacked_params, x_mb)
+        return y_mb.reshape(b, *y_mb.shape[2:])
+
+    return apply

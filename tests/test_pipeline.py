@@ -1,0 +1,108 @@
+"""Pipeline-parallelism tests: schedule correctness and trainability."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from distributed_parameter_server_for_ml_training_tpu.parallel.pipeline import (
+    make_pipeline_apply, stack_stage_params)
+from distributed_parameter_server_for_ml_training_tpu.parallel import make_mesh
+
+S = 4  # stages
+D = 16
+
+
+def stage_fn(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def make_params(seed):
+    rng = np.random.default_rng(seed)
+    return {
+        "w": jnp.asarray(rng.normal(scale=0.5, size=(D, D)), jnp.float32),
+        "b": jnp.asarray(rng.normal(scale=0.1, size=(D,)), jnp.float32),
+    }
+
+
+@pytest.fixture(scope="module")
+def stage_params():
+    return [make_params(i) for i in range(S)]
+
+
+def sequential(stage_params, x):
+    for p in stage_params:
+        x = stage_fn(p, x)
+    return x
+
+
+def test_pipeline_matches_sequential(devices, stage_params):
+    mesh = make_mesh(S, axis_names=("stage",))
+    stacked = stack_stage_params(stage_params)
+    apply = make_pipeline_apply(mesh, stage_fn, num_microbatches=8,
+                                axis="stage")
+    x = jnp.asarray(np.random.default_rng(9).normal(size=(32, D)),
+                    jnp.float32)
+    np.testing.assert_allclose(np.asarray(apply(stacked, x)),
+                               np.asarray(sequential(stage_params, x)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_single_microbatch(devices, stage_params):
+    mesh = make_mesh(S, axis_names=("stage",))
+    stacked = stack_stage_params(stage_params)
+    apply = make_pipeline_apply(mesh, stage_fn, num_microbatches=1,
+                                axis="stage")
+    x = jnp.ones((4, D), jnp.float32)
+    np.testing.assert_allclose(np.asarray(apply(stacked, x)),
+                               np.asarray(sequential(stage_params, x)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_pipeline_gradients_match_sequential(devices, stage_params):
+    """Autodiff through the ppermute schedule == sequential-model grads."""
+    mesh = make_mesh(S, axis_names=("stage",))
+    stacked = stack_stage_params(stage_params)
+    apply = make_pipeline_apply(mesh, stage_fn, num_microbatches=4,
+                                axis="stage")
+    x = jnp.asarray(np.random.default_rng(3).normal(size=(8, D)), jnp.float32)
+    y_target = jnp.ones((8, D), jnp.float32)
+
+    def loss_pipe(stacked):
+        return jnp.mean((apply(stacked, x) - y_target) ** 2)
+
+    def loss_seq(stacked):
+        per_stage = [jax.tree_util.tree_map(lambda p: p[i], stacked)
+                     for i in range(S)]
+        return jnp.mean((sequential(per_stage, x) - y_target) ** 2)
+
+    g_pipe = jax.grad(loss_pipe)(stacked)
+    g_seq = jax.grad(loss_seq)(stacked)
+    for a, b in zip(jax.tree_util.tree_leaves(g_pipe),
+                    jax.tree_util.tree_leaves(g_seq)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-6)
+
+
+def test_pipeline_training_learns(devices, stage_params):
+    mesh = make_mesh(S, axis_names=("stage",))
+    stacked = stack_stage_params(stage_params)
+    apply = make_pipeline_apply(mesh, stage_fn, num_microbatches=4,
+                                axis="stage")
+    x = jnp.asarray(np.random.default_rng(4).normal(size=(16, D)),
+                    jnp.float32)
+    y = jnp.asarray(np.random.default_rng(5).normal(size=(16, D)) * 0.5,
+                    jnp.float32)
+
+    @jax.jit
+    def step(stacked):
+        loss, grads = jax.value_and_grad(
+            lambda p: jnp.mean((apply(p, x) - y) ** 2))(stacked)
+        new = jax.tree_util.tree_map(lambda p, g: p - 0.1 * g, stacked, grads)
+        return new, loss
+
+    losses = []
+    for _ in range(100):
+        stacked, loss = step(stacked)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.7
