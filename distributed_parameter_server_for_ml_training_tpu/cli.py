@@ -65,6 +65,16 @@ def build_parser() -> argparse.ArgumentParser:
         q.add_argument("--no-augment", action="store_true")
         q.add_argument("--dtype", choices=["bfloat16", "float32"],
                        default="bfloat16")
+        q.add_argument("--model",
+                       choices=["resnet18", "resnet50", "vit_b16",
+                                "vit_tiny"],
+                       default="resnet18")
+        q.add_argument("--dataset", choices=["cifar100", "imagenet-synth"],
+                       default="cifar100",
+                       help="imagenet-synth = ImageNet-shaped synthetic "
+                            "(ResNet-50 pod config)")
+        q.add_argument("--image-size", type=int, default=224,
+                       help="imagenet-synth resolution")
         q.add_argument("--seed", type=int, default=0)
         q.add_argument("--emit-metrics", action="store_true",
                        help="print METRICS_JSON lines (server.py:367)")
@@ -136,8 +146,14 @@ def build_parser() -> argparse.ArgumentParser:
 
 def _load_dataset(args):
     from .data import load_cifar100, synthetic_cifar100
+    from .data.cifar import synthetic_imagenet
 
-    if getattr(args, "synthetic", False):
+    if getattr(args, "dataset", "cifar100") == "imagenet-synth":
+        ds = synthetic_imagenet(
+            n_train=getattr(args, "num_train", None) or 10_000,
+            n_test=getattr(args, "num_test", None) or 1_000,
+            image_size=getattr(args, "image_size", 224))
+    elif getattr(args, "synthetic", False):
         ds = synthetic_cifar100()
     else:
         ds = load_cifar100(getattr(args, "data_dir", None))
@@ -152,9 +168,13 @@ def _load_dataset(args):
 
 def cmd_train(args) -> int:
     dataset = _load_dataset(args)
-    if dataset.synthetic:
+    if dataset.synthetic and getattr(args, "dataset",
+                                     "cifar100") == "cifar100" \
+            and not getattr(args, "synthetic", False):
         print("note: CIFAR-100 not found on disk; using the synthetic "
               "dataset", file=sys.stderr)
+
+    num_classes = dataset.num_classes
 
     if args.mode == "baseline":
         from .train.baseline import BaselineConfig, BaselineTrainer
@@ -162,7 +182,8 @@ def cmd_train(args) -> int:
                              num_epochs=args.epochs,
                              learning_rate=args.lr,
                              augment=not args.no_augment,
-                             dtype=args.dtype, seed=args.seed)
+                             dtype=args.dtype, model=args.model,
+                             num_classes=num_classes, seed=args.seed)
         trainer = BaselineTrainer(dataset, cfg)
         trainer.train(plot_path=args.plot,
                       emit_metrics=args.emit_metrics,
@@ -178,7 +199,8 @@ def cmd_train(args) -> int:
         sync_steps=args.sync_steps, k_step_mode=args.k_step_mode,
         staleness_bound=args.staleness_bound, compression=args.compression,
         strict_rounds=args.strict_rounds, augment=not args.no_augment,
-        dtype=args.dtype, seed=args.seed)
+        dtype=args.dtype, model=args.model, num_classes=num_classes,
+        seed=args.seed)
     trainer = (SyncTrainer if args.mode == "sync" else AsyncTrainer)(
         dataset, cfg)
     metrics = trainer.train(emit_metrics=args.emit_metrics)

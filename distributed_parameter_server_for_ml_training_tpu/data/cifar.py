@@ -120,6 +120,33 @@ def synthetic_cifar100(n_train: int = 50_000, n_test: int = 10_000,
                    synthetic=True)
 
 
+def synthetic_imagenet(n_train: int = 10_000, n_test: int = 1_000,
+                       num_classes: int = 1000, image_size: int = 224,
+                       seed: int = 0) -> Dataset:
+    """ImageNet-shaped synthetic data for the ResNet-50 pod-scale config
+    (BASELINE.json configs[3]); same class-template construction as
+    :func:`synthetic_cifar100` at configurable resolution."""
+    rng = np.random.default_rng(seed + 77)
+    coarse_px = max(4, image_size // 8)
+    coarse = rng.normal(0.0, 1.0, size=(num_classes, coarse_px, coarse_px, 3)
+                        ).astype(np.float32)
+    rep = image_size // coarse_px
+    templates = 0.5 + 0.18 * coarse.repeat(rep, axis=1).repeat(rep, axis=2)
+
+    def make_split(n: int, split_seed: int):
+        r = np.random.default_rng(seed * 1000 + split_seed + 7)
+        y = np.arange(n, dtype=np.int32) % num_classes
+        r.shuffle(y)
+        x = templates[y] + r.normal(
+            0.0, 0.12, size=(n, image_size, image_size, 3)).astype(np.float32)
+        return (np.clip(x, 0.0, 1.0) * 255.0).astype(np.uint8), y
+
+    x_tr, y_tr = make_split(n_train, 1)
+    x_te, y_te = make_split(n_test, 2)
+    return Dataset(x_tr, y_tr, x_te, y_te, num_classes=num_classes,
+                   synthetic=True)
+
+
 def shard_range(n: int, worker_id: int, total_workers: int) -> tuple[int, int]:
     """Contiguous [start, end) shard for ``worker_id``.
 

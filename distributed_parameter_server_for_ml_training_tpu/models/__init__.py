@@ -1,7 +1,8 @@
 """Model zoo: CIFAR-style ResNets and ViT (flax.linen)."""
 
+from .registry import MODEL_NAMES, get_model
 from .resnet import ResNet, ResNet18, ResNet50, count_params
 from .vit import ViT, ViT_B16, ViT_Tiny
 
 __all__ = ["ResNet", "ResNet18", "ResNet50", "count_params",
-           "ViT", "ViT_B16", "ViT_Tiny"]
+           "ViT", "ViT_B16", "ViT_Tiny", "get_model", "MODEL_NAMES"]

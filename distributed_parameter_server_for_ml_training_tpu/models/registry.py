@@ -1,0 +1,37 @@
+"""Model registry: the BASELINE.json config matrix by name.
+
+Configs covered (BASELINE.json ``configs``):
+  - resnet18  — ResNet-18 / CIFAR-100 (the reference's only model)
+  - resnet50  — ResNet-50 (pod-scale sync config; ImageNet-1k shapes)
+  - vit_b16   — ViT-B/16 (transformer / non-conv MXU path)
+  - vit_tiny  — small ViT for CIFAR-resolution runs and tests
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .resnet import ResNet18, ResNet50
+from .vit import ViT_B16, ViT_Tiny
+
+_REGISTRY = {
+    "resnet18": lambda num_classes, dtype, axis_name: ResNet18(
+        num_classes=num_classes, dtype=dtype, axis_name=axis_name),
+    "resnet50": lambda num_classes, dtype, axis_name: ResNet50(
+        num_classes=num_classes, dtype=dtype, axis_name=axis_name),
+    "vit_b16": lambda num_classes, dtype, axis_name: ViT_B16(
+        num_classes=num_classes, dtype=dtype),
+    "vit_tiny": lambda num_classes, dtype, axis_name: ViT_Tiny(
+        num_classes=num_classes, dtype=dtype),
+}
+
+MODEL_NAMES = tuple(_REGISTRY)
+
+
+def get_model(name: str, num_classes: int = 100, dtype=jnp.bfloat16,
+              axis_name: str | None = None):
+    """Build a model by registry name. ViT models ignore ``axis_name``
+    (LayerNorm needs no cross-replica sync; BN models use it)."""
+    if name not in _REGISTRY:
+        raise ValueError(f"unknown model {name!r}; have {MODEL_NAMES}")
+    return _REGISTRY[name](num_classes, dtype, axis_name)

@@ -147,9 +147,10 @@ class PSWorker(threading.Thread):
         y_shard = self.dataset.y_train[lo:hi]
 
         # Template structure for flat<->pytree conversion.
+        h, w = self.dataset.x_train.shape[1:3]
         variables = self.model.init(
             jax.random.PRNGKey(cfg.seed),
-            np.zeros((1, 32, 32, 3), np.float32), train=False)
+            np.zeros((1, h, w, 3), np.float32), train=False)
         batch_stats = variables["batch_stats"]
         params = variables["params"]
 

@@ -37,6 +37,7 @@ class BaselineConfig:
     num_classes: int = 100
     dtype: str = "bfloat16"        # TPU-first default; 'float32' for parity
     plain_sgd: bool = False        # True = the distributed server optimizer
+    model: str = "resnet18"        # models/registry.py name
     seed: int = 0
 
 
@@ -96,14 +97,18 @@ class BaselineTrainer:
         steps_per_epoch = max(
             1, len(dataset.x_train) // cfg.batch_size)
         dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
-        self.model = model or ResNet18(num_classes=cfg.num_classes,
-                                       dtype=dtype)
+        from ..models import get_model
+        self.model = model or get_model(cfg.model,
+                                        num_classes=cfg.num_classes,
+                                        dtype=dtype)
         tx = (server_sgd(cfg.learning_rate) if cfg.plain_sgd
               else baseline_optimizer(
                   cfg.learning_rate, cfg.momentum, cfg.weight_decay,
                   cfg.milestones, cfg.gamma, steps_per_epoch))
+        h, w = dataset.x_train.shape[1:3]
         self.state = create_train_state(
-            self.model, jax.random.PRNGKey(cfg.seed), tx)
+            self.model, jax.random.PRNGKey(cfg.seed), tx,
+            input_shape=(1, h, w, 3))
         self._train_step = jax.jit(make_train_step(augment=cfg.augment),
                                    donate_argnums=0)
         self._eval_step = jax.jit(make_eval_step())

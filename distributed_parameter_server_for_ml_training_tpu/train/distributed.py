@@ -20,7 +20,6 @@ import jax
 import numpy as np
 
 from ..data.cifar import Dataset, make_batches
-from ..models import ResNet18
 from ..parallel.mesh import make_mesh
 from ..parallel.sync_dp import make_sync_dp_step, shard_batch
 from ..ps.store import ParameterStore, StoreConfig
@@ -47,6 +46,7 @@ class DistributedConfig:
     augment: bool = True
     num_classes: int = 100
     dtype: str = "bfloat16"
+    model: str = "resnet18"        # models/registry.py name
     seed: int = 0
 
 
@@ -58,12 +58,15 @@ class SyncTrainer:
         self.dataset = dataset
         self.mesh = make_mesh(cfg.num_workers)
         import jax.numpy as jnp
+
+        from ..models import get_model
         dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
-        self.model = ResNet18(num_classes=cfg.num_classes, dtype=dtype,
-                              axis_name="data")
+        self.model = get_model(cfg.model, num_classes=cfg.num_classes,
+                               dtype=dtype, axis_name="data")
+        h, w = dataset.x_train.shape[1:3]
         self.state = create_train_state(
             self.model, jax.random.PRNGKey(cfg.seed),
-            server_sgd(cfg.learning_rate))
+            server_sgd(cfg.learning_rate), input_shape=(1, h, w, 3))
         self._step = make_sync_dp_step(self.mesh,
                                        compression=cfg.compression,
                                        augment=cfg.augment)
@@ -145,11 +148,15 @@ class AsyncTrainer:
         self.config = cfg = config or DistributedConfig()
         self.dataset = dataset
         import jax.numpy as jnp
+
+        from ..models import get_model
         dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
-        self.model = ResNet18(num_classes=cfg.num_classes, dtype=dtype)
+        self.model = get_model(cfg.model, num_classes=cfg.num_classes,
+                               dtype=dtype)
+        h, w = dataset.x_train.shape[1:3]
         variables = self.model.init(
             jax.random.PRNGKey(cfg.seed),
-            np.zeros((1, 32, 32, 3), np.float32), train=False)
+            np.zeros((1, h, w, 3), np.float32), train=False)
         self.store = ParameterStore(
             flatten_params(variables["params"]),
             StoreConfig(mode=cfg.mode, total_workers=cfg.num_workers,
