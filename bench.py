@@ -29,7 +29,7 @@ REFERENCE_IMAGES_PER_SEC = 50_000 / 1037.8  # M1 Mac CPU epoch time
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    parser.add_argument("--batch-size", type=int, default=1024)
+    parser.add_argument("--batch-size", type=int, default=2048)
     parser.add_argument("--scan-steps", type=int, default=20,
                         help="train steps per device-side scan window")
     parser.add_argument("--trials", type=int, default=5)

@@ -193,10 +193,14 @@ def augment_batch(key: jax.Array, x: jax.Array) -> jax.Array:
     xp = jnp.pad(x, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
     offsets = jax.random.randint(k_crop, (b, 2), 0, 2 * pad + 1)
 
-    def crop_one(img, off):
-        return jax.lax.dynamic_slice(img, (off[0], off[1], 0), (h, w, c))
+    # Per-image crop as two batched take_along_axis gathers (one per spatial
+    # axis) — much faster on TPU than B separate dynamic slices (the vmap'd
+    # form cost ~45% of the whole ResNet-18 train step).
+    rows = offsets[:, 0:1] + jnp.arange(h)[None, :]          # [B, h]
+    cols = offsets[:, 1:2] + jnp.arange(w)[None, :]          # [B, w]
+    x = jnp.take_along_axis(xp, rows[:, :, None, None], axis=1)
+    x = jnp.take_along_axis(x, cols[:, None, :, None], axis=2)
 
-    x = jax.vmap(crop_one)(xp, offsets)
     flip = jax.random.bernoulli(k_flip, 0.5, (b,))
     return jnp.where(flip[:, None, None, None], x[:, :, ::-1, :], x)
 
