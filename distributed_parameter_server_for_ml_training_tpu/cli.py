@@ -114,6 +114,13 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--lr", type=float,
                    default=_env("LEARNING_RATE", 0.1, float))
     s.add_argument("--num-classes", type=int, default=100)
+    s.add_argument("--model",
+                   choices=["resnet18", "resnet50", "vit_b16", "vit_tiny"],
+                   default="resnet18",
+                   help="must match the workers' --model (the store is "
+                        "keyed by parameter names)")
+    s.add_argument("--image-size", type=int, default=32,
+                   help="input resolution used to init the store's params")
     s.add_argument("--seed", type=int, default=0)
     s.add_argument("--emit-metrics", action="store_true")
     add_platform(s)
@@ -215,14 +222,16 @@ def cmd_serve(args) -> int:
     import numpy as np
 
     from .comms.service import serve
-    from .models import ResNet18
+    from .models import get_model
     from .ps.store import ParameterStore, StoreConfig
     from .utils.metrics import emit_metrics_json
     from .utils.pytree import flatten_params
 
-    model = ResNet18(num_classes=args.num_classes)
+    model = get_model(args.model, num_classes=args.num_classes)
+    size = args.image_size
     variables = model.init(jax.random.PRNGKey(args.seed),
-                           np.zeros((1, 32, 32, 3), np.float32), train=False)
+                           np.zeros((1, size, size, 3), np.float32),
+                           train=False)
     store = ParameterStore(
         flatten_params(variables["params"]),
         StoreConfig(mode=args.mode, total_workers=args.workers,
@@ -248,7 +257,7 @@ def cmd_serve(args) -> int:
 
 def cmd_worker(args) -> int:
     from .comms.client import RemoteStore
-    from .models import ResNet18
+    from .models import get_model
     from .ps.worker import PSWorker, WorkerConfig
     from .utils.metrics import emit_metrics_json
 
@@ -256,7 +265,10 @@ def cmd_worker(args) -> int:
     store = RemoteStore(args.server)
     import jax.numpy as jnp
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
-    model = ResNet18(num_classes=100, dtype=dtype)
+    # Honor --model/--dataset like cmd_train does — a mismatched architecture
+    # would push parameter names the server's store doesn't know.
+    model = get_model(args.model, num_classes=dataset.num_classes,
+                      dtype=dtype)
     cfg = WorkerConfig(batch_size=args.batch_size, num_epochs=args.epochs,
                        sync_steps=args.sync_steps,
                        k_step_mode=args.k_step_mode,

@@ -39,8 +39,10 @@ class RemoteStore:
                          "FetchParameters", "JobFinished"]
         }
         #: filled in at registration from the server's config; PSWorker reads
-        #: this to apply the fp16 cast client-side (worker.py:264-268).
+        #: these to apply the fp16 cast client-side before push
+        #: (worker.py:264-268) and decompress after fetch.
         self.push_codec = "none"
+        self.fetch_codec = "none"
 
     def register_worker(self, worker_name: str = "") -> tuple[int, int]:
         """Retry x5 with exponential backoff (worker.py:215-229)."""
@@ -51,6 +53,7 @@ class RemoteStore:
                 reply, _ = unpack_msg(self._call["RegisterWorker"](
                     pack_msg({"worker_name": worker_name})))
                 self.push_codec = reply.get("push_codec", "none")
+                self.fetch_codec = reply.get("fetch_codec", "none")
                 return int(reply["worker_id"]), int(reply["total_workers"])
             except grpc.RpcError as e:
                 last_err = e

@@ -68,8 +68,9 @@ class ParameterService:
         return pack_msg({
             "worker_id": worker_id,
             "total_workers": total,
-            # Client needs the server's codec/mode to compress correctly.
+            # Client needs the server's codecs/mode to compress correctly.
             "push_codec": self.store.config.push_codec,
+            "fetch_codec": getattr(self.store, "fetch_codec", "none"),
             "mode": self.store.config.mode,
             "learning_rate": self.store.config.learning_rate,
         })

@@ -19,11 +19,11 @@ from typing import Mapping
 import numpy as np
 
 from ..ps.semantics import DEFAULT_STALENESS_BOUND
-from ..ps.store import MAX_WORKERS, StoreConfig, _Stats
+from ..ps.store import MAX_WORKERS, MembershipMixin, StoreConfig, _Stats
 from .bindings import _f32p, _u16p, load_library
 
 
-class NativeParameterStore:
+class NativeParameterStore(MembershipMixin):
     """ParameterStore drop-in with the C++ core under the hot path."""
 
     def __init__(self, initial_params: Mapping[str, np.ndarray],
@@ -34,6 +34,10 @@ class NativeParameterStore:
                 "NativeParameterStore supports async mode only; the sync "
                 "mode is the SPMD path (parallel/sync_dp.py) or the Python "
                 "store")
+        if self.config.fetch_codec != "none":
+            raise ValueError(
+                "NativeParameterStore fetches fp32 from the arena; "
+                "fetch_codec compression is Python-store only")
         lib = load_library()
         if lib is None:
             raise RuntimeError("native library unavailable; build native/ "
@@ -70,6 +74,10 @@ class NativeParameterStore:
         return self.config.push_codec
 
     @property
+    def fetch_codec(self) -> str:
+        return "none"  # the arena always fetches fp32
+
+    @property
     def global_step(self) -> int:
         return int(self._lib.dps_store_step(self._handle))
 
@@ -79,15 +87,7 @@ class NativeParameterStore:
         flat, _ = self._fetch_flat()
         return self._unpack(flat)
 
-    # -- lifecycle -----------------------------------------------------------
-
-    def register_worker(self, worker_name: str = "") -> tuple[int, int]:
-        with self._registration_lock:
-            worker_id = self._next_worker_id
-            self._next_worker_id += 1
-            self.active_workers.add(worker_id)
-            self.last_seen[worker_id] = time.time()
-        return worker_id, self.config.total_workers
+    # -- lifecycle (register/finish/expire inherited) ------------------------
 
     def _fetch_flat(self) -> tuple[np.ndarray, int]:
         out = np.empty(self._size, np.float32)
@@ -140,16 +140,6 @@ class NativeParameterStore:
         self.stats.staleness_values.append(before - int(fetched_step))
         self.stats.update_times.append(time.time() - t0)
         return True
-
-    def job_finished(self, worker_id: int) -> None:
-        with self._registration_lock:
-            self.active_workers.discard(worker_id)
-            empty = not self.active_workers
-        if empty:
-            self._finished_event.set()
-
-    def wait_all_finished(self, timeout: float | None = None) -> bool:
-        return self._finished_event.wait(timeout)
 
     def metrics(self) -> dict:
         elapsed = time.time() - self.stats.start_time

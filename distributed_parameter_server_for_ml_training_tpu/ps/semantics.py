@@ -34,21 +34,31 @@ def mean_gradients(
 ) -> dict[str, np.ndarray]:
     """Element-wise mean over workers, per parameter (server.py:145-169).
 
-    Every worker must supply the same parameter names; float32 accumulation.
+    Parameter names come from the FIRST worker's push, and each parameter is
+    averaged over only the workers that supplied it (``valid_workers`` in
+    ``aggregate_gradients_sync``) — a partial push therefore skews the mean
+    for the parameters it carries rather than aborting the round. Names that
+    appear only in later workers' pushes are dropped, exactly as the
+    reference's ``param_names = list(worker_gradients[0].keys())`` does.
+    Float32 accumulation. Returns ``{}`` for an empty round (server.py:147).
     """
     grads_list = list(grads_per_worker)
     if not grads_list:
-        raise ValueError("no gradients to aggregate")
-    names = set(grads_list[0])
-    for g in grads_list[1:]:
-        if set(g) != names:
-            raise ValueError("workers pushed mismatched parameter sets")
-    n = len(grads_list)
-    return {
-        k: np.sum([np.asarray(g[k], np.float32) for g in grads_list], axis=0)
-        / np.float32(n)
-        for k in grads_list[0]
-    }
+        return {}
+    out: dict[str, np.ndarray] = {}
+    for name in grads_list[0]:
+        total = None
+        valid = 0
+        for g in grads_list:
+            if name in g:
+                arr = np.asarray(g[name], np.float32)
+                # no copy needed: accumulation and the final divide both
+                # allocate fresh arrays, so `total` never aliases the output
+                total = arr if total is None else total + arr
+                valid += 1
+        if valid > 0:
+            out[name] = total / np.float32(valid)
+    return out
 
 
 def sgd_apply(params: dict[str, np.ndarray],

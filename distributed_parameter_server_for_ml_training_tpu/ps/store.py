@@ -81,7 +81,56 @@ class _Stats:
     start_time: float = field(default_factory=time.time)
 
 
-class ParameterStore:
+class MembershipMixin:
+    """Worker-lifecycle surface shared by the Python and native stores:
+    sequential id assignment under a registration lock (server.py:190-211),
+    JobFinished accounting (server.py:306-318), and the corrected-semantics
+    expiry (no-op when ``worker_timeout`` is None, the faithful default —
+    the reference tracks ``last_seen`` but never expires, server.py:219,251).
+
+    Expects the host class to provide ``config``, ``_registration_lock``,
+    ``_next_worker_id``, ``active_workers``, ``last_seen`` and
+    ``_finished_event``.
+    """
+
+    def register_worker(self, worker_name: str = "") -> tuple[int, int]:
+        """Returns (worker_id, total_workers)."""
+        with self._registration_lock:
+            worker_id = self._next_worker_id
+            self._next_worker_id += 1
+            self.active_workers.add(worker_id)
+            self.last_seen[worker_id] = time.time()
+        return worker_id, self.config.total_workers
+
+    def job_finished(self, worker_id: int) -> None:
+        """Remove from the active set; final stats fire when it empties."""
+        with self._registration_lock:
+            self.active_workers.discard(worker_id)
+            empty = not self.active_workers
+        if empty:
+            self._finished_event.set()
+
+    def wait_all_finished(self, timeout: float | None = None) -> bool:
+        return self._finished_event.wait(timeout)
+
+    def expire_stale_workers(self) -> list[int]:
+        """Failure detection: drop workers not seen within the timeout —
+        liveness comes from pushes, fetches, and the heartbeat ping."""
+        if self.config.worker_timeout is None:
+            return []
+        cutoff = time.time() - self.config.worker_timeout
+        with self._registration_lock:
+            stale = [w for w in self.active_workers
+                     if self.last_seen.get(w, 0.0) < cutoff]
+            for w in stale:
+                self.active_workers.discard(w)
+            empty = not self.active_workers
+        if stale and empty:
+            self._finished_event.set()
+        return stale
+
+
+class ParameterStore(MembershipMixin):
     """Thread-safe canonical parameter holder + sync/async aggregator."""
 
     def __init__(self, initial_params: Mapping[str, np.ndarray],
@@ -112,17 +161,13 @@ class ParameterStore:
         fp16 cast on the worker side)."""
         return self.config.push_codec
 
-    # -- lifecycle ---------------------------------------------------- ps.proto:8
+    @property
+    def fetch_codec(self) -> str:
+        """Codec applied to fetched payloads; workers must decompress
+        (non-default — the reference always fetched fp32, server.py:222)."""
+        return self.config.fetch_codec
 
-    def register_worker(self, worker_name: str = "") -> tuple[int, int]:
-        """Sequential id assignment under the registration lock
-        (server.py:190-211). Returns (worker_id, total_workers)."""
-        with self._registration_lock:
-            worker_id = self._next_worker_id
-            self._next_worker_id += 1
-            self.active_workers.add(worker_id)
-            self.last_seen[worker_id] = time.time()
-        return worker_id, self.config.total_workers
+    # -- lifecycle (register/finish/expire inherited) ----------------- ps.proto:8
 
     def fetch(self, worker_id: int | None = None
               ) -> tuple[dict[str, np.ndarray], int]:
@@ -160,36 +205,6 @@ class ParameterStore:
             self._push_sync(worker_id, gradients)
             return True
         return self._push_async(worker_id, gradients, fetched_step)
-
-    def job_finished(self, worker_id: int) -> None:
-        """Remove from the active set; final stats fire when it empties
-        (server.py:306-318)."""
-        with self._registration_lock:
-            self.active_workers.discard(worker_id)
-            empty = not self.active_workers
-        if empty:
-            self._finished_event.set()
-
-    def wait_all_finished(self, timeout: float | None = None) -> bool:
-        return self._finished_event.wait(timeout)
-
-    def expire_stale_workers(self) -> list[int]:
-        """Failure detection (corrected semantics; no-op when
-        ``worker_timeout`` is None, which is the faithful default): drop
-        workers not seen within the timeout — liveness comes from pushes,
-        fetches, and the heartbeat ping (ps/worker.py)."""
-        if self.config.worker_timeout is None:
-            return []
-        cutoff = time.time() - self.config.worker_timeout
-        with self._registration_lock:
-            stale = [w for w in self.active_workers
-                     if self.last_seen.get(w, 0.0) < cutoff]
-            for w in stale:
-                self.active_workers.discard(w)
-            empty = not self.active_workers
-        if stale and empty:
-            self._finished_event.set()
-        return stale
 
     # -- aggregation ---------------------------------------------------------
 

@@ -151,13 +151,14 @@ class PSWorker(threading.Thread):
         variables = self.model.init(
             jax.random.PRNGKey(cfg.seed),
             np.zeros((1, h, w, 3), np.float32), train=False)
-        batch_stats = variables["batch_stats"]
+        batch_stats = variables.get("batch_stats", {})  # ViT has no BN
         params = variables["params"]
 
         rng = jax.random.PRNGKey(cfg.seed + worker_id)
         fetched_step = 0
         k = cfg.sync_steps
         accum = None
+        accum_n = 0
 
         for epoch in range(cfg.num_epochs):
             t_epoch = time.time()
@@ -167,6 +168,9 @@ class PSWorker(threading.Thread):
                 boundary = batch_idx % k == 0
                 if boundary:
                     flat, fetched_step = self.store.fetch(worker_id)
+                    if getattr(self.store, "fetch_codec", "none") == "fp16":
+                        from ..ops.compression import fp16_decompress
+                        flat = fp16_decompress(flat)
                     params = unflatten_params(flat)
 
                 grads, batch_stats, loss, acc = self._grad_step(
@@ -175,25 +179,38 @@ class PSWorker(threading.Thread):
                 self.result.local_steps_completed += 1
 
                 if cfg.k_step_mode == "accumulate" and k > 1:
-                    g = jax.tree_util.tree_map(lambda a: a, grads)
-                    accum = g if accum is None else jax.tree_util.tree_map(
-                        lambda a, b: a + b, accum, g)
-                    window_end = (batch_idx % k == k - 1)
-                    if window_end:
-                        n = np.float32((batch_idx % k) + 1)
-                        push_tree = jax.tree_util.tree_map(
-                            lambda a: a / n, accum)
-                        accum = None
-                        self._push(worker_id, push_tree, fetched_step)
+                    accum = grads if accum is None else jax.tree_util.tree_map(
+                        lambda a, b: a + b, accum, grads)
+                    accum_n += 1
+                    if accum_n == k:
+                        self._push_mean(worker_id, accum, accum_n,
+                                        fetched_step)
+                        accum, accum_n = None, 0
                 elif boundary:
                     # Faithful: push THIS batch's gradients; the other K-1
                     # batches' gradients are computed and dropped (quirk 7).
                     self._push(worker_id, grads, fetched_step)
 
+            # An epoch ending mid-window flushes the partial accumulator,
+            # divided by the ACTUAL number of accumulated batches — it must
+            # not leak into the next epoch's first window (which would push a
+            # >K-batch sum divided by K, against stale params).
+            if accum is not None:
+                self._push_mean(worker_id, accum, accum_n, fetched_step)
+                accum, accum_n = None, 0
+
             self.result.epoch_times.append(time.time() - t_epoch)
             if cfg.eval_each_epoch:
                 self.result.test_accuracies.append(
                     self.evaluate(params, batch_stats))
+
+    def _push_mean(self, worker_id, accum_tree, n: int,
+                   fetched_step) -> None:
+        """Push the mean of an accumulated gradient window of n batches."""
+        scale = np.float32(n)
+        self._push(worker_id,
+                   jax.tree_util.tree_map(lambda a: a / scale, accum_tree),
+                   fetched_step)
 
     def _push(self, worker_id, grads_tree, fetched_step) -> None:
         flat = flatten_params(jax.device_get(grads_tree))

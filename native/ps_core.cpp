@@ -190,8 +190,12 @@ int64_t dps_store_push_fp16(void* h, const uint16_t* grads,
     for (int64_t i = lo; i < hi; ++i)
       p[i] -= scale * half_to_float(grads[i]);
   });
+  // Step must advance BEFORE the version returns to even: a fetch validated
+  // against the post-write version would otherwise pair new params with the
+  // pre-push step, inflating every later staleness computation by 1.
+  int64_t new_step = s->global_step.fetch_add(1) + 1;
   s->version.fetch_add(1, std::memory_order_acq_rel);  // even: stable
-  return s->global_step.fetch_add(1) + 1;
+  return new_step;
 }
 
 // fp32 variant (push_codec='none'), same semantics.
@@ -213,8 +217,9 @@ int64_t dps_store_push_fp32(void* h, const float* grads,
   parallel_for(n, 1 << 15, [&](int64_t lo, int64_t hi) {
     for (int64_t i = lo; i < hi; ++i) p[i] -= scale * grads[i];
   });
+  int64_t new_step = s->global_step.fetch_add(1) + 1;  // before even bump
   s->version.fetch_add(1, std::memory_order_acq_rel);
-  return s->global_step.fetch_add(1) + 1;
+  return new_step;
 }
 
 }  // extern "C"

@@ -40,13 +40,24 @@ class TestMeanGradients:
         g = {"w": np.array([1.5, -2.0])}
         np.testing.assert_allclose(mean_gradients([g])["w"], g["w"])
 
-    def test_mismatched_names_rejected(self):
-        with pytest.raises(ValueError):
-            mean_gradients([{"w": np.zeros(2)}, {"v": np.zeros(2)}])
+    def test_partial_push_mean_over_valid_workers(self):
+        """server.py:145-169: each param is averaged over only the workers
+        that supplied it (``valid_workers``), not the round size."""
+        g1 = {"w": np.array([2.0, 4.0]), "b": np.array([6.0])}
+        g2 = {"w": np.array([4.0, 6.0])}  # partial push: no "b"
+        m = mean_gradients([g1, g2])
+        np.testing.assert_allclose(m["w"], [3.0, 5.0])
+        np.testing.assert_allclose(m["b"], [6.0])  # mean over 1 valid worker
 
-    def test_empty_rejected(self):
-        with pytest.raises(ValueError):
-            mean_gradients([])
+    def test_names_come_from_first_worker(self):
+        """Params appearing only in later pushes are dropped, matching
+        ``param_names = list(worker_gradients[0].keys())``."""
+        m = mean_gradients([{"w": np.ones(2)},
+                            {"w": np.ones(2), "extra": np.ones(1)}])
+        assert set(m) == {"w"}
+
+    def test_empty_round_returns_empty(self):
+        assert mean_gradients([]) == {}  # server.py:147
 
 
 class TestSgdApply:

@@ -149,3 +149,61 @@ def test_k_step_accumulate_pushes_window_mean(model, small_dataset):
     n_batches = len(small_dataset.x_train) // 32
     assert r.local_steps_completed == n_batches
     assert r.pushes_accepted == n_batches // 2
+
+
+def test_k_step_accumulate_epoch_boundary_flush(model, small_dataset):
+    """An epoch ending mid-window must flush the partial accumulator (divided
+    by the actual batch count) rather than leak it into the next epoch.
+
+    640 train / batch 32 = 20 batches; K=3 -> 6 full windows + a 2-batch
+    partial per epoch. Per epoch: 7 pushes, and the accumulator starts the
+    next epoch empty. Every update equals plain SGD on per-window means, so
+    a 2-epoch run must apply exactly 14 updates."""
+    store = ParameterStore(
+        init_flat(model),
+        StoreConfig(mode="async", total_workers=1, learning_rate=0.05))
+    cfg = WorkerConfig(batch_size=32, num_epochs=2, sync_steps=3,
+                       k_step_mode="accumulate", augment=False,
+                       eval_each_epoch=False)
+    results = run_workers(store, model, small_dataset, n_workers=1,
+                          config=cfg)
+    r = results[0]
+    n_batches = len(small_dataset.x_train) // 32  # 20
+    assert n_batches % 3 != 0  # the scenario under test
+    pushes_per_epoch = -(-n_batches // 3)  # ceil: 7
+    assert r.local_steps_completed == 2 * n_batches
+    assert r.pushes_accepted == 2 * pushes_per_epoch
+    assert store.global_step == 2 * pushes_per_epoch
+
+
+def test_fetch_codec_fp16_roundtrip(model, small_dataset):
+    """fetch_codec='fp16' compresses the fetch payload; the worker must
+    decompress back to fp32 before training (ADVICE r1)."""
+    store = ParameterStore(
+        init_flat(model),
+        StoreConfig(mode="async", total_workers=1, learning_rate=0.05,
+                    fetch_codec="fp16"))
+    payload, _ = store.fetch()
+    assert all(v.dtype == np.float16 for v in payload.values())
+
+    from distributed_parameter_server_for_ml_training_tpu.ps.worker import (
+        PSWorker)
+    from distributed_parameter_server_for_ml_training_tpu.train.steps import (
+        make_grad_step)
+    seen_dtypes = []
+    base_step = make_grad_step(model, augment=False)
+
+    def recording_step(params, batch_stats, xb, yb, rng, step):
+        seen_dtypes.append(jax.tree_util.tree_leaves(params)[0].dtype)
+        return base_step(params, batch_stats, xb, yb, rng, step)
+
+    cfg = WorkerConfig(batch_size=32, num_epochs=1, augment=False,
+                       eval_each_epoch=False)
+    worker = PSWorker(store, model, small_dataset, cfg,
+                      grad_step=recording_step)
+    worker.start()
+    worker.join()
+    assert worker.result.error is None
+    assert worker.result.pushes_accepted > 0
+    # the grad step must see decompressed fp32 params, never raw fp16
+    assert seen_dtypes and all(d == np.float32 for d in seen_dtypes)
