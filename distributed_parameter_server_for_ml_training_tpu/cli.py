@@ -101,6 +101,17 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--resume", action="store_true",
                    help="resume from the newest checkpoint in "
                         "--checkpoint-dir")
+    t.add_argument("--multihost", action="store_true",
+                   help="join a multi-process SPMD job before training "
+                        "(sync mode): one global mesh across hosts")
+    t.add_argument("--coordinator",
+                   default=_env("DPS_COORDINATOR", None),
+                   help="process-0 address host:port (env DPS_COORDINATOR); "
+                        "omit on TPU pods for auto-detection")
+    t.add_argument("--num-processes", type=int,
+                   default=_env("DPS_NUM_PROCESSES", None, int))
+    t.add_argument("--process-id", type=int,
+                   default=_env("DPS_PROCESS_ID", None, int))
     add_common(t)
 
     s = sub.add_parser("serve", help="gRPC parameter server (multi-host)")
@@ -174,6 +185,14 @@ def _load_dataset(args):
 
 
 def cmd_train(args) -> int:
+    if getattr(args, "multihost", False):
+        if args.mode != "sync":
+            raise SystemExit("--multihost applies to --mode sync (async "
+                             "multi-host uses serve/worker over gRPC)")
+        from .parallel.multihost import initialize as initialize_multihost
+        initialize_multihost(args.coordinator, args.num_processes,
+                             args.process_id)
+
     dataset = _load_dataset(args)
     if dataset.synthetic and getattr(args, "dataset",
                                      "cifar100") == "cifar100" \

@@ -3,6 +3,9 @@ parallelism (GSPMD sharding rules), ring-attention sequence parallelism."""
 
 from .mesh import make_mesh, worker_axis_size
 from .moe import init_moe_params, make_moe_ffn
+from .multihost import (fetch_replicated, host_local_slice, make_global_mesh,
+                        replicate_to_mesh, shard_batch_global)
+from .multihost import initialize as initialize_multihost
 from .pipeline import make_pipeline_apply, stack_stage_params
 from .ring_attention import (dense_attention, make_ring_attention,
                              ring_attention_local)
@@ -12,6 +15,12 @@ from .tensor import param_shardings, shard_train_state, tp_spec_for_path
 __all__ = [
     "make_mesh",
     "worker_axis_size",
+    "initialize_multihost",
+    "make_global_mesh",
+    "host_local_slice",
+    "shard_batch_global",
+    "replicate_to_mesh",
+    "fetch_replicated",
     "make_sync_dp_step",
     "shard_batch",
     "make_ring_attention",

@@ -1,0 +1,108 @@
+"""Multi-host SPMD: one global mesh across processes via ``jax.distributed``.
+
+This is the TPU-native replacement for the reference's multi-*machine* layer
+— 1 parameter-server task + N worker tasks on ECS behind an internal NLB
+(terraform/main.tf:387-435), wired together by env-injected addresses
+(main.tf:308-314). Here every process is a peer in one multi-controller SPMD
+job: each calls :func:`initialize` (address wiring by env vars, same idiom as
+the reference's ``PARAMETER_SERVER_ADDRESS``), the runtime forms one global
+device view, and the *same* compiled sync step (parallel/sync_dp.py) runs on
+a mesh spanning every host — gradient averaging rides ICI within a host and
+DCN across hosts through the same ``lax.pmean``, with no server process and
+no NLB.
+
+Env contract (mirrors server.py:407-417 / worker.py:457-459 env-first
+config):
+
+    DPS_COORDINATOR   host:port of process 0 (like PARAMETER_SERVER_ADDRESS)
+    DPS_NUM_PROCESSES total process count      (like TOTAL_WORKERS_EXPECTED)
+    DPS_PROCESS_ID    this process's rank
+
+On real TPU pods these are normally auto-detected by the TPU runtime and
+``jax.distributed.initialize()`` needs no arguments; the env contract is for
+CPU fleets and tests.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS
+
+
+def initialize(coordinator: str | None = None,
+               num_processes: int | None = None,
+               process_id: int | None = None) -> None:
+    """Join the multi-controller job. Arguments default from env
+    (DPS_COORDINATOR / DPS_NUM_PROCESSES / DPS_PROCESS_ID); with no args and
+    no env, defers entirely to JAX's auto-detection (TPU pods). The local
+    device count comes from the backend (on CPU fleets set
+    ``--xla_force_host_platform_device_count`` in XLA_FLAGS)."""
+    coordinator = coordinator or os.environ.get("DPS_COORDINATOR")
+    if num_processes is None and "DPS_NUM_PROCESSES" in os.environ:
+        num_processes = int(os.environ["DPS_NUM_PROCESSES"])
+    if process_id is None and "DPS_PROCESS_ID" in os.environ:
+        process_id = int(os.environ["DPS_PROCESS_ID"])
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+
+
+def make_global_mesh(axis_names: tuple[str, ...] = (DATA_AXIS,)) -> Mesh:
+    """Mesh over ALL processes' devices: the global-batch ``data`` axis spans
+    hosts. Device order is process-major (process 0's devices first), so a
+    contiguous global-batch slice per process matches the addressable
+    shards."""
+    devices = np.array(jax.devices())
+    if len(axis_names) == 1:
+        return Mesh(devices, axis_names)
+    # trailing axis = per-process devices (model axis inside a host, data
+    # across hosts): ('data', 'model') => (num_processes, local_count)
+    local = jax.local_device_count()
+    return Mesh(devices.reshape(len(devices) // local, local), axis_names)
+
+
+def host_local_slice(x: np.ndarray) -> np.ndarray:
+    """This process's contiguous slice of a globally-agreed batch (the
+    reference's contiguous shard-by-worker-id, worker.py:166-179, at host
+    granularity)."""
+    per = x.shape[0] // jax.process_count()
+    lo = jax.process_index() * per
+    return x[lo:lo + per]
+
+
+def shard_batch_global(mesh: Mesh, batch, axis: str = DATA_AXIS):
+    """Multi-process version of sync_dp.shard_batch: every process passes the
+    FULL global batch (identical on all processes, e.g. same seeded
+    shuffle); each contributes only its local slice to the global array."""
+    sharding = NamedSharding(mesh, P(axis))
+
+    def put(x):
+        x = np.asarray(x)
+        return jax.make_array_from_process_local_data(
+            sharding, host_local_slice(x), global_shape=x.shape)
+
+    return jax.tree_util.tree_map(put, batch)
+
+
+def replicate_to_mesh(mesh: Mesh, tree):
+    """Replicate host-local values (identical on every process) onto the
+    global mesh — the multi-host way to place the train state."""
+    sharding = NamedSharding(mesh, P())
+
+    def put(x):
+        x = np.asarray(x)
+        return jax.make_array_from_process_local_data(
+            sharding, x, global_shape=x.shape)
+
+    return jax.tree_util.tree_map(put, tree)
+
+
+def fetch_replicated(tree):
+    """Host-local numpy copy of a fully-replicated global pytree (every
+    process holds a complete shard, so this is local)."""
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)

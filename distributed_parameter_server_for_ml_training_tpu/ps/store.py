@@ -201,6 +201,19 @@ class ParameterStore(MembershipMixin):
                          for k, v in gradients.items()}
         self.last_seen[worker_id] = time.time()
 
+        # Reject shape-mismatched pushes up front (e.g. a worker built with a
+        # different head size / image size than the server): the reference
+        # would crash mid-apply on the broadcast; here the bad push is
+        # refused and the round state stays clean.
+        for name, g in gradients.items():
+            p = self.parameters.get(name)
+            if p is not None and p.shape != g.shape:
+                self.stats.gradients_rejected += 1
+                print(f"rejecting push from worker {worker_id}: {name} "
+                      f"shape {g.shape} != server {p.shape} (model/dataset "
+                      f"mismatch?)")
+                return False
+
         if self.config.mode == "sync":
             self._push_sync(worker_id, gradients)
             return True
@@ -223,15 +236,20 @@ class ParameterStore(MembershipMixin):
 
             if self._gradients_received >= self.config.total_workers:
                 t0 = time.time()
-                mean = mean_gradients(self._pending.values())
-                with self._param_lock:
-                    sgd_apply(self.parameters, mean,
-                              self.config.learning_rate)
-                    self.global_step += 1
-                self.stats.total_parameter_updates += 1
-                self.stats.update_times.append(time.time() - t0)
-                self._pending.clear()
-                self._gradients_received = 0
+                try:
+                    mean = mean_gradients(self._pending.values())
+                    with self._param_lock:
+                        sgd_apply(self.parameters, mean,
+                                  self.config.learning_rate)
+                        self.global_step += 1
+                    self.stats.total_parameter_updates += 1
+                    self.stats.update_times.append(time.time() - t0)
+                finally:
+                    # The round MUST reset even if aggregation raises —
+                    # otherwise every later push re-triggers the failure and
+                    # the server is wedged permanently.
+                    self._pending.clear()
+                    self._gradients_received = 0
             self.stats.gradients_processed += 1
 
     def _push_async(self, worker_id: int, grads: dict[str, np.ndarray],

@@ -51,12 +51,33 @@ class DistributedConfig:
 
 
 class SyncTrainer:
-    """Sync data-parallel training over a device mesh (no server process)."""
+    """Sync data-parallel training over a device mesh (no server process).
+
+    Multi-host: when the process has already joined a multi-controller job
+    (``parallel.initialize_multihost``; ``jax.process_count() > 1``), the
+    mesh spans every host's devices, each process contributes its contiguous
+    slice of the global batch, and the same compiled step runs everywhere —
+    the TPU-native version of the reference's multi-machine deployment
+    (terraform/main.tf:387-435), with DCN in place of the NLB.
+    """
 
     def __init__(self, dataset: Dataset, config: DistributedConfig | None = None):
         self.config = cfg = config or DistributedConfig()
         self.dataset = dataset
-        self.mesh = make_mesh(cfg.num_workers)
+        self.multihost = jax.process_count() > 1
+        if self.multihost:
+            from ..parallel.multihost import make_global_mesh
+            self.mesh = make_global_mesh()
+            # logical workers == global mesh slots in multi-host mode
+            if cfg.num_workers != jax.device_count() \
+                    and jax.process_index() == 0:
+                print(f"multihost: overriding --workers {cfg.num_workers} "
+                      f"-> {jax.device_count()} (one logical worker per "
+                      f"device across {jax.process_count()} processes); "
+                      f"global batch = batch_size x {jax.device_count()}")
+            cfg.num_workers = jax.device_count()
+        else:
+            self.mesh = make_mesh(cfg.num_workers)
         import jax.numpy as jnp
 
         from ..models import get_model
@@ -67,6 +88,9 @@ class SyncTrainer:
         self.state = create_train_state(
             self.model, jax.random.PRNGKey(cfg.seed),
             server_sgd(cfg.learning_rate), input_shape=(1, h, w, 3))
+        if self.multihost:
+            from ..parallel.multihost import replicate_to_mesh
+            self.state = replicate_to_mesh(self.mesh, self.state)
         self._step = make_sync_dp_step(self.mesh,
                                        compression=cfg.compression,
                                        augment=cfg.augment)
@@ -74,6 +98,12 @@ class SyncTrainer:
         self.epoch_times: list[float] = []
         self.test_accuracies: list[float] = []
         self.global_steps = 0
+
+    def _shard(self, batch):
+        if self.multihost:
+            from ..parallel.multihost import shard_batch_global
+            return shard_batch_global(self.mesh, batch)
+        return shard_batch(self.mesh, batch)
 
     def train(self, emit_metrics: bool = False) -> dict:
         cfg = self.config
@@ -86,16 +116,23 @@ class SyncTrainer:
             for xb, yb in make_batches(self.dataset.x_train,
                                        self.dataset.y_train, global_batch,
                                        seed=cfg.seed * 997 + epoch):
-                bi, bl = shard_batch(self.mesh, (xb, yb))
+                bi, bl = self._shard((xb, yb))
                 self.state, m = self._step(self.state, bi, bl, rng)
                 losses.append(m["loss"])
                 self.global_steps += 1
-            acc = self.evaluate()
+            # In multihost mode only rank 0 pays for the full test pass —
+            # the state is replicated, so the others' evals would be
+            # identical duplicated work on the critical path.
+            if self.multihost and jax.process_index() != 0:
+                acc = float("nan")
+            else:
+                acc = self.evaluate()
             self.epoch_times.append(time.time() - t0)
             self.test_accuracies.append(acc)
-            print(f"[sync x{cfg.num_workers}] epoch {epoch + 1}: "
-                  f"loss {float(np.mean([float(l) for l in losses])):.4f} "
-                  f"test {acc:.2%} ({self.epoch_times[-1]:.1f}s)")
+            if jax.process_index() == 0:
+                print(f"[sync x{cfg.num_workers}] epoch {epoch + 1}: "
+                      f"loss {float(np.mean([float(l) for l in losses])):.4f} "
+                      f"test {acc:.2%} ({self.epoch_times[-1]:.1f}s)")
         total = time.time() - t_start
 
         server_metrics = {
@@ -110,7 +147,7 @@ class SyncTrainer:
             "updates_per_second": round(self.global_steps / total, 3),
             "learning_rate": cfg.learning_rate,
         }
-        if emit_metrics:
+        if emit_metrics and jax.process_index() == 0:
             emit_metrics_json(server_metrics)
             for wid in range(cfg.num_workers):
                 emit_metrics_json({
@@ -131,11 +168,17 @@ class SyncTrainer:
         return server_metrics
 
     def evaluate(self) -> float:
+        state = self.state
+        if self.multihost:
+            # The state is fully replicated, so every process holds a
+            # complete copy — fetch it and evaluate locally (no collective).
+            from ..parallel.multihost import fetch_replicated
+            state = fetch_replicated(self.state)
         correct = total = 0
         for xb, yb in make_batches(self.dataset.x_test, self.dataset.y_test,
                                    1000, shuffle=False,
                                    drop_remainder=False):
-            c, t = self._eval_step(self.state, xb, yb)
+            c, t = self._eval_step(state, xb, yb)
             correct += int(c)
             total += int(t)
         return correct / max(total, 1)

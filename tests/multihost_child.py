@@ -1,0 +1,72 @@
+"""Child process for the multi-host sync test (tests/test_multihost.py).
+
+Joins a 2-process jax.distributed job with 4 virtual CPU devices per
+process, runs ONE sync-DP step on a deterministic batch over the 8-device
+global mesh, and (rank 0) writes the resulting params to --out as npz.
+"""
+
+import argparse
+import os
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--coordinator", required=True)
+    ap.add_argument("--num-processes", type=int, required=True)
+    ap.add_argument("--process-id", type=int, required=True)
+    ap.add_argument("--local-devices", type=int, default=4)
+    ap.add_argument("--out", required=True)
+    args = ap.parse_args()
+
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={args.local_devices}")
+    os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from distributed_parameter_server_for_ml_training_tpu.parallel import (
+        fetch_replicated, initialize_multihost, make_global_mesh,
+        make_sync_dp_step, replicate_to_mesh, shard_batch_global)
+
+    initialize_multihost(args.coordinator, args.num_processes,
+                         args.process_id)
+    assert jax.process_count() == args.num_processes
+    assert jax.local_device_count() == args.local_devices
+
+    import numpy as np
+
+    from distributed_parameter_server_for_ml_training_tpu.models import ResNet
+    from distributed_parameter_server_for_ml_training_tpu.train import (
+        create_train_state, server_sgd)
+    from distributed_parameter_server_for_ml_training_tpu.utils import (
+        flatten_params)
+
+    model = ResNet(stage_sizes=(1, 1), num_filters=8, num_classes=10,
+                   axis_name="data")
+    state = create_train_state(model, jax.random.PRNGKey(0), server_sgd(0.1))
+
+    mesh = make_global_mesh()
+    state = replicate_to_mesh(mesh, state)
+    step = make_sync_dp_step(mesh, compression="none", augment=False)
+
+    # Deterministic batch, identical in every process (same seed).
+    r = np.random.default_rng(7)
+    images = r.integers(0, 255, (16, 32, 32, 3), dtype=np.uint8)
+    labels = (np.arange(16) % 10).astype(np.int32)
+    bi, bl = shard_batch_global(mesh, (images, labels))
+
+    state, metrics = step(state, bi, bl, jax.random.PRNGKey(1))
+    loss = float(metrics["loss"])
+
+    if jax.process_index() == 0:
+        flat = flatten_params(fetch_replicated(state.params))
+        np.savez(args.out, loss=np.float32(loss), **flat)
+    jax.distributed.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

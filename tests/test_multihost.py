@@ -1,0 +1,78 @@
+"""Multi-host sync: 2 processes x 4 CPU devices == 8-device single process.
+
+The reference could only exercise its multi-machine layer by deploying to
+ECS (SURVEY.md §4); here a real ``jax.distributed`` job — two OS processes
+joined through a coordinator, gloo collectives between them — must produce
+bit-comparable updates to the same program on one process's 8-device mesh.
+This is the CI-able stand-in for a TPU pod's DCN path.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+
+import jax
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CHILD = os.path.join(REPO, "tests", "multihost_child.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_sync_step_matches_single_process(tmp_path, devices):
+    port = _free_port()
+    out = tmp_path / "rank0.npz"
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, CHILD, "--coordinator", f"127.0.0.1:{port}",
+             "--num-processes", "2", "--process-id", str(pid),
+             "--local-devices", "4", "--out", str(out)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        for pid in range(2)
+    ]
+    logs = []
+    for p in procs:
+        stdout, _ = p.communicate(timeout=300)
+        logs.append(stdout.decode(errors="replace"))
+    assert all(p.returncode == 0 for p in procs), "\n\n".join(logs)
+    assert out.exists(), logs[0]
+    got = dict(np.load(out))
+    got_loss = float(got.pop("loss"))
+
+    # Same program, single process, 8 local devices (conftest mesh).
+    from distributed_parameter_server_for_ml_training_tpu.models import ResNet
+    from distributed_parameter_server_for_ml_training_tpu.parallel import (
+        make_mesh, make_sync_dp_step, shard_batch)
+    from distributed_parameter_server_for_ml_training_tpu.train import (
+        create_train_state, server_sgd)
+    from distributed_parameter_server_for_ml_training_tpu.utils import (
+        flatten_params)
+
+    model = ResNet(stage_sizes=(1, 1), num_filters=8, num_classes=10,
+                   axis_name="data")
+    state = create_train_state(model, jax.random.PRNGKey(0), server_sgd(0.1))
+    mesh = make_mesh(8)
+    step = make_sync_dp_step(mesh, compression="none", augment=False)
+    r = np.random.default_rng(7)
+    images = r.integers(0, 255, (16, 32, 32, 3), dtype=np.uint8)
+    labels = (np.arange(16) % 10).astype(np.int32)
+    bi, bl = shard_batch(mesh, (images, labels))
+    state, metrics = step(state, bi, bl, jax.random.PRNGKey(1))
+
+    want = flatten_params(jax.device_get(state.params))
+    assert set(got) == set(want)
+    np.testing.assert_allclose(got_loss, float(metrics["loss"]),
+                               rtol=1e-5, atol=1e-6)
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], rtol=1e-4, atol=1e-5,
+                                   err_msg=k)

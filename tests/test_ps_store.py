@@ -95,6 +95,21 @@ class TestSyncAggregation:
         assert s.global_step == 1
         np.testing.assert_allclose(s.parameters["w"], 1.0 - 0.1 * 4.0)
 
+    def test_shape_mismatched_push_rejected_round_survives(self):
+        """A worker built with the wrong head size (e.g. serve/worker
+        --model/--dataset mismatch) must be refused without poisoning the
+        sync round: later well-formed pushes still complete it."""
+        s = make_store(mode="sync", total_workers=2, learning_rate=0.1,
+                       push_codec="none")
+        w0 = s.parameters["w"].copy()
+        bad = {"w": np.ones(7, np.float32), "b": np.zeros(2, np.float32)}
+        assert s.push(0, bad, 0) is False
+        assert s.stats.gradients_rejected == 1
+        assert s.push(0, ones_grads(1.0), 0) is True
+        assert s.push(1, ones_grads(3.0), 0) is True
+        np.testing.assert_allclose(s.parameters["w"], w0 - 0.2)
+        assert s.global_step == 1
+
     def test_fp16_push_codec_roundtrip(self):
         # worker.py:264-268 / server.py:232-237
         from distributed_parameter_server_for_ml_training_tpu.ops import (
