@@ -60,7 +60,10 @@ def test_int8_sync_allreduce_trains(devices, tiny_model):
 
     mesh = make_mesh(8)
     m = tiny_model(axis_name="data")
-    st0 = create_train_state(m, jax.random.PRNGKey(0), server_sgd(0.1))
+
+    # Fresh state per call: the sync-DP step donates its state argument.
+    def st0():
+        return create_train_state(m, jax.random.PRNGKey(0), server_sgd(0.1))
 
     rng = np.random.default_rng(3)
     images = rng.integers(0, 255, (32, 32, 32, 3), dtype=np.uint8)
@@ -68,9 +71,9 @@ def test_int8_sync_allreduce_trains(devices, tiny_model):
     bi, bl = shard_batch(mesh, (images, labels))
 
     exact, _ = make_sync_dp_step(mesh, compression="none", augment=False)(
-        st0, bi, bl, jax.random.PRNGKey(1))
+        st0(), bi, bl, jax.random.PRNGKey(1))
     quant, _ = make_sync_dp_step(mesh, compression="int8", augment=False)(
-        st0, bi, bl, jax.random.PRNGKey(1))
+        st0(), bi, bl, jax.random.PRNGKey(1))
     for a, b in zip(jax.tree_util.tree_leaves(exact.params),
                     jax.tree_util.tree_leaves(quant.params)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
@@ -79,7 +82,7 @@ def test_int8_sync_allreduce_trains(devices, tiny_model):
     # short training run still learns
     d = synthetic_cifar100(n_train=512, n_test=64, num_classes=10, seed=5)
     step = make_sync_dp_step(mesh, compression="int8", augment=False)
-    st = st0
+    st = st0()
     losses = []
     for epoch in range(6):
         for xb, yb in make_batches(d.x_train, d.y_train, 64, seed=epoch):

@@ -67,13 +67,16 @@ def test_bf16_compression_close_to_fp32(devices, tiny_model, batch):
     images, labels = batch
     mesh = make_mesh(8)
     m = tiny_model(axis_name="data")
-    st = create_train_state(m, jax.random.PRNGKey(0), server_sgd(0.1))
     bi, bl = shard_batch(mesh, (images, labels))
 
+    # Fresh state per call: the sync-DP step donates its state argument.
+    def fresh_state():
+        return create_train_state(m, jax.random.PRNGKey(0), server_sgd(0.1))
+
     exact, _ = make_sync_dp_step(mesh, compression="none", augment=False)(
-        st, bi, bl, jax.random.PRNGKey(1))
+        fresh_state(), bi, bl, jax.random.PRNGKey(1))
     comp, _ = make_sync_dp_step(mesh, compression="bf16", augment=False)(
-        st, bi, bl, jax.random.PRNGKey(1))
+        fresh_state(), bi, bl, jax.random.PRNGKey(1))
     _tree_allclose(exact.params, comp.params, rtol=0.02, atol=1e-3)
 
 
