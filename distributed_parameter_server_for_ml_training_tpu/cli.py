@@ -142,8 +142,10 @@ def build_parser() -> argparse.ArgumentParser:
     e.add_argument("--modes", default="sync,async")
     e.add_argument("--worker-counts", default="4,8")
     e.add_argument("--out-dir", default="experiments/results")
-    e.add_argument("--backend", choices=["python", "native"],
-                   default="python")
+    e.add_argument("--backend", choices=["python", "native", "device"],
+                   default="python",
+                   help="'device' keeps store tensors in accelerator HBM "
+                        "(zero host<->device traffic per step)")
     e.add_argument("--no-plots", action="store_true")
     add_common(e)
 

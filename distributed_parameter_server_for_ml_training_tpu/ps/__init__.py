@@ -14,11 +14,13 @@ from .semantics import (
     sgd_apply,
     DEFAULT_STALENESS_BOUND,
 )
+from .device_store import DeviceParameterStore
 from .store import ParameterStore, StoreConfig
 from .worker import PSWorker, WorkerConfig, WorkerResult, run_workers
 
 __all__ = [
     "ParameterStore",
+    "DeviceParameterStore",
     "StoreConfig",
     "PSWorker",
     "WorkerConfig",

@@ -1,0 +1,228 @@
+"""Device-resident parameter store: the async/sync PS with params in HBM.
+
+The reference keeps canonical params in server RAM as numpy and moves the
+full ~45 MB parameter/gradient payload across the network on every fetch and
+push (server.py:96, 222, 245). :class:`~.store.ParameterStore` re-hosts that
+faithfully on the host CPU — which is the right shape for a *multi-host*
+deployment, but on a TPU host it forces two full host<->device transfers per
+worker step. This store is the TPU-native alternative for workers that share
+the accelerator:
+
+- canonical parameters live ON DEVICE as a flat ``{name: jax.Array}`` dict
+  (fp32, like server.py:96's state_dict copy),
+- ``fetch`` returns *references* to the current device arrays (jax arrays
+  are immutable, so a fetched snapshot stays consistent while later pushes
+  rebind the store to new arrays) — zero bytes moved,
+- ``push`` takes device gradient arrays straight from ``jax.grad`` and
+  applies the update with a jitted on-device SGD kernel — zero bytes moved,
+- aggregation math is identical to the reference: sync rounds mean each
+  parameter over the workers that supplied it then apply plain SGD
+  (server.py:145-169, 126-143); async applies immediately, down-weighted by
+  ``max(0.1, 1/(1+0.1*staleness))`` with rejection beyond the bound
+  (server.py:171-186). Staleness/step/membership bookkeeping stays in
+  host Python, same three-lock structure as the reference (server.py:97,
+  103, 114).
+
+No wire codec applies (``push_codec='none'``): nothing crosses a wire. The
+fp16-compression analogue for this path is the bf16/int8 *collective*
+compression in parallel/sync_dp.py.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .semantics import DEFAULT_STALENESS_BOUND, staleness_weight
+from .store import MembershipMixin, StoreConfig, _Stats
+
+
+@jax.jit
+def _sgd_apply_device(params: dict, grads: dict, scale):
+    """p <- p - scale * g for the params present in ``grads``
+    (server.py:126-143 apply_gradients; scale = lr * staleness_weight)."""
+    return {
+        k: (params[k] - scale * grads[k] if k in grads else params[k])
+        for k in params
+    }
+
+
+@jax.jit
+def _mean_grads_device(stacked: dict):
+    """Per-parameter mean over the leading (worker) axis
+    (server.py:145-169 aggregate_gradients_sync)."""
+    return {k: jnp.mean(v, axis=0) for k, v in stacked.items()}
+
+
+class DeviceParameterStore(MembershipMixin):
+    """Thread-safe parameter store whose tensors never leave the device.
+
+    API-compatible with :class:`~.store.ParameterStore` for in-process
+    workers (register/fetch/push/job_finished/metrics), with
+    ``keeps_device_arrays = True`` advertising that fetch returns jax arrays
+    and push expects them (PSWorker skips its host round-trip accordingly).
+    """
+
+    keeps_device_arrays = True
+    push_codec = "none"
+    fetch_codec = "none"
+
+    def __init__(self, initial_params: Mapping[str, np.ndarray],
+                 config: StoreConfig | None = None):
+        self.config = config or StoreConfig()
+        self.parameters: dict[str, jax.Array] = {
+            k: jnp.asarray(v, jnp.float32) for k, v in initial_params.items()
+        }
+        self.global_step = 0
+
+        self._param_lock = threading.Lock()
+        self._sync_lock = threading.Lock()
+        self._registration_lock = threading.Lock()
+
+        self._next_worker_id = 0
+        self.active_workers: set[int] = set()
+        self.last_seen: dict[int, float] = {}
+
+        self._pending: dict[int, dict[str, jax.Array]] = {}
+        self._gradients_received = 0
+
+        self.stats = _Stats()
+        self._finished_event = threading.Event()
+
+    # -- hot path ------------------------------------------------------------
+
+    def fetch(self, worker_id: int | None = None
+              ) -> tuple[dict[str, jax.Array], int]:
+        """Consistent (params, step) snapshot — references, not copies
+        (immutability makes the reference's copy-under-lock, server.py:222,
+        free here)."""
+        with self._param_lock:
+            payload = dict(self.parameters)
+            step = self.global_step
+        if worker_id is not None:
+            self.last_seen[worker_id] = time.time()
+        return payload, step
+
+    def push(self, worker_id: int, gradients: Mapping[str, jax.Array],
+             fetched_step: int) -> bool:
+        """Accept device-array gradients; apply per the configured mode.
+
+        Same accept/reject contract as ParameterStore.push (PushGradrients,
+        ps.proto:12): sync always accepts, async rejects past the staleness
+        bound.
+        """
+        self.last_seen[worker_id] = time.time()
+        for name, g in gradients.items():
+            p = self.parameters.get(name)
+            if p is not None and p.shape != g.shape:
+                self.stats.gradients_rejected += 1
+                print(f"rejecting push from worker {worker_id}: {name} "
+                      f"shape {g.shape} != server {p.shape}")
+                return False
+        if self.config.mode == "sync":
+            self._push_sync(worker_id, dict(gradients))
+            return True
+        return self._push_async(worker_id, dict(gradients), fetched_step)
+
+    def _push_sync(self, worker_id: int, grads: dict) -> None:
+        with self._sync_lock:
+            if self.config.strict_rounds:
+                self._pending[worker_id] = grads
+                self._gradients_received = len(self._pending)
+            else:
+                # Faithful quirk 3 (server.py:267-268): overwrite the entry,
+                # count the push anyway.
+                self._pending[worker_id] = grads
+                self._gradients_received += 1
+
+            if self._gradients_received >= self.config.total_workers:
+                t0 = time.time()
+                try:
+                    mean = self._aggregate(list(self._pending.values()))
+                    with self._param_lock:
+                        self.parameters = _sgd_apply_device(
+                            self.parameters, mean,
+                            jnp.float32(self.config.learning_rate))
+                        self.global_step += 1
+                    # Wait for the device to finish so update_times measures
+                    # the actual apply (comparable with the host backends),
+                    # not jax's async dispatch.
+                    jax.block_until_ready(self.parameters)
+                    self.stats.total_parameter_updates += 1
+                    self.stats.update_times.append(time.time() - t0)
+                finally:
+                    self._pending.clear()
+                    self._gradients_received = 0
+            self.stats.gradients_processed += 1
+
+    def _aggregate(self, grad_dicts: list[dict]) -> dict:
+        """Mean each parameter over the workers that supplied it
+        (server.py:145-169 iterates params independently, so partial pushes
+        average over their own supplier count)."""
+        names = {n for g in grad_dicts for n in g}
+        full = [n for n in names if all(n in g for g in grad_dicts)]
+        # Common case — every worker supplied every param — is one jitted
+        # stacked mean; stragglers (ragged pushes) are averaged per name.
+        mean = _mean_grads_device(
+            {n: jnp.stack([g[n] for g in grad_dicts]) for n in full})
+        for n in names:
+            if n not in mean:
+                have = [g[n] for g in grad_dicts if n in g]
+                mean[n] = jnp.mean(jnp.stack(have), axis=0)
+        return mean
+
+    def _push_async(self, worker_id: int, grads: dict,
+                    fetched_step: int) -> bool:
+        staleness = self.global_step - fetched_step
+        if staleness > self.config.staleness_bound:
+            self.stats.gradients_rejected += 1
+            return False
+        weight = staleness_weight(staleness)
+        t0 = time.time()
+        with self._param_lock:
+            self.parameters = _sgd_apply_device(
+                self.parameters, grads,
+                jnp.float32(self.config.learning_rate * weight))
+            self.global_step += 1
+        jax.block_until_ready(self.parameters)  # time the apply, not dispatch
+        self.stats.gradients_processed += 1
+        self.stats.total_parameter_updates += 1
+        self.stats.staleness_values.append(staleness)
+        self.stats.update_times.append(time.time() - t0)
+        return True
+
+    # -- observability (same schema as ParameterStore.metrics) ---------------
+
+    def metrics(self) -> dict:
+        elapsed = time.time() - self.stats.start_time
+        out = {
+            "mode": self.config.mode,
+            "total_workers": self.config.total_workers,
+            "total_training_time_seconds": round(elapsed, 2),
+            "global_steps_completed": self.global_step,
+            "total_parameter_updates": self.stats.total_parameter_updates,
+            "gradients_processed": self.stats.gradients_processed,
+            "average_update_time_seconds": (
+                round(float(np.mean(self.stats.update_times)), 6)
+                if self.stats.update_times else 0.0),
+            "updates_per_second": (
+                round(self.stats.total_parameter_updates / elapsed, 3)
+                if elapsed > 0 else 0.0),
+            "learning_rate": self.config.learning_rate,
+            "store_backend": "device",
+        }
+        if self.config.mode == "async":
+            sv = self.stats.staleness_values
+            out.update({
+                "staleness_bound": self.config.staleness_bound,
+                "gradients_rejected": self.stats.gradients_rejected,
+                "average_staleness": (round(float(np.mean(sv)), 3)
+                                      if sv else 0.0),
+                "max_staleness": int(max(sv)) if sv else 0,
+            })
+        return out

@@ -213,12 +213,18 @@ class PSWorker(threading.Thread):
                    fetched_step)
 
     def _push(self, worker_id, grads_tree, fetched_step) -> None:
-        flat = flatten_params(jax.device_get(grads_tree))
-        # Worker-side compression (worker.py:264-268): the store/service
-        # advertises its codec; the cast happens here, once, before the wire.
-        if getattr(self.store, "push_codec", "none") == "fp16":
-            from ..ops.compression import fp16_compress
-            flat = fp16_compress(flat)
+        if getattr(self.store, "keeps_device_arrays", False):
+            # Device-resident store: hand over the device arrays untouched —
+            # no host round-trip, no wire, no codec.
+            flat = flatten_params(grads_tree, as_numpy=False)
+        else:
+            flat = flatten_params(jax.device_get(grads_tree))
+            # Worker-side compression (worker.py:264-268): the store/service
+            # advertises its codec; the cast happens here, once, before the
+            # wire.
+            if getattr(self.store, "push_codec", "none") == "fp16":
+                from ..ops.compression import fp16_compress
+                flat = fp16_compress(flat)
         if self.store.push(worker_id, flat, fetched_step):
             self.result.pushes_accepted += 1
         else:

@@ -39,6 +39,11 @@ class BaselineConfig:
     plain_sgd: bool = False        # True = the distributed server optimizer
     model: str = "resnet18"        # models/registry.py name
     seed: int = 0
+    # True = run each epoch as ONE compiled program over a device-resident
+    # dataset (train/device_loop.py) — epochs at compute speed even on a
+    # remotely-attached chip. False = per-batch host dispatch (the
+    # reference's DataLoader shape, baseline_training.py:149-179).
+    device_loop: bool = False
 
 
 @dataclass
@@ -112,6 +117,12 @@ class BaselineTrainer:
         self._train_step = jax.jit(make_train_step(augment=cfg.augment),
                                    donate_argnums=0)
         self._eval_step = jax.jit(make_eval_step())
+        self._device_loop = None
+        if cfg.device_loop:
+            from .device_loop import DeviceEpochLoop
+            self._device_loop = DeviceEpochLoop(
+                dataset, make_train_step(augment=cfg.augment),
+                batch_size=cfg.batch_size)
         self.metrics = TrainingMetrics()
 
     def train_epoch(self, epoch: int) -> tuple[float, float]:
@@ -159,8 +170,17 @@ class BaselineTrainer:
                       f"(epoch {start_epoch})")
         for epoch in range(start_epoch, cfg.num_epochs + 1):
             t0 = time.time()
-            loss, train_acc = self.train_epoch(epoch)
-            test_acc = self.test_epoch()
+            if self._device_loop is not None:
+                self.state, em = self._device_loop.run_epoch(
+                    self.state,
+                    jax.random.fold_in(jax.random.PRNGKey(cfg.seed + 1),
+                                       epoch))
+                loss = em["train_loss"]
+                train_acc = 100.0 * em["train_accuracy"]
+                test_acc = 100.0 * em["test_accuracy"]
+            else:
+                loss, train_acc = self.train_epoch(epoch)
+                test_acc = self.test_epoch()
             dt = time.time() - t0
             self.metrics.add_epoch(epoch, loss, train_acc, test_acc, dt)
             print(f"epoch {epoch}/{cfg.num_epochs}: loss {loss:.4f} "

@@ -1,0 +1,114 @@
+"""On-device epoch loop: a whole training epoch as ONE compiled program.
+
+The reference's trainer re-fed every batch from a host DataLoader each epoch
+(baseline_training.py:149-179), which is fine on a local CPU but pathological
+for a remotely-attached accelerator: each dispatch pays link latency, and the
+batch bytes pay link bandwidth. Here the dataset is uploaded ONCE
+(CIFAR-100's 50k uint8 images are ~150 MB — trivial for HBM), and each epoch
+runs as one XLA program:
+
+    device-side shuffle (jax.random.permutation)
+    -> lax.scan over jitted train steps (gathered uint8 batches)
+    -> lax.scan over the test set for top-1
+    -> scalar metrics out.
+
+Only a handful of scalars cross the host<->device link per epoch, so epoch
+time approaches pure compute (~1.7 s for ResNet-18/CIFAR-100 at the measured
+~30k images/s/chip) regardless of link quality.
+
+Epoch semantics match data/cifar.py's host iterator: full shuffle, then
+``n // batch_size`` full batches with the remainder dropped
+(worker.py:182-187 used DataLoader(shuffle=True, drop_last default False —
+the reference *kept* ragged last batches; we drop them for static shapes and
+document the difference: <0.3% of data at batch 128).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..data.cifar import Dataset
+
+
+class DeviceEpochLoop:
+    """Compiled epoch runner over a device-resident dataset.
+
+    ``step_fn(state, images_u8, labels, rng) -> (state, {'loss','accuracy'})``
+    is any train step with the standard signature (single-chip
+    ``make_train_step`` or a sharded sync-DP step).
+    """
+
+    def __init__(self, dataset: Dataset, step_fn: Callable, *,
+                 batch_size: int, eval_batch_size: int = 1000,
+                 device_put: Callable = jnp.asarray):
+        self.batch_size = batch_size
+        n = (len(dataset.x_train) // batch_size) * batch_size
+        self.steps_per_epoch = n // batch_size
+        if self.steps_per_epoch == 0:
+            raise ValueError("dataset smaller than one batch")
+        self._n = n
+        x_tr = device_put(np.ascontiguousarray(dataset.x_train))
+        y_tr = device_put(np.ascontiguousarray(
+            dataset.y_train.astype(np.int32)))
+
+        # Pad the test set to a multiple of eval_batch_size with label -1
+        # (argmax is always >= 0, so padding never counts as correct).
+        n_te = len(dataset.x_test)
+        pad = (-n_te) % eval_batch_size
+        x_te = np.concatenate(
+            [dataset.x_test,
+             np.zeros((pad,) + dataset.x_test.shape[1:], np.uint8)])
+        y_te = np.concatenate(
+            [dataset.y_test.astype(np.int32), np.full((pad,), -1, np.int32)])
+        eb = eval_batch_size
+        x_te = device_put(x_te.reshape(-1, eb, *x_te.shape[1:]))
+        y_te = device_put(y_te.reshape(-1, eb))
+        self._n_test = n_te
+
+        steps, bs = self.steps_per_epoch, batch_size
+
+        n_total = len(dataset.x_train)
+
+        def epoch(state, key):
+            # Permute the FULL set, then keep the first n indices: the ragged
+            # tail is dropped at random each epoch (as the host iterator's
+            # shuffle-then-truncate does), not excluded permanently.
+            perm = jax.random.permutation(key, n_total)[:n].reshape(steps, bs)
+
+            def train_body(st, idx):
+                xb = jnp.take(x_tr, idx, axis=0)
+                yb = jnp.take(y_tr, idx, axis=0)
+                st, m = step_fn(st, xb, yb, key)
+                return st, (m["loss"], m["accuracy"])
+
+            state, (losses, accs) = jax.lax.scan(train_body, state, perm)
+
+            def eval_body(carry, batch):
+                xb, yb = batch
+                from .steps import _variables
+                from ..data.cifar import normalize
+                logits = state.apply_fn(
+                    _variables(state.params, state.batch_stats),
+                    normalize(xb), train=False)
+                return carry + jnp.sum(jnp.argmax(logits, -1) == yb), None
+
+            correct, _ = jax.lax.scan(
+                eval_body, jnp.zeros((), jnp.int32), (x_te, y_te))
+            metrics = {
+                "train_loss": jnp.mean(losses),
+                "train_accuracy": jnp.mean(accs),
+                "test_accuracy": correct / self._n_test,
+            }
+            return state, metrics
+
+        self._epoch = jax.jit(epoch, donate_argnums=0)
+
+    def run_epoch(self, state, key):
+        """One epoch; returns (state, scalar metrics dict). The input state
+        is donated."""
+        state, metrics = self._epoch(state, key)
+        return state, {k: float(v) for k, v in metrics.items()}

@@ -17,9 +17,16 @@ from flax import traverse_util
 PyTree = Any
 
 
-def flatten_params(tree: PyTree) -> dict[str, np.ndarray]:
-    """Nested params pytree -> flat {'a/b/c': np.ndarray} dict."""
+def flatten_params(tree: PyTree, *, as_numpy: bool = True
+                   ) -> dict[str, np.ndarray]:
+    """Nested params pytree -> flat {'a/b/c': np.ndarray} dict.
+
+    ``as_numpy=False`` keeps the leaves as-is (device arrays stay on device
+    — required by the zero-copy DeviceParameterStore path).
+    """
     flat = traverse_util.flatten_dict(tree, sep="/")
+    if not as_numpy:
+        return dict(flat)
     return {k: np.asarray(v) for k, v in flat.items()}
 
 
