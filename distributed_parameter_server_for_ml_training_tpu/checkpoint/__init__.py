@@ -10,8 +10,10 @@ state holders checkpoint natively:
 
 from .manager import (
     CheckpointManager,
+    PeriodicStoreCheckpointer,
     restore_store,
     save_store,
 )
 
-__all__ = ["CheckpointManager", "save_store", "restore_store"]
+__all__ = ["CheckpointManager", "PeriodicStoreCheckpointer", "save_store",
+           "restore_store"]

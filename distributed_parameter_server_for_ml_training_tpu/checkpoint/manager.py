@@ -77,17 +77,27 @@ class CheckpointManager:
 # -- async store snapshots ----------------------------------------------------
 
 def save_store(store: ParameterStore, directory: str) -> str:
-    """Atomic snapshot of the ParameterStore: params npz + metadata JSON.
+    """Atomic snapshot of a parameter store: params npz + metadata JSON.
 
+    Works for both the host-numpy ParameterStore and the HBM-resident
+    DeviceParameterStore (whose jax arrays are immutable — the reference
+    grab stays consistent; np.savez pulls them to host once per snapshot).
     Enables the <30 s recovery the reference targeted but never built
     (baseline_summary.json distributed_system_targets; SURVEY.md §4).
     """
     os.makedirs(directory, exist_ok=True)
     step = store.global_step
+    device_arrays = getattr(store, "keeps_device_arrays", False)
     with store._param_lock:  # consistent (params, step) pair
-        arrays = {k: v.copy() for k, v in store.parameters.items()}
+        arrays = {k: (v if device_arrays else v.copy())
+                  for k, v in store.parameters.items()}
         step = store.global_step
-    tmp = os.path.join(directory, ".tmp.npz")
+    if device_arrays:
+        arrays = {k: np.asarray(v) for k, v in arrays.items()}
+    # Unique temp name per call: concurrent snapshots (periodic thread +
+    # final snapshot) must never interleave writes into one file.
+    tmp = os.path.join(directory,
+                       f".tmp-{os.getpid()}-{threading.get_ident()}.npz")
     np.savez(tmp, **arrays)
     final = os.path.join(directory, f"store_{step:08d}.npz")
     os.replace(tmp, final)
@@ -121,9 +131,13 @@ def restore_store(store: ParameterStore, directory: str,
     with open(os.path.join(directory,
                            name.replace(".npz", ".json"))) as f:
         meta = json.load(f)
+    if getattr(store, "keeps_device_arrays", False):
+        import jax.numpy as jnp
+        params = {k: jnp.asarray(data[k], jnp.float32) for k in data.files}
+    else:
+        params = {k: np.array(data[k], np.float32) for k in data.files}
     with store._param_lock:
-        store.parameters = {k: np.array(data[k], np.float32) for k in
-                            data.files}
+        store.parameters = params
         store.global_step = int(meta["global_step"])
     return store.global_step
 
@@ -137,13 +151,17 @@ class PeriodicStoreCheckpointer(threading.Thread):
         self.store = store
         self.directory = directory
         self.interval = interval
-        self._stop = threading.Event()
+        # NB: must not be named _stop — that would shadow
+        # threading.Thread._stop(), which join() calls internally.
+        self._stop_event = threading.Event()
 
     def run(self):
-        while not self._stop.wait(self.interval):
+        while not self._stop_event.wait(self.interval):
             save_store(self.store, self.directory)
 
     def stop(self, final_snapshot: bool = True):
-        self._stop.set()
+        self._stop_event.set()
+        if self.is_alive():
+            self.join()  # let an in-flight periodic snapshot finish first
         if final_snapshot:
             save_store(self.store, self.directory)

@@ -13,6 +13,7 @@ Both emit the METRICS_JSON lines the reference's ETL expects (SURVEY.md §5.5).
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass
 
@@ -43,6 +44,8 @@ class DistributedConfig:
     staleness_bound: int = 5       # server.py:418
     compression: str = "bf16"      # sync all-reduce dtype
     strict_rounds: bool = False
+    elastic: bool = False          # elastic membership (StoreConfig.elastic)
+    worker_timeout: float | None = None  # liveness expiry (seconds)
     augment: bool = True
     num_classes: int = 100
     dtype: str = "bfloat16"
@@ -105,12 +108,32 @@ class SyncTrainer:
             return shard_batch_global(self.mesh, batch)
         return shard_batch(self.mesh, batch)
 
-    def train(self, emit_metrics: bool = False) -> dict:
+    def train(self, emit_metrics: bool = False,
+              checkpoint_dir: str | None = None,
+              resume: bool = False) -> dict:
         cfg = self.config
         global_batch = cfg.batch_size * cfg.num_workers
         rng = jax.random.PRNGKey(cfg.seed + 1)
+
+        # Orbax checkpoint per epoch (the recovery story the reference only
+        # planned: DEPLOYMENT.md:309, <30 s target in baseline_summary.json).
+        mgr = None
+        start_epoch = 0
+        if checkpoint_dir:
+            from ..checkpoint import CheckpointManager
+            mgr = CheckpointManager(checkpoint_dir)
+            if resume and mgr.latest_step() is not None:
+                self.state = mgr.restore(self.state)
+                steps_per_epoch = max(
+                    1, len(self.dataset.x_train) // global_batch)
+                self.global_steps = int(self.state.step)
+                start_epoch = self.global_steps // steps_per_epoch
+                if jax.process_index() == 0:
+                    print(f"resumed from step {self.global_steps} "
+                          f"(epoch {start_epoch + 1})")
+
         t_start = time.time()
-        for epoch in range(cfg.num_epochs):
+        for epoch in range(start_epoch, cfg.num_epochs):
             t0 = time.time()
             losses = []
             for xb, yb in make_batches(self.dataset.x_train,
@@ -133,7 +156,12 @@ class SyncTrainer:
                 print(f"[sync x{cfg.num_workers}] epoch {epoch + 1}: "
                       f"loss {float(np.mean([float(l) for l in losses])):.4f} "
                       f"test {acc:.2%} ({self.epoch_times[-1]:.1f}s)")
+            if mgr is not None and jax.process_index() == 0:
+                # State is replicated; process 0's copy is the full model.
+                mgr.save(self.state)
         total = time.time() - t_start
+        if mgr is not None:
+            mgr.close()
 
         server_metrics = {
             "mode": "sync",
@@ -205,17 +233,44 @@ class AsyncTrainer:
             StoreConfig(mode=cfg.mode, total_workers=cfg.num_workers,
                         learning_rate=cfg.learning_rate,
                         staleness_bound=cfg.staleness_bound,
-                        strict_rounds=cfg.strict_rounds))
+                        strict_rounds=cfg.strict_rounds,
+                        elastic=cfg.elastic,
+                        worker_timeout=cfg.worker_timeout))
 
-    def train(self, emit_metrics: bool = False) -> dict:
+    def train(self, emit_metrics: bool = False,
+              checkpoint_dir: str | None = None,
+              resume: bool = False,
+              checkpoint_interval: float = 30.0) -> dict:
         cfg = self.config
-        results = run_workers(
-            self.store, self.model, self.dataset, cfg.num_workers,
-            WorkerConfig(batch_size=cfg.batch_size,
-                         num_epochs=cfg.num_epochs,
-                         sync_steps=cfg.sync_steps,
-                         k_step_mode=cfg.k_step_mode,
-                         augment=cfg.augment, seed=cfg.seed))
+        ckpt = None
+        if checkpoint_dir:
+            from ..checkpoint import (PeriodicStoreCheckpointer,
+                                      restore_store)
+            if resume and os.path.isdir(checkpoint_dir) and any(
+                    f.endswith(".npz") for f in os.listdir(checkpoint_dir)):
+                step = restore_store(self.store, checkpoint_dir)
+                print(f"resumed store from global step {step}")
+            ckpt = PeriodicStoreCheckpointer(self.store, checkpoint_dir,
+                                             interval=checkpoint_interval)
+            ckpt.start()
+        try:
+            results = run_workers(
+                self.store, self.model, self.dataset, cfg.num_workers,
+                WorkerConfig(batch_size=cfg.batch_size,
+                             num_epochs=cfg.num_epochs,
+                             sync_steps=cfg.sync_steps,
+                             k_step_mode=cfg.k_step_mode,
+                             augment=cfg.augment, seed=cfg.seed,
+                             # With expiry on, workers must prove liveness
+                             # even while their first step COMPILES (which
+                             # can exceed the timeout): the heartbeat fetch
+                             # starts before compilation.
+                             heartbeat_interval=(cfg.worker_timeout / 3
+                                                 if cfg.worker_timeout
+                                                 else 0.0)))
+        finally:
+            if ckpt is not None:
+                ckpt.stop(final_snapshot=True)
         server_metrics = self.store.metrics()
         if emit_metrics:
             emit_metrics_json(server_metrics)
