@@ -95,6 +95,12 @@ def build_parser() -> argparse.ArgumentParser:
                    default="bf16", help="sync all-reduce precision")
     t.add_argument("--strict-rounds", action="store_true",
                    help="corrected sync-round semantics (vs quirk 3)")
+    t.add_argument("--elastic", action="store_true",
+                   help="elastic membership: id-slot reuse on join, sync "
+                        "rounds sized to live workers (vs reference "
+                        "restart pollution, README.md:368-371)")
+    t.add_argument("--worker-timeout", type=float, default=None,
+                   help="expire workers unseen for this many seconds")
     t.add_argument("--plot", default=None, help="save a results plot (png)")
     t.add_argument("--checkpoint-dir", default=None,
                    help="save checkpoints each epoch (gap-fill, SURVEY §5.4)")
@@ -134,6 +140,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="input resolution used to init the store's params")
     s.add_argument("--seed", type=int, default=0)
     s.add_argument("--emit-metrics", action="store_true")
+    s.add_argument("--elastic", action="store_true",
+                   help="elastic membership (id reuse + live round sizing)")
+    s.add_argument("--worker-timeout", type=float, default=None)
     add_platform(s)
 
     e = sub.add_parser("experiments",
@@ -159,6 +168,9 @@ def build_parser() -> argparse.ArgumentParser:
                    default=_env("SYNC_STEPS", 1, int))
     w.add_argument("--k-step-mode", choices=["faithful", "accumulate"],
                    default="faithful")
+    w.add_argument("--heartbeat", type=float, default=0.0,
+                   help="liveness ping interval in seconds (pair with the "
+                        "server's --worker-timeout); 0 disables")
     add_common(w)
 
     return p
@@ -226,12 +238,15 @@ def cmd_train(args) -> int:
         num_epochs=args.epochs, batch_size=args.batch_size,
         sync_steps=args.sync_steps, k_step_mode=args.k_step_mode,
         staleness_bound=args.staleness_bound, compression=args.compression,
-        strict_rounds=args.strict_rounds, augment=not args.no_augment,
+        strict_rounds=args.strict_rounds, elastic=args.elastic,
+        worker_timeout=args.worker_timeout, augment=not args.no_augment,
         dtype=args.dtype, model=args.model, num_classes=num_classes,
         seed=args.seed)
     trainer = (SyncTrainer if args.mode == "sync" else AsyncTrainer)(
         dataset, cfg)
-    metrics = trainer.train(emit_metrics=args.emit_metrics)
+    metrics = trainer.train(emit_metrics=args.emit_metrics,
+                            checkpoint_dir=args.checkpoint_dir,
+                            resume=args.resume)
     print(f"done: {metrics}", file=sys.stderr)
     return 0
 
@@ -257,15 +272,20 @@ def cmd_serve(args) -> int:
         flatten_params(variables["params"]),
         StoreConfig(mode=args.mode, total_workers=args.workers,
                     learning_rate=args.lr,
-                    staleness_bound=args.staleness_bound))
+                    staleness_bound=args.staleness_bound,
+                    elastic=args.elastic,
+                    worker_timeout=args.worker_timeout))
     server, port = serve(store, port=args.port)
     print(f"parameter server up on :{port} "
           f"(mode={args.mode}, workers={args.workers})", file=sys.stderr)
     try:
         # server.py:399-403 sleep-forever loop, but exiting cleanly once all
-        # registered workers report JobFinished.
+        # registered workers report JobFinished — and, with --worker-timeout,
+        # expiring silent workers each tick (failure-detection reaper).
         while not store.wait_all_finished(timeout=1.0):
-            pass
+            expired = store.expire_stale_workers()
+            if expired:
+                print(f"expired silent workers: {expired}", file=sys.stderr)
         time.sleep(0.5)
     except KeyboardInterrupt:
         pass
@@ -293,7 +313,8 @@ def cmd_worker(args) -> int:
     cfg = WorkerConfig(batch_size=args.batch_size, num_epochs=args.epochs,
                        sync_steps=args.sync_steps,
                        k_step_mode=args.k_step_mode,
-                       augment=not args.no_augment, seed=args.seed)
+                       augment=not args.no_augment, seed=args.seed,
+                       heartbeat_interval=args.heartbeat)
     worker = PSWorker(store, model, dataset, cfg,
                       worker_name=args.worker_name)
     worker.start()

@@ -14,14 +14,14 @@ the accelerator:
   are immutable, so a fetched snapshot stays consistent while later pushes
   rebind the store to new arrays) — zero bytes moved,
 - ``push`` takes device gradient arrays straight from ``jax.grad`` and
-  applies the update with a jitted on-device SGD kernel — zero bytes moved,
-- aggregation math is identical to the reference: sync rounds mean each
-  parameter over the workers that supplied it then apply plain SGD
-  (server.py:145-169, 126-143); async applies immediately, down-weighted by
-  ``max(0.1, 1/(1+0.1*staleness))`` with rejection beyond the bound
-  (server.py:171-186). Staleness/step/membership bookkeeping stays in
-  host Python, same three-lock structure as the reference (server.py:97,
-  103, 114).
+  applies the update with a jitted on-device SGD kernel — zero bytes moved.
+
+Aggregation/membership orchestration (sync rounds, bounded staleness,
+elastic expiry, metrics) is shared with the host store via
+:class:`~.store.AggregationBase` — only the three kernels differ (jitted
+device mean/apply + a block_until_ready so update timings measure compute,
+not dispatch). Staleness math is therefore identical to the reference
+(server.py:145-169, 126-143, 171-186).
 
 No wire codec applies (``push_codec='none'``): nothing crosses a wire. The
 fp16-compression analogue for this path is the bf16/int8 *collective*
@@ -31,15 +31,15 @@ compression in parallel/sync_dp.py.
 from __future__ import annotations
 
 import threading
-import time
 from typing import Mapping
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .semantics import DEFAULT_STALENESS_BOUND, staleness_weight
-from .store import MembershipMixin, StoreConfig, _Stats
+import time
+
+from .store import AggregationBase, StoreConfig, _Stats
 
 
 @jax.jit
@@ -59,7 +59,7 @@ def _mean_grads_device(stacked: dict):
     return {k: jnp.mean(v, axis=0) for k, v in stacked.items()}
 
 
-class DeviceParameterStore(MembershipMixin):
+class DeviceParameterStore(AggregationBase):
     """Thread-safe parameter store whose tensors never leave the device.
 
     API-compatible with :class:`~.store.ParameterStore` for in-process
@@ -69,6 +69,7 @@ class DeviceParameterStore(MembershipMixin):
     """
 
     keeps_device_arrays = True
+    store_backend = "device"
     push_codec = "none"
     fetch_codec = "none"
 
@@ -129,38 +130,9 @@ class DeviceParameterStore(MembershipMixin):
             return True
         return self._push_async(worker_id, dict(gradients), fetched_step)
 
-    def _push_sync(self, worker_id: int, grads: dict) -> None:
-        with self._sync_lock:
-            if self.config.strict_rounds:
-                self._pending[worker_id] = grads
-                self._gradients_received = len(self._pending)
-            else:
-                # Faithful quirk 3 (server.py:267-268): overwrite the entry,
-                # count the push anyway.
-                self._pending[worker_id] = grads
-                self._gradients_received += 1
+    # -- aggregation kernels (orchestration in AggregationBase) --------------
 
-            if self._gradients_received >= self.config.total_workers:
-                t0 = time.time()
-                try:
-                    mean = self._aggregate(list(self._pending.values()))
-                    with self._param_lock:
-                        self.parameters = _sgd_apply_device(
-                            self.parameters, mean,
-                            jnp.float32(self.config.learning_rate))
-                        self.global_step += 1
-                    # Wait for the device to finish so update_times measures
-                    # the actual apply (comparable with the host backends),
-                    # not jax's async dispatch.
-                    jax.block_until_ready(self.parameters)
-                    self.stats.total_parameter_updates += 1
-                    self.stats.update_times.append(time.time() - t0)
-                finally:
-                    self._pending.clear()
-                    self._gradients_received = 0
-            self.stats.gradients_processed += 1
-
-    def _aggregate(self, grad_dicts: list[dict]) -> dict:
+    def _mean(self, grad_dicts: list) -> dict:
         """Mean each parameter over the workers that supplied it
         (server.py:145-169 iterates params independently, so partial pushes
         average over their own supplier count)."""
@@ -176,53 +148,11 @@ class DeviceParameterStore(MembershipMixin):
                 mean[n] = jnp.mean(jnp.stack(have), axis=0)
         return mean
 
-    def _push_async(self, worker_id: int, grads: dict,
-                    fetched_step: int) -> bool:
-        staleness = self.global_step - fetched_step
-        if staleness > self.config.staleness_bound:
-            self.stats.gradients_rejected += 1
-            return False
-        weight = staleness_weight(staleness)
-        t0 = time.time()
-        with self._param_lock:
-            self.parameters = _sgd_apply_device(
-                self.parameters, grads,
-                jnp.float32(self.config.learning_rate * weight))
-            self.global_step += 1
-        jax.block_until_ready(self.parameters)  # time the apply, not dispatch
-        self.stats.gradients_processed += 1
-        self.stats.total_parameter_updates += 1
-        self.stats.staleness_values.append(staleness)
-        self.stats.update_times.append(time.time() - t0)
-        return True
+    def _apply(self, grads: dict, lr: float, weight: float = 1.0) -> None:
+        self.parameters = _sgd_apply_device(
+            self.parameters, grads, jnp.float32(lr * weight))
 
-    # -- observability (same schema as ParameterStore.metrics) ---------------
-
-    def metrics(self) -> dict:
-        elapsed = time.time() - self.stats.start_time
-        out = {
-            "mode": self.config.mode,
-            "total_workers": self.config.total_workers,
-            "total_training_time_seconds": round(elapsed, 2),
-            "global_steps_completed": self.global_step,
-            "total_parameter_updates": self.stats.total_parameter_updates,
-            "gradients_processed": self.stats.gradients_processed,
-            "average_update_time_seconds": (
-                round(float(np.mean(self.stats.update_times)), 6)
-                if self.stats.update_times else 0.0),
-            "updates_per_second": (
-                round(self.stats.total_parameter_updates / elapsed, 3)
-                if elapsed > 0 else 0.0),
-            "learning_rate": self.config.learning_rate,
-            "store_backend": "device",
-        }
-        if self.config.mode == "async":
-            sv = self.stats.staleness_values
-            out.update({
-                "staleness_bound": self.config.staleness_bound,
-                "gradients_rejected": self.stats.gradients_rejected,
-                "average_staleness": (round(float(np.mean(sv)), 3)
-                                      if sv else 0.0),
-                "max_staleness": int(max(sv)) if sv else 0,
-            })
-        return out
+    def _after_apply(self) -> None:
+        # Wait for the device so update_times measures the actual apply
+        # (comparable with the host backends), not jax's async dispatch.
+        jax.block_until_ready(self.parameters)

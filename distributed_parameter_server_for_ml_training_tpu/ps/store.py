@@ -61,6 +61,16 @@ class StoreConfig:
     # (SURVEY.md quirk 10). None reproduces that; a number of seconds turns
     # on the corrected behavior via expire_stale_workers().
     worker_timeout: float | None = None
+    # Elastic membership (net-new; the reference's only "elasticity" was ECS
+    # restarting tasks, which inflated worker ids and skewed shards,
+    # README.md:368-371). When True:
+    #   - a registering worker takes the LOWEST free id slot, so a
+    #     replacement adopts the dead worker's data shard,
+    #   - sync rounds complete at the CURRENT active-worker count instead of
+    #     the fixed total, so training continues while a slot is empty,
+    #   - expiry purges the dead worker's pending gradients and completes
+    #     the round if the survivors already cover it.
+    elastic: bool = False
 
     def __post_init__(self):
         if self.mode not in ("sync", "async"):
@@ -94,10 +104,20 @@ class MembershipMixin:
     """
 
     def register_worker(self, worker_name: str = "") -> tuple[int, int]:
-        """Returns (worker_id, total_workers)."""
+        """Returns (worker_id, total_workers).
+
+        Faithful mode assigns strictly sequential ids (server.py:193-194);
+        elastic mode reuses the lowest free slot so a replacement worker
+        adopts the departed worker's shard.
+        """
         with self._registration_lock:
-            worker_id = self._next_worker_id
-            self._next_worker_id += 1
+            if getattr(self.config, "elastic", False):
+                worker_id = next(i for i in range(len(self.active_workers) + 1)
+                                 if i not in self.active_workers)
+                self._next_worker_id = max(self._next_worker_id, worker_id + 1)
+            else:
+                worker_id = self._next_worker_id
+                self._next_worker_id += 1
             self.active_workers.add(worker_id)
             self.last_seen[worker_id] = time.time()
         return worker_id, self.config.total_workers
@@ -107,11 +127,25 @@ class MembershipMixin:
         with self._registration_lock:
             self.active_workers.discard(worker_id)
             empty = not self.active_workers
+        # Elastic: a departure shrinks the round target, so the pending
+        # round may already be satisfied by the survivors — the same
+        # re-evaluation expiry does (otherwise their final gradients drop).
+        self._on_workers_expired([worker_id])
         if empty:
             self._finished_event.set()
 
     def wait_all_finished(self, timeout: float | None = None) -> bool:
         return self._finished_event.wait(timeout)
+
+    def _round_target(self) -> int:
+        """Sync-round completion size: fixed total (server.py:271-274) or,
+        in elastic mode, the live membership count."""
+        if getattr(self.config, "elastic", False):
+            return max(1, len(self.active_workers))
+        return self.config.total_workers
+
+    def _on_workers_expired(self, stale: list[int]) -> None:
+        """Hook for stores to clean round state after expiry (no-op here)."""
 
     def expire_stale_workers(self) -> list[int]:
         """Failure detection: drop workers not seen within the timeout —
@@ -125,12 +159,138 @@ class MembershipMixin:
             for w in stale:
                 self.active_workers.discard(w)
             empty = not self.active_workers
+        if stale:
+            self._on_workers_expired(stale)
         if stale and empty:
             self._finished_event.set()
         return stale
 
 
-class ParameterStore(MembershipMixin):
+class AggregationBase(MembershipMixin):
+    """Sync-round / async-apply orchestration shared by every in-process
+    store backend (host numpy, device HBM). Subclasses supply the three
+    kernels — ``_mean(grad_dicts)``, ``_apply(grads, lr, weight)`` (must
+    bump ``global_step`` under ``_param_lock`` semantics chosen by the
+    subclass) is split here as apply-only; and ``_after_apply()`` (e.g.
+    device sync) — plus the ``store_backend`` label for metrics.
+    """
+
+    store_backend = "python"
+
+    def _mean(self, grad_dicts: list) -> dict:
+        raise NotImplementedError
+
+    def _apply(self, grads: dict, lr: float, weight: float = 1.0) -> None:
+        """Apply p -= lr*weight*g to self.parameters (no locking here)."""
+        raise NotImplementedError
+
+    def _after_apply(self) -> None:
+        """Hook after an update is issued (device store waits here so
+        update_times measures the apply, not async dispatch)."""
+
+    def _push_sync(self, worker_id: int, grads: dict) -> None:
+        """server.py:264-288: stash under sync_lock; when the round is full,
+        mean + apply + reset. No barrier — returns immediately."""
+        with self._sync_lock:
+            if self.config.strict_rounds:
+                # Corrected semantics: count distinct workers.
+                self._pending[worker_id] = grads
+                self._gradients_received = len(self._pending)
+            else:
+                # Faithful quirk 3 (server.py:267-268): overwrite the entry,
+                # increment the count anyway.
+                self._pending[worker_id] = grads
+                self._gradients_received += 1
+            self._maybe_complete_round_locked()
+            self.stats.gradients_processed += 1
+
+    def _maybe_complete_round_locked(self) -> None:
+        """Aggregate + apply + reset if the round reached its target
+        (caller holds ``_sync_lock``)."""
+        if self._gradients_received >= self._round_target():
+            t0 = time.time()
+            try:
+                mean = self._mean(list(self._pending.values()))
+                with self._param_lock:
+                    self._apply(mean, self.config.learning_rate)
+                    self.global_step += 1
+                self._after_apply()
+                self.stats.total_parameter_updates += 1
+                self.stats.update_times.append(time.time() - t0)
+            finally:
+                # The round MUST reset even if aggregation raises —
+                # otherwise every later push re-triggers the failure and
+                # the server is wedged permanently.
+                self._pending.clear()
+                self._gradients_received = 0
+
+    def _on_workers_expired(self, stale: list[int]) -> None:
+        """Elastic: purge departed workers' pending gradients and complete
+        the round if the survivors already cover the reduced target."""
+        if not getattr(self.config, "elastic", False):
+            return
+        with self._sync_lock:
+            for w in stale:
+                self._pending.pop(w, None)
+            if self._pending or self._gradients_received:
+                self._gradients_received = len(self._pending)
+                self._maybe_complete_round_locked()
+
+    def _push_async(self, worker_id: int, grads: dict,
+                    fetched_step: int) -> bool:
+        """server.py:290-304 + 171-186: bounded staleness with down-weighted
+        immediate apply."""
+        staleness = self.global_step - fetched_step
+        if staleness > self.config.staleness_bound:
+            self.stats.gradients_rejected += 1
+            return False
+        weight = staleness_weight(staleness)
+        t0 = time.time()
+        with self._param_lock:
+            self._apply(grads, self.config.learning_rate, weight)
+            self.global_step += 1
+        self._after_apply()
+        self.stats.gradients_processed += 1
+        self.stats.total_parameter_updates += 1
+        self.stats.staleness_values.append(staleness)
+        self.stats.update_times.append(time.time() - t0)
+        return True
+
+    # -- observability -------------------------------------------------------
+
+    def metrics(self) -> dict:
+        """Final-statistics fields, matching the server's METRICS_JSON
+        (server.py:349-366; SURVEY.md §5.5)."""
+        elapsed = time.time() - self.stats.start_time
+        out = {
+            "mode": self.config.mode,
+            "total_workers": self.config.total_workers,
+            "total_training_time_seconds": round(elapsed, 2),
+            "global_steps_completed": self.global_step,
+            "total_parameter_updates": self.stats.total_parameter_updates,
+            "gradients_processed": self.stats.gradients_processed,
+            "average_update_time_seconds": (
+                round(float(np.mean(self.stats.update_times)), 6)
+                if self.stats.update_times else 0.0),
+            "updates_per_second": (
+                round(self.stats.total_parameter_updates / elapsed, 3)
+                if elapsed > 0 else 0.0),
+            "learning_rate": self.config.learning_rate,
+            "store_backend": self.store_backend,
+        }
+        if self.config.mode == "async":
+            sv = self.stats.staleness_values
+            out.update({
+                "staleness_bound": self.config.staleness_bound,
+                "gradients_rejected": self.stats.gradients_rejected,
+                "average_staleness": (round(float(np.mean(sv)), 3)
+                                      if sv else 0.0),
+                "max_staleness": int(max(sv)) if sv else 0,
+            })
+        return out
+
+
+class ParameterStore(AggregationBase):
     """Thread-safe canonical parameter holder + sync/async aggregator."""
 
     def __init__(self, initial_params: Mapping[str, np.ndarray],
@@ -219,87 +379,10 @@ class ParameterStore(MembershipMixin):
             return True
         return self._push_async(worker_id, gradients, fetched_step)
 
-    # -- aggregation ---------------------------------------------------------
+    # -- aggregation kernels (orchestration in AggregationBase) --------------
 
-    def _push_sync(self, worker_id: int, grads: dict[str, np.ndarray]) -> None:
-        """server.py:264-288: stash under sync_lock; when the round is full,
-        mean + apply + reset. No barrier — returns immediately."""
-        with self._sync_lock:
-            if self.config.strict_rounds:
-                # Corrected semantics: count distinct workers.
-                self._pending[worker_id] = grads
-                self._gradients_received = len(self._pending)
-            else:
-                # Faithful quirk 3: overwrite entry, increment count anyway.
-                self._pending[worker_id] = grads
-                self._gradients_received += 1
+    def _mean(self, grad_dicts: list) -> dict:
+        return mean_gradients(grad_dicts)
 
-            if self._gradients_received >= self.config.total_workers:
-                t0 = time.time()
-                try:
-                    mean = mean_gradients(self._pending.values())
-                    with self._param_lock:
-                        sgd_apply(self.parameters, mean,
-                                  self.config.learning_rate)
-                        self.global_step += 1
-                    self.stats.total_parameter_updates += 1
-                    self.stats.update_times.append(time.time() - t0)
-                finally:
-                    # The round MUST reset even if aggregation raises —
-                    # otherwise every later push re-triggers the failure and
-                    # the server is wedged permanently.
-                    self._pending.clear()
-                    self._gradients_received = 0
-            self.stats.gradients_processed += 1
-
-    def _push_async(self, worker_id: int, grads: dict[str, np.ndarray],
-                    fetched_step: int) -> bool:
-        """server.py:290-304 + 171-186: bounded staleness with down-weighted
-        immediate apply."""
-        staleness = self.global_step - fetched_step
-        if staleness > self.config.staleness_bound:
-            self.stats.gradients_rejected += 1
-            return False
-        weight = staleness_weight(staleness)
-        t0 = time.time()
-        with self._param_lock:
-            sgd_apply(self.parameters, grads, self.config.learning_rate,
-                      weight=weight)
-            self.global_step += 1
-        self.stats.gradients_processed += 1
-        self.stats.total_parameter_updates += 1
-        self.stats.staleness_values.append(staleness)
-        self.stats.update_times.append(time.time() - t0)
-        return True
-
-    # -- observability -------------------------------------------------------
-
-    def metrics(self) -> dict:
-        """Final-statistics fields, matching the server's METRICS_JSON
-        (server.py:349-366; SURVEY.md §5.5)."""
-        elapsed = time.time() - self.stats.start_time
-        out = {
-            "mode": self.config.mode,
-            "total_workers": self.config.total_workers,
-            "total_training_time_seconds": round(elapsed, 2),
-            "global_steps_completed": self.global_step,
-            "total_parameter_updates": self.stats.total_parameter_updates,
-            "gradients_processed": self.stats.gradients_processed,
-            "average_update_time_seconds": (
-                round(float(np.mean(self.stats.update_times)), 6)
-                if self.stats.update_times else 0.0),
-            "updates_per_second": (
-                round(self.stats.total_parameter_updates / elapsed, 3)
-                if elapsed > 0 else 0.0),
-            "learning_rate": self.config.learning_rate,
-        }
-        if self.config.mode == "async":
-            sv = self.stats.staleness_values
-            out.update({
-                "staleness_bound": self.config.staleness_bound,
-                "gradients_rejected": self.stats.gradients_rejected,
-                "average_staleness": (round(float(np.mean(sv)), 3)
-                                      if sv else 0.0),
-                "max_staleness": int(max(sv)) if sv else 0,
-            })
-        return out
+    def _apply(self, grads: dict, lr: float, weight: float = 1.0) -> None:
+        sgd_apply(self.parameters, grads, lr, weight=weight)

@@ -265,8 +265,23 @@ def run_workers(store: ParameterStore, model, dataset: Dataset,
     ]
     for w in workers:
         w.start()
-    for w in workers:
-        w.join(timeout)
+    # Failure-detection reaper: with a worker_timeout configured, expire
+    # silent workers periodically so elastic rounds shrink instead of
+    # wedging on a dead worker (the capability behind --worker-timeout).
+    reaper_stop = threading.Event()
+    wt = getattr(store.config, "worker_timeout", None)
+    if wt:
+        def _reap():
+            while not reaper_stop.wait(wt / 2):
+                expired = store.expire_stale_workers()
+                if expired:
+                    print(f"expired silent workers: {expired}")
+        threading.Thread(target=_reap, daemon=True).start()
+    try:
+        for w in workers:
+            w.join(timeout)
+    finally:
+        reaper_stop.set()
     for w in workers:
         if w.result.error is not None:
             raise w.result.error

@@ -1,0 +1,218 @@
+"""Elastic membership v1: slot reuse, live round sizing, expiry purge.
+
+The reference's only elasticity was ECS restarting crashed tasks, which
+re-registered workers under NEW ids — inflating membership and skewing the
+contiguous data shards (README.md:368-371; sync_4workers.json records
+num_workers=11 for a 4-worker run). Elastic mode is the corrected design:
+a replacement adopts the dead worker's id (and therefore its shard), and
+sync rounds size themselves to the live membership so training never wedges
+on a dead worker.
+"""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from distributed_parameter_server_for_ml_training_tpu.ps import (
+    ParameterStore, StoreConfig, WorkerConfig)
+from distributed_parameter_server_for_ml_training_tpu.ps.device_store import (
+    DeviceParameterStore)
+
+
+def _params():
+    return {"w": np.ones((4,), np.float32)}
+
+
+def _grad(val=1.0):
+    return {"w": np.full((4,), val, np.float32)}
+
+
+def test_elastic_register_reuses_freed_slot():
+    store = ParameterStore(_params(), StoreConfig(
+        mode="sync", total_workers=4, elastic=True, push_codec="none"))
+    ids = [store.register_worker()[0] for _ in range(4)]
+    assert ids == [0, 1, 2, 3]
+    store.job_finished(1)
+    # Replacement adopts slot 1 (and therefore shard 1), not id 4.
+    assert store.register_worker()[0] == 1
+    # Faithful mode keeps the reference's inflating behavior.
+    ref = ParameterStore(_params(), StoreConfig(
+        mode="sync", total_workers=4, push_codec="none"))
+    for _ in range(4):
+        ref.register_worker()
+    ref.job_finished(1)
+    assert ref.register_worker()[0] == 4  # server.py:193-194 sequential
+
+
+def test_elastic_sync_round_sizes_to_live_membership():
+    store = ParameterStore(_params(), StoreConfig(
+        mode="sync", total_workers=4, elastic=True, learning_rate=1.0,
+        push_codec="none"))
+    for _ in range(4):
+        store.register_worker()
+    # Two of four die.
+    store.job_finished(2)
+    store.job_finished(3)
+    # A round now completes with the 2 survivors.
+    store.push(0, _grad(2.0), 0)
+    assert store.global_step == 0
+    store.push(1, _grad(4.0), 0)
+    assert store.global_step == 1
+    np.testing.assert_allclose(store.parameters["w"], 1.0 - 3.0)  # mean(2,4)
+
+
+def test_expiry_purges_pending_and_completes_round():
+    store = ParameterStore(_params(), StoreConfig(
+        mode="sync", total_workers=3, elastic=True, learning_rate=1.0,
+        worker_timeout=0.2, push_codec="none", strict_rounds=True))
+    for _ in range(3):
+        store.register_worker()
+    store.push(0, _grad(1.0), 0)
+    store.push(1, _grad(3.0), 0)
+    assert store.global_step == 0  # waiting on worker 2
+    # Worker 2 goes silent; keep 0 and 1 alive past the cutoff.
+    time.sleep(0.25)
+    store.last_seen[0] = store.last_seen[1] = time.time()
+    stale = store.expire_stale_workers()
+    assert stale == [2]
+    # The survivors' round completed at the reduced target.
+    assert store.global_step == 1
+    np.testing.assert_allclose(store.parameters["w"], 1.0 - 2.0)  # mean(1,3)
+
+
+def test_elastic_device_store_matches_host_semantics(devices):
+    import jax.numpy as jnp
+    host = ParameterStore(_params(), StoreConfig(
+        mode="sync", total_workers=3, elastic=True, learning_rate=1.0,
+        push_codec="none"))
+    dev = DeviceParameterStore(_params(), StoreConfig(
+        mode="sync", total_workers=3, elastic=True, learning_rate=1.0))
+    for s in (host, dev):
+        for _ in range(3):
+            s.register_worker()
+        s.job_finished(2)
+        s.push(0, {"w": jnp.asarray(_grad(2.0)["w"])} if s is dev
+               else _grad(2.0), 0)
+        s.push(1, {"w": jnp.asarray(_grad(4.0)["w"])} if s is dev
+               else _grad(4.0), 0)
+    assert host.global_step == dev.global_step == 1
+    np.testing.assert_allclose(np.asarray(dev.parameters["w"]),
+                               host.parameters["w"])
+
+
+def test_midrun_kill_and_replacement(devices, tiny_model):
+    """End-to-end: one worker dies mid-run without job_finished; expiry
+    frees its slot, a replacement registers into it and training completes
+    across the full data range."""
+    from distributed_parameter_server_for_ml_training_tpu.data import (
+        synthetic_cifar100)
+    from distributed_parameter_server_for_ml_training_tpu.ps.worker import (
+        PSWorker)
+    from distributed_parameter_server_for_ml_training_tpu.train.steps import (
+        make_eval_step, make_grad_step)
+    from distributed_parameter_server_for_ml_training_tpu.utils import (
+        flatten_params)
+
+    ds = synthetic_cifar100(n_train=256, n_test=64, num_classes=10, seed=9)
+    model = tiny_model()
+    variables = model.init(jax.random.PRNGKey(0),
+                           np.zeros((1, 32, 32, 3), np.float32), train=False)
+    store = ParameterStore(
+        flatten_params(variables["params"]),
+        StoreConfig(mode="async", total_workers=2, elastic=True,
+                    worker_timeout=0.5, push_codec="none"))
+
+    grad_step = make_grad_step(model, augment=False)
+    eval_step = jax.jit(make_eval_step())
+    wc = WorkerConfig(batch_size=32, num_epochs=2, augment=False,
+                      eval_each_epoch=False)
+
+    # Worker A runs normally; worker B "crashes": registers, pushes once,
+    # then vanishes without job_finished (the ECS-restart scenario).
+    def crashing_worker():
+        wid, total = store.register_worker("doomed")
+        flat, step = store.fetch(wid)
+        from distributed_parameter_server_for_ml_training_tpu.utils import (
+            unflatten_params)
+        params = unflatten_params(flat)
+        xb = ds.x_train[:32]
+        yb = ds.y_train[:32].astype(np.int32)
+        grads, _, _, _ = grad_step(params, variables.get("batch_stats", {}),
+                                   xb, yb, jax.random.PRNGKey(0), 0)
+        store.push(wid, flatten_params(jax.device_get(grads)), step)
+        return wid  # thread exits silently — no job_finished
+
+    doomed_id = crashing_worker()
+    assert doomed_id == 0
+
+    a = PSWorker(store, model, ds, wc, grad_step=grad_step,
+                 eval_step=eval_step, worker_name="survivor")
+    a.start()
+    # Let the doomed worker expire, then send in the replacement.
+    time.sleep(0.6)
+    expired = store.expire_stale_workers()
+    # Only the doomed worker expires (A keeps refreshing last_seen).
+    assert doomed_id in expired
+    b = PSWorker(store, model, ds, wc, grad_step=grad_step,
+                 eval_step=eval_step, worker_name="replacement")
+    b.start()
+    a.join(120)
+    b.join(120)
+    assert a.result.error is None and b.result.error is None
+    # The replacement adopted the freed slot 0 = the doomed worker's shard.
+    assert b.result.worker_id == doomed_id
+    assert store.global_step > 0
+    assert store.wait_all_finished(timeout=5)
+
+
+def test_job_finished_completes_pending_round():
+    """A clean departure (JobFinished) shrinks the round target and must
+    complete a round the survivors already cover — their final gradients
+    must not drop."""
+    store = ParameterStore(_params(), StoreConfig(
+        mode="sync", total_workers=3, elastic=True, learning_rate=1.0,
+        push_codec="none", strict_rounds=True))
+    for _ in range(3):
+        store.register_worker()
+    store.push(0, _grad(1.0), 0)
+    store.push(1, _grad(3.0), 0)
+    assert store.global_step == 0  # waiting on worker 2
+    store.job_finished(2)          # departs without a final push
+    assert store.global_step == 1  # survivors' round applied
+    np.testing.assert_allclose(store.parameters["w"], 1.0 - 2.0)
+
+
+def test_run_workers_reaper_unwedges_elastic_round(devices, tiny_model):
+    """run_workers' reaper expires a silent member so elastic sync rounds
+    stop waiting for it (--worker-timeout is live, not just a config)."""
+    from distributed_parameter_server_for_ml_training_tpu.data import (
+        synthetic_cifar100)
+    from distributed_parameter_server_for_ml_training_tpu.ps import (
+        run_workers)
+    from distributed_parameter_server_for_ml_training_tpu.utils import (
+        flatten_params)
+
+    ds = synthetic_cifar100(n_train=256, n_test=64, num_classes=10, seed=11)
+    model = tiny_model()
+    variables = model.init(jax.random.PRNGKey(0),
+                           np.zeros((1, 32, 32, 3), np.float32), train=False)
+    store = ParameterStore(
+        flatten_params(variables["params"]),
+        StoreConfig(mode="sync", total_workers=2, elastic=True,
+                    worker_timeout=0.4, push_codec="none",
+                    strict_rounds=True))
+    # A ghost member that will never push (e.g. a crashed-before-start task):
+    # until it expires, elastic rounds wait for 3 pushes from 2 workers.
+    ghost_id, _ = store.register_worker("ghost")
+    store.last_seen[ghost_id] = time.time() - 10.0
+
+    results = run_workers(store, model, ds, 2,
+                          WorkerConfig(batch_size=32, num_epochs=3,
+                                       augment=False,
+                                       eval_each_epoch=False))
+    assert all(r.error is None for r in results)
+    assert ghost_id not in store.active_workers  # reaper expired it
+    assert store.global_step > 0                 # rounds completed at size 2
