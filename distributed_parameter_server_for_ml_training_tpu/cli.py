@@ -80,10 +80,18 @@ def build_parser() -> argparse.ArgumentParser:
                        help="print METRICS_JSON lines (server.py:367)")
 
     t = sub.add_parser("train", help="in-process training run")
-    t.add_argument("--mode", choices=["baseline", "sync", "async"],
-                   default=_env("SERVER_MODE", "sync"))
+    t.add_argument("--mode",
+                   choices=["baseline", "sync", "async", "tp", "pp"],
+                   default=_env("SERVER_MODE", "sync"),
+                   help="baseline/sync/async reproduce the reference's "
+                        "modes; tp = data x tensor parallel (GSPMD ViT), "
+                        "pp = GPipe pipeline over ViT block groups")
     t.add_argument("--workers", type=int,
                    default=_env("TOTAL_WORKERS_EXPECTED", 4, int))
+    t.add_argument("--tp-degree", type=int, default=2,
+                   help="model-axis size for --mode tp")
+    t.add_argument("--pp-microbatches", type=int, default=8,
+                   help="GPipe microbatch count for --mode pp")
     t.add_argument("--staleness-bound", type=int,
                    default=_env("STALENESS_BOUND", 5, int))
     t.add_argument("--sync-steps", type=int,
@@ -230,6 +238,29 @@ def cmd_train(args) -> int:
                       checkpoint_dir=args.checkpoint_dir,
                       resume=args.resume)
         return 0
+
+    if args.mode in ("tp", "pp"):
+        from .train.model_parallel import (ModelParallelConfig,
+                                           PipelineTrainer, TPTrainer)
+        mp_cfg = ModelParallelConfig(
+            model=args.model, num_workers=args.workers,
+            tp_degree=args.tp_degree,
+            pp_microbatches=args.pp_microbatches,
+            learning_rate=args.lr, num_epochs=args.epochs,
+            batch_size=args.batch_size, augment=not args.no_augment,
+            num_classes=num_classes, dtype=args.dtype, seed=args.seed)
+        trainer = (TPTrainer if args.mode == "tp"
+                   else PipelineTrainer)(dataset, mp_cfg)
+        metrics = trainer.train(emit_metrics=args.emit_metrics,
+                                checkpoint_dir=args.checkpoint_dir,
+                                resume=args.resume)
+        print(f"done: {metrics}", file=sys.stderr)
+        return 0
+
+    if args.mode == "sync" and (args.elastic or args.worker_timeout):
+        print("note: --elastic/--worker-timeout apply to the store-based "
+              "modes (async, serve/worker); SPMD sync has no membership — "
+              "a mesh slot cannot die independently", file=sys.stderr)
 
     from .train.distributed import (AsyncTrainer, DistributedConfig,
                                     SyncTrainer)

@@ -140,6 +140,74 @@ class ViT(nn.Module):
         return x.astype(jnp.float32)
 
 
+class ViTPrologue(nn.Module):
+    """Patch embed + CLS + position embeddings — the shape-changing entry of
+    ViT, run replicated OUTSIDE the pipeline (stages must preserve shapes).
+    Splitting here matches the ViT structure above exactly (same layer
+    names), so a pipelined model is parameter-compatible per stage."""
+
+    patch_size: int = 4
+    hidden_dim: int = 192
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        b = x.shape[0]
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.hidden_dim,
+                    (self.patch_size, self.patch_size),
+                    strides=(self.patch_size, self.patch_size),
+                    padding="VALID", dtype=self.dtype,
+                    param_dtype=jnp.float32, name="patch_embed")(x)
+        x = x.reshape(b, -1, self.hidden_dim)
+        n_tokens = x.shape[1] + 1
+        cls = self.param("cls_token", nn.initializers.zeros,
+                         (1, 1, self.hidden_dim), jnp.float32)
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (b, 1, self.hidden_dim)).astype(self.dtype),
+             x], axis=1)
+        pos = self.param("pos_embed", nn.initializers.normal(stddev=0.02),
+                         (1, n_tokens, self.hidden_dim), jnp.float32)
+        return x + pos.astype(self.dtype)
+
+
+class EncoderStage(nn.Module):
+    """A contiguous group of encoder blocks: ONE pipeline stage.
+
+    Shape-preserving [B, T, D] -> [B, T, D], so S identical stages stack
+    into the [S, ...] parameter layout parallel/pipeline.py ships around the
+    ring.
+    """
+
+    num_blocks: int
+    num_heads: int
+    mlp_ratio: int = 4
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        for i in range(self.num_blocks):
+            x = EncoderBlock(self.num_heads, self.mlp_ratio,
+                             dtype=self.dtype, name=f"block_{i}")(x)
+        return x
+
+
+class ViTEpilogue(nn.Module):
+    """Final LayerNorm + CLS head — the shape-changing exit, replicated."""
+
+    num_classes: int = 100
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
+                         name="ln_final")(x)
+        x = x[:, 0]
+        x = nn.Dense(self.num_classes, dtype=self.dtype,
+                     param_dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
 def ViT_B16(num_classes: int = 100, dtype: Dtype = jnp.float32) -> ViT:
     """ViT-B/16: 12 layers, 768 hidden, 12 heads (~85.7M params)."""
     return ViT(patch_size=16, hidden_dim=768, depth=12, num_heads=12,

@@ -31,13 +31,12 @@ compression in parallel/sync_dp.py.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Mapping
 
 import jax
 import jax.numpy as jnp
 import numpy as np
-
-import time
 
 from .store import AggregationBase, StoreConfig, _Stats
 

