@@ -26,9 +26,12 @@ import sys
 import time
 
 # XLA compiles on the host CPU (1 core in this environment); the persistent
-# cache turns the ~30 s first-compile into a disk hit on re-runs.
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
+# cache turns the ~30 s first-compile into a disk hit on re-runs. Set via
+# jax.config — the env-var route is swallowed by the axon site hook.
+import jax  # noqa: E402
+
+jax.config.update(
+    "jax_compilation_cache_dir",
     os.path.join(os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
 
 REFERENCE_IMAGES_PER_SEC = 50_000 / 1037.8  # M1 Mac CPU epoch time
