@@ -23,6 +23,8 @@ class ExperimentVisualizer:
         for path in sorted(glob(os.path.join(results_dir, "*.json"))):
             with open(path) as f:
                 rec = json.load(f)
+            if "worker_metrics_aggregated" not in rec:
+                continue  # manifests / convergence curves, not matrix cells
             name = rec.get("experiment_name") or os.path.splitext(
                 os.path.basename(path))[0]
             self.experiments[name] = rec

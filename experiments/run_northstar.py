@@ -38,14 +38,13 @@ sys.path.insert(0, REPO)
 import jax  # noqa: E402
 
 jax.config.update("jax_compilation_cache_dir",
-                  os.path.join(REPO, ".jax_cache"))
+                  os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                 os.path.join(REPO, ".jax_cache")))
 
 OUT = os.path.join(REPO, "experiments", "results")
 
 
 def run_baseline_convergence(ds, epochs: int, out_dir: str) -> dict:
-    import jax
-
     from distributed_parameter_server_for_ml_training_tpu.train.baseline import (
         BaselineConfig, BaselineTrainer)
 
