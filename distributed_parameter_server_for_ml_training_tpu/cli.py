@@ -81,11 +81,14 @@ def build_parser() -> argparse.ArgumentParser:
 
     t = sub.add_parser("train", help="in-process training run")
     t.add_argument("--mode",
-                   choices=["baseline", "sync", "async", "tp", "pp"],
+                   choices=["baseline", "sync", "async", "tp", "pp", "sp",
+                            "moe"],
                    default=_env("SERVER_MODE", "sync"),
                    help="baseline/sync/async reproduce the reference's "
                         "modes; tp = data x tensor parallel (GSPMD ViT), "
-                        "pp = GPipe pipeline over ViT block groups")
+                        "pp = GPipe pipeline over ViT block groups, "
+                        "sp = ring-attention sequence parallelism, "
+                        "moe = Switch-MoE expert parallelism")
     t.add_argument("--workers", type=int,
                    default=_env("TOTAL_WORKERS_EXPECTED", 4, int))
     t.add_argument("--tp-degree", type=int, default=2,
@@ -239,9 +242,14 @@ def cmd_train(args) -> int:
                       resume=args.resume)
         return 0
 
-    if args.mode in ("tp", "pp"):
-        from .train.model_parallel import (ModelParallelConfig,
-                                           PipelineTrainer, TPTrainer)
+    if args.mode in ("tp", "pp", "sp", "moe"):
+        from .train.model_parallel import (ModelParallelConfig, MoETrainer,
+                                           PipelineTrainer, SPTrainer,
+                                           TPTrainer)
+        if args.mode in ("sp", "moe"):
+            print(f"note: --mode {args.mode} trains its built-in compact "
+                  f"architecture (--model is ignored; tp/pp honor it)",
+                  file=sys.stderr)
         mp_cfg = ModelParallelConfig(
             model=args.model, num_workers=args.workers,
             tp_degree=args.tp_degree,
@@ -249,8 +257,9 @@ def cmd_train(args) -> int:
             learning_rate=args.lr, num_epochs=args.epochs,
             batch_size=args.batch_size, augment=not args.no_augment,
             num_classes=num_classes, dtype=args.dtype, seed=args.seed)
-        trainer = (TPTrainer if args.mode == "tp"
-                   else PipelineTrainer)(dataset, mp_cfg)
+        trainer = {"tp": TPTrainer, "pp": PipelineTrainer,
+                   "sp": SPTrainer, "moe": MoETrainer}[args.mode](
+            dataset, mp_cfg)
         metrics = trainer.train(emit_metrics=args.emit_metrics,
                                 checkpoint_dir=args.checkpoint_dir,
                                 resume=args.resume)

@@ -237,8 +237,18 @@ class PSWorker(threading.Thread):
         state = TrainState.create(
             apply_fn=self.model.apply, params=params,
             batch_stats=batch_stats, tx=optax.identity())
+        # Device-resident test set, shared by every worker in the process:
+        # uploaded once instead of ~30 MB per eval (the remote-attach link
+        # is slow; see ps/device_store.py). Benign create race: last wins.
+        cache = getattr(self.dataset, "_device_test_cache", None)
+        if cache is None:
+            import jax.numpy as jnp
+            cache = (jnp.asarray(self.dataset.x_test),
+                     jnp.asarray(self.dataset.y_test.astype(np.int32)))
+            self.dataset._device_test_cache = cache
+        x_te, y_te = cache
         correct = total = 0
-        for xb, yb in make_batches(self.dataset.x_test, self.dataset.y_test,
+        for xb, yb in make_batches(x_te, y_te,
                                    self.config.eval_batch_size,
                                    shuffle=False, drop_remainder=False):
             c, t = self._eval_step(state, xb, yb)

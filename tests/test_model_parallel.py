@@ -91,3 +91,41 @@ def test_tp_trainer_checkpoint_resume(devices, tiny_ds, tmp_path):
     qkv = next(v for k, v in flat.items() if k.endswith("attn/qkv/kernel"))
     assert "model" in str(qkv.sharding.spec)
     assert m["global_steps_completed"] == 2 * step1
+
+
+def test_sp_trainer_learns(devices, tiny_ds):
+    """Ring-attention sequence parallelism trains end-to-end: T=64 tokens
+    sharded 8 per device, loss falls, accuracy above chance."""
+    from distributed_parameter_server_for_ml_training_tpu.train.model_parallel import (
+        SPTrainer)
+    cfg = ModelParallelConfig(num_workers=8, num_epochs=3, batch_size=64,
+                              augment=False, num_classes=10,
+                              learning_rate=0.1)
+    trainer = SPTrainer(tiny_ds, cfg)
+    assert trainer.tokens == 64
+    metrics = trainer.train()
+    assert metrics["mode"] == "sp"
+    assert metrics["seq_shards"] == 8
+    assert metrics["final_test_accuracy"] > 0.2, metrics
+
+
+def test_moe_trainer_learns(devices, tiny_ds):
+    """Switch-MoE expert parallelism trains end-to-end: 8 experts, two
+    all_to_all hops per layer, loss falls, accuracy above chance."""
+    from distributed_parameter_server_for_ml_training_tpu.train.model_parallel import (
+        MoETrainer)
+    cfg = ModelParallelConfig(num_workers=8, num_epochs=3, batch_size=64,
+                              augment=False, num_classes=10,
+                              learning_rate=0.1)
+    trainer = MoETrainer(tiny_ds, cfg)
+    metrics = trainer.train()
+    assert metrics["mode"] == "moe"
+    assert metrics["n_experts"] == 8
+    assert metrics["final_test_accuracy"] > 0.2, metrics
+
+    # Expert FFN weights really live one-per-slot on the expert axis.
+    from distributed_parameter_server_for_ml_training_tpu.utils import (
+        flatten_params)
+    flat = flatten_params(trainer.state.params, as_numpy=False)
+    w1 = next(v for k, v in flat.items() if k.endswith("moe/w1"))
+    assert "expert" in str(w1.sharding.spec)
