@@ -43,7 +43,7 @@ def run_cell(dataset: Dataset, mode: str, n_workers: int, *,
     flat = flatten_params(variables["params"])
     cfg = StoreConfig(mode=mode, total_workers=n_workers, learning_rate=lr,
                       staleness_bound=staleness_bound)
-    if backend == "native" and mode == "async":
+    if backend == "native":
         from ..native import NativeParameterStore
         store = NativeParameterStore(flat, cfg)
     elif backend == "device":

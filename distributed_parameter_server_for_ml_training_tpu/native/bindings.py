@@ -57,6 +57,11 @@ def load_library() -> ctypes.CDLL | None:
         lib.dps_store_push_fp16.restype = i64
         lib.dps_store_push_fp32.argtypes = [ctypes.c_void_p, f32p, i64, i64]
         lib.dps_store_push_fp32.restype = i64
+        i64p = ctypes.POINTER(i64)
+        lib.dps_store_stash_fp16.argtypes = [ctypes.c_void_p, i64, u16p]
+        lib.dps_store_stash_fp32.argtypes = [ctypes.c_void_p, i64, f32p]
+        lib.dps_store_apply_mean.argtypes = [ctypes.c_void_p, i64p, i64]
+        lib.dps_store_apply_mean.restype = i64
         _LIB = lib
         return _LIB
 
@@ -67,6 +72,10 @@ def native_available() -> bool:
 
 def _f32p(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_float))
+
+
+def _i64p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int64))
 
 
 def _u16p(a: np.ndarray):

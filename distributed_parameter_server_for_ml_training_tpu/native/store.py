@@ -1,4 +1,4 @@
-"""Native-backed parameter store (async hot path in C++).
+"""Native-backed parameter store (hot paths in C++).
 
 API-compatible with :class:`~..ps.store.ParameterStore` for the worker-facing
 surface (register_worker / fetch / push / job_finished / metrics), so
@@ -6,8 +6,17 @@ surface (register_worker / fetch / push / job_finished / metrics), so
 interchangeably. The arena layout (one flat float buffer + a name->slice
 index) is what lets C++ do the whole push in one multithreaded pass.
 
-Async mode only — the sync TPU path has no server at all (parallel/sync_dp),
-and the Python store covers sync-store experiments.
+Both modes run native bulk passes: async pushes are a fused
+fp16-decode + staleness-weighted SGD (server.py:171-186 semantics in
+ps_core.cpp); sync rounds stash each worker's gradients into a C++ slot
+buffer and complete with one fused mean+apply pass (server.py:264-288 +
+145-169 + 126-143). Round ORCHESTRATION (locks, counts, elastic targets,
+quirk-3 double-push semantics) stays in Python, mirroring
+:class:`~..ps.store.AggregationBase`.
+
+Restriction vs the Python store: pushes must carry the FULL parameter set
+(the arena is contiguous); the reference's partial-push averaging is a
+Python-store behavior.
 """
 
 from __future__ import annotations
@@ -20,7 +29,7 @@ import numpy as np
 
 from ..ps.semantics import DEFAULT_STALENESS_BOUND
 from ..ps.store import MAX_WORKERS, MembershipMixin, StoreConfig, _Stats
-from .bindings import _f32p, _u16p, load_library
+from .bindings import _f32p, _i64p, _u16p, load_library
 
 
 class NativeParameterStore(MembershipMixin):
@@ -29,11 +38,6 @@ class NativeParameterStore(MembershipMixin):
     def __init__(self, initial_params: Mapping[str, np.ndarray],
                  config: StoreConfig | None = None):
         self.config = config or StoreConfig(mode="async")
-        if self.config.mode != "async":
-            raise ValueError(
-                "NativeParameterStore supports async mode only; the sync "
-                "mode is the SPMD path (parallel/sync_dp.py) or the Python "
-                "store")
         if self.config.fetch_codec != "none":
             raise ValueError(
                 "NativeParameterStore fetches fp32 from the arena; "
@@ -66,6 +70,13 @@ class NativeParameterStore(MembershipMixin):
         self.last_seen: dict[int, float] = {}
         self.stats = _Stats()
         self._finished_event = threading.Event()
+
+        # Sync-round state (orchestrated here, bulk work in C++): worker id
+        # -> C++ slot holding its stashed gradients this round.
+        self._sync_lock = threading.Lock()
+        self._slot_of: dict[int, int] = {}
+        self._pending: dict[int, int] = {}      # worker_id -> slot
+        self._gradients_received = 0
 
     # -- properties mirroring ParameterStore ---------------------------------
 
@@ -120,6 +131,9 @@ class NativeParameterStore(MembershipMixin):
     def push(self, worker_id: int, gradients: Mapping[str, np.ndarray],
              fetched_step: int) -> bool:
         self.last_seen[worker_id] = time.time()
+        if self.config.mode == "sync":
+            self._push_sync(worker_id, gradients)
+            return True
         t0 = time.time()
         bound = int(self.config.staleness_bound)
         before = self.global_step
@@ -141,11 +155,75 @@ class NativeParameterStore(MembershipMixin):
         self.stats.update_times.append(time.time() - t0)
         return True
 
+    # -- sync rounds (orchestration mirrors AggregationBase; _round_target
+    #    and the elastic hooks' call sites are inherited) --------------------
+
+    def _push_sync(self, worker_id: int,
+                   gradients: Mapping[str, np.ndarray]) -> None:
+        """server.py:264-288 semantics: stash (C++ decode into the worker's
+        slot), count, and complete the round with one fused mean+apply.
+
+        The WHOLE stash happens under ``_sync_lock`` — exactly like the
+        Python store, whose pushes hold the lock for the full stash —
+        otherwise apply_mean could read a slot mid-overwrite (quirk-3
+        double pushes make that reachable, not just theoretical).
+        """
+        with self._sync_lock:
+            slot = self._slot_of.setdefault(worker_id, len(self._slot_of))
+            if self.config.push_codec == "fp16":
+                flat = self._pack(gradients, np.float16)
+                self._lib.dps_store_stash_fp16(self._handle, slot,
+                                               _u16p(flat.view(np.uint16)))
+            else:
+                flat = self._pack(gradients, np.float32)
+                self._lib.dps_store_stash_fp32(self._handle, slot,
+                                               _f32p(flat))
+            if self.config.strict_rounds:
+                self._pending[worker_id] = slot
+                self._gradients_received = len(self._pending)
+            else:
+                # Faithful quirk 3: a double push overwrites the slot (the
+                # stash above already did) but still counts.
+                self._pending[worker_id] = slot
+                self._gradients_received += 1
+            self._maybe_complete_round_locked()
+            self.stats.gradients_processed += 1
+
+    def _maybe_complete_round_locked(self) -> None:
+        if self._gradients_received >= self._round_target() and self._pending:
+            t0 = time.time()
+            try:
+                slots = np.fromiter(self._pending.values(), np.int64)
+                self._lib.dps_store_apply_mean(
+                    self._handle, _i64p(slots), len(slots))
+                self.stats.total_parameter_updates += 1
+                self.stats.update_times.append(time.time() - t0)
+            finally:
+                self._pending.clear()
+                self._gradients_received = 0
+
+    def _on_workers_expired(self, stale) -> None:
+        """Elastic: purge dead workers' stashed slots from the round."""
+        if not getattr(self.config, "elastic", False):
+            return
+        with self._sync_lock:
+            for w in stale:
+                self._pending.pop(w, None)
+            if self._pending or self._gradients_received:
+                self._gradients_received = len(self._pending)
+                self._maybe_complete_round_locked()
+
+    def _on_worker_departed(self) -> None:
+        if not getattr(self.config, "elastic", False):
+            return
+        with self._sync_lock:
+            if self._gradients_received:
+                self._maybe_complete_round_locked()
+
     def metrics(self) -> dict:
         elapsed = time.time() - self.stats.start_time
-        sv = self.stats.staleness_values
-        return {
-            "mode": "async",
+        out = {
+            "mode": self.config.mode,
             "backend": "native",
             "total_workers": self.config.total_workers,
             "total_training_time_seconds": round(elapsed, 2),
@@ -159,11 +237,17 @@ class NativeParameterStore(MembershipMixin):
                 round(self.stats.total_parameter_updates / elapsed, 3)
                 if elapsed > 0 else 0.0),
             "learning_rate": self.config.learning_rate,
-            "staleness_bound": self.config.staleness_bound,
-            "gradients_rejected": self.stats.gradients_rejected,
-            "average_staleness": (round(float(np.mean(sv)), 3) if sv else 0.0),
-            "max_staleness": int(max(sv)) if sv else 0,
         }
+        if self.config.mode == "async":
+            sv = self.stats.staleness_values
+            out.update({
+                "staleness_bound": self.config.staleness_bound,
+                "gradients_rejected": self.stats.gradients_rejected,
+                "average_staleness": (round(float(np.mean(sv)), 3)
+                                      if sv else 0.0),
+                "max_staleness": int(max(sv)) if sv else 0,
+            })
+        return out
 
     def __del__(self):
         try:

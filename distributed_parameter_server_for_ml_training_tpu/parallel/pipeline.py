@@ -34,7 +34,7 @@ def _pipeline_body(stage_params, x_mb, *, stage_fn: Callable,
                    axis_name: str, axis_size: int):
     """shard_map body. stage_params: this stage's [1, ...] param slice.
     x_mb: [M, mb, ...] microbatches (replicated). Returns [M, mb, ...]
-    outputs (replicated via psum from the last stage)."""
+    outputs (replicated via ONE psum from the last stage at the end)."""
     s = jax.lax.axis_index(axis_name)
     n_stages = axis_size
     m = x_mb.shape[0]
@@ -50,22 +50,27 @@ def _pipeline_body(stage_params, x_mb, *, stage_fn: Callable,
         # Stage 0 reads fresh input; later stages use the carried activation.
         fresh = x_mb[jnp.clip(mb_idx, 0, m - 1)]
         x_in = jnp.where(s == 0, fresh, carry)
-        y = stage_fn(my_params, x_in)
-        y = jnp.where(active, y, jnp.zeros_like(y))
+        # Bubble ticks SKIP the stage compute: ``active`` is a per-device
+        # scalar and stage_fn contains no collectives, so lax.cond lowers to
+        # a real branch — (S-1)/(S+M-1) of the ticks do no FLOPs instead of
+        # computing masked garbage.
+        y = jax.lax.cond(active,
+                         lambda x: stage_fn(my_params, x),
+                         lambda x: jnp.zeros_like(x), x_in)
 
-        # The last stage's finished microbatch is broadcast to everyone
-        # (psum over one-hot contribution), keeping outputs replicated.
-        is_last = s == n_stages - 1
-        contribution = jnp.where(active & is_last, y, jnp.zeros_like(y))
-        contribution = jax.lax.psum(contribution, axis_name)
+        # Stash the last stage's finished microbatch locally; everyone else
+        # contributes zeros and ONE final psum replicates all outputs (the
+        # per-tick broadcast this replaces cost S+M-2 extra collectives).
         out_idx = t - (n_stages - 1)  # static: which microbatch finished
         if 0 <= out_idx < m:
-            outputs = outputs.at[out_idx].add(contribution)
+            is_last = s == n_stages - 1
+            outputs = outputs.at[out_idx].add(
+                jnp.where(is_last, y, jnp.zeros_like(y)))
 
         # Ship activations one stage forward for the next tick.
         carry = jax.lax.ppermute(y, axis_name, perm_fwd)
 
-    return outputs
+    return jax.lax.psum(outputs, axis_name)
 
 
 def stack_stage_params(per_stage_params: list) -> jax.Array:

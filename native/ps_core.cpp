@@ -115,6 +115,13 @@ struct Store {
   std::atomic<int64_t> global_step{0};
   std::atomic<int64_t> rejected{0};
   float lr;
+  // Sync-round stash: one arena-sized buffer per worker slot (allocated on
+  // first stash). unique_ptr keeps each buffer's address stable across
+  // outer-vector resizes (a reference obtained before a concurrent resize
+  // must stay valid). Round orchestration (locks, counting, elastic
+  // targets) stays on the Python side; C++ only does the bulk passes.
+  std::vector<std::unique_ptr<std::vector<float>>> slots;
+  std::mutex slots_lock;
 };
 
 }  // namespace
@@ -216,6 +223,66 @@ int64_t dps_store_push_fp32(void* h, const float* grads,
   s->version.fetch_add(1, std::memory_order_acq_rel);
   parallel_for(n, 1 << 15, [&](int64_t lo, int64_t hi) {
     for (int64_t i = lo; i < hi; ++i) p[i] -= scale * grads[i];
+  });
+  int64_t new_step = s->global_step.fetch_add(1) + 1;  // before even bump
+  s->version.fetch_add(1, std::memory_order_acq_rel);
+  return new_step;
+}
+
+// ---- sync rounds: per-slot stash + fused mean-apply -------------------------
+//
+// The reference's sync mode stashes one gradient set per worker and, when
+// the round is full, averages per-parameter and applies SGD
+// (server.py:264-288 + 145-169 + 126-143). Here the stash decode and the
+// mean+apply are single multithreaded passes over the contiguous arena.
+
+static std::vector<float>& slot_buffer(Store* s, int64_t slot) {
+  std::lock_guard<std::mutex> g(s->slots_lock);
+  if ((int64_t)s->slots.size() <= slot) s->slots.resize(slot + 1);
+  if (!s->slots[slot])
+    s->slots[slot] = std::make_unique<std::vector<float>>(
+        s->params.size(), 0.0f);
+  return *s->slots[slot];
+}
+
+void dps_store_stash_fp16(void* h, int64_t slot, const uint16_t* grads) {
+  auto* s = static_cast<Store*>(h);
+  std::vector<float>& buf = slot_buffer(s, slot);
+  parallel_for((int64_t)buf.size(), 1 << 15, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) buf[i] = half_to_float(grads[i]);
+  });
+}
+
+void dps_store_stash_fp32(void* h, int64_t slot, const float* grads) {
+  auto* s = static_cast<Store*>(h);
+  std::vector<float>& buf = slot_buffer(s, slot);
+  std::memcpy(buf.data(), grads, buf.size() * sizeof(float));
+}
+
+// Fused p -= lr * mean(slots): one pass, all threads. Returns the new
+// global step. Caller guarantees the listed slots are fully stashed and
+// holds its own round lock (matching the Python store's sync_lock).
+int64_t dps_store_apply_mean(void* h, const int64_t* slot_ids, int64_t n) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->write_lock);
+  const float scale = s->lr / (float)n;
+  float* p = s->params.data();
+  const int64_t size = (int64_t)s->params.size();
+  // Collect raw pointers outside the hot loop.
+  std::vector<const float*> bufs;
+  bufs.reserve(n);
+  {
+    std::lock_guard<std::mutex> sg(s->slots_lock);
+    for (int64_t j = 0; j < n; ++j)
+      bufs.push_back(s->slots[slot_ids[j]]->data());
+  }
+  s->version.fetch_add(1, std::memory_order_acq_rel);  // odd: writing
+  parallel_for(size, 1 << 15, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      float acc = 0.0f;
+      for (int64_t j = 0; j < n; ++j) acc += bufs[j][i];
+      p[i] -= scale * acc;
+    }
   });
   int64_t new_step = s->global_step.fetch_add(1) + 1;  // before even bump
   s->version.fetch_add(1, std::memory_order_acq_rel);

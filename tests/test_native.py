@@ -157,6 +157,88 @@ class TestNativeStore:
         assert w.result.error is None
         assert nat.global_step == 4
 
-    def test_sync_mode_rejected(self):
-        with pytest.raises(ValueError):
-            NativeParameterStore(params(), StoreConfig(mode="sync"))
+    def test_sync_round_matches_python_store(self):
+        """Native sync rounds (C++ slot stash + fused mean+apply) equal the
+        Python store given the same push sequence (server.py:264-288 +
+        145-169 + 126-143 semantics)."""
+        cfg = dict(mode="sync", total_workers=2, learning_rate=0.1,
+                   push_codec="none")
+        py = ParameterStore(params(), StoreConfig(**cfg))
+        nat = NativeParameterStore(params(), StoreConfig(**cfg))
+        for step in range(3):
+            for wid in range(2):
+                g = {k: v.astype(np.float32)
+                     for k, v in grads(10 * step + wid).items()}
+                py.push(wid, g, step)
+                nat.push(wid, g, step)
+        assert py.global_step == nat.global_step == 3
+        for k in py.parameters:
+            np.testing.assert_allclose(nat.parameters[k], py.parameters[k],
+                                       rtol=1e-6, atol=1e-7, err_msg=k)
+
+    def test_sync_fp16_round_matches_python_store(self):
+        cfg = dict(mode="sync", total_workers=2, learning_rate=0.1,
+                   push_codec="fp16")
+        py = ParameterStore(params(), StoreConfig(**cfg))
+        nat = NativeParameterStore(params(), StoreConfig(**cfg))
+        for wid in range(2):
+            g = grads(wid)  # already fp16, the wire codec
+            py.push(wid, g, 0)
+            nat.push(wid, g, 0)
+        assert py.global_step == nat.global_step == 1
+        for k in py.parameters:
+            np.testing.assert_allclose(nat.parameters[k], py.parameters[k],
+                                       rtol=1e-6, atol=1e-7, err_msg=k)
+
+    def test_sync_double_push_quirk_and_strict(self):
+        """Quirk 3 (double push completes a round with one distinct worker)
+        holds natively; strict_rounds corrects it — same as the Python
+        store."""
+        nat = NativeParameterStore(params(), StoreConfig(
+            mode="sync", total_workers=2, push_codec="none"))
+        g = {k: v.astype(np.float32) for k, v in grads(1).items()}
+        nat.push(0, g, 0)
+        nat.push(0, g, 0)       # overwrite + count (server.py:267-268)
+        assert nat.global_step == 1
+        strict = NativeParameterStore(params(), StoreConfig(
+            mode="sync", total_workers=2, push_codec="none",
+            strict_rounds=True))
+        strict.push(0, g, 0)
+        strict.push(0, g, 0)
+        assert strict.global_step == 0  # still waiting on a second worker
+
+    def test_sync_elastic_departure_completes_round(self):
+        nat = NativeParameterStore(params(), StoreConfig(
+            mode="sync", total_workers=3, push_codec="none", elastic=True,
+            strict_rounds=True))
+        for _ in range(3):
+            nat.register_worker()
+        g = {k: v.astype(np.float32) for k, v in grads(2).items()}
+        nat.push(0, g, 0)
+        nat.push(1, g, 0)
+        assert nat.global_step == 0
+        nat.job_finished(2)     # round completes at the reduced target
+        assert nat.global_step == 1
+
+    def test_sync_concurrent_pushes_smoke(self):
+        """Threaded sync pushes (quirk-3 double pushes included) never
+        corrupt the arena: steps advance, params stay finite."""
+        import threading
+        nat = NativeParameterStore(params(), StoreConfig(
+            mode="sync", total_workers=4, push_codec="none",
+            learning_rate=0.01))
+
+        def worker(wid):
+            for i in range(12):
+                g = {k: v.astype(np.float32)
+                     for k, v in grads(wid * 100 + i).items()}
+                nat.push(wid, g, 0)
+
+        ts = [threading.Thread(target=worker, args=(w,)) for w in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert nat.global_step == 12  # 48 pushes / 4 per round
+        for k, v in nat.parameters.items():
+            assert np.all(np.isfinite(v)), k
