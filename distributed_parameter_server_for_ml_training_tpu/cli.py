@@ -303,7 +303,8 @@ def cmd_serve(args) -> int:
     from .utils.metrics import emit_metrics_json
     from .utils.pytree import flatten_params
 
-    model = get_model(args.model, num_classes=args.num_classes)
+    model = get_model(args.model, num_classes=args.num_classes,
+                      image_size=args.image_size)
     size = args.image_size
     variables = model.init(jax.random.PRNGKey(args.seed),
                            np.zeros((1, size, size, 3), np.float32),
@@ -349,7 +350,8 @@ def cmd_worker(args) -> int:
     # Honor --model/--dataset like cmd_train does — a mismatched architecture
     # would push parameter names the server's store doesn't know.
     model = get_model(args.model, num_classes=dataset.num_classes,
-                      dtype=dtype)
+                      dtype=dtype,
+                      image_size=dataset.x_train.shape[1])
     cfg = WorkerConfig(batch_size=args.batch_size, num_epochs=args.epochs,
                        sync_steps=args.sync_steps,
                        k_step_mode=args.k_step_mode,

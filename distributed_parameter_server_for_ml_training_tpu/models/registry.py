@@ -15,13 +15,17 @@ from .resnet import ResNet18, ResNet50
 from .vit import ViT_B16, ViT_Tiny
 
 _REGISTRY = {
-    "resnet18": lambda num_classes, dtype, axis_name: ResNet18(
+    "resnet18": lambda num_classes, dtype, axis_name, image_size: ResNet18(
         num_classes=num_classes, dtype=dtype, axis_name=axis_name),
-    "resnet50": lambda num_classes, dtype, axis_name: ResNet50(
-        num_classes=num_classes, dtype=dtype, axis_name=axis_name),
-    "vit_b16": lambda num_classes, dtype, axis_name: ViT_B16(
+    # ResNet-50 switches to the ImageNet stem (7x7/2 + maxpool/2) at large
+    # resolutions: the CIFAR stem carries full-resolution feature maps into
+    # stage 0 and needs ~37 GB HBM for one 224px batch-128 train step.
+    "resnet50": lambda num_classes, dtype, axis_name, image_size: ResNet50(
+        num_classes=num_classes, dtype=dtype, axis_name=axis_name,
+        imagenet_stem=image_size >= 96),
+    "vit_b16": lambda num_classes, dtype, axis_name, image_size: ViT_B16(
         num_classes=num_classes, dtype=dtype),
-    "vit_tiny": lambda num_classes, dtype, axis_name: ViT_Tiny(
+    "vit_tiny": lambda num_classes, dtype, axis_name, image_size: ViT_Tiny(
         num_classes=num_classes, dtype=dtype),
 }
 
@@ -29,9 +33,10 @@ MODEL_NAMES = tuple(_REGISTRY)
 
 
 def get_model(name: str, num_classes: int = 100, dtype=jnp.bfloat16,
-              axis_name: str | None = None):
+              axis_name: str | None = None, image_size: int = 32):
     """Build a model by registry name. ViT models ignore ``axis_name``
-    (LayerNorm needs no cross-replica sync; BN models use it)."""
+    (LayerNorm needs no cross-replica sync; BN models use it).
+    ``image_size`` selects resolution-dependent choices (ResNet-50 stem)."""
     if name not in _REGISTRY:
         raise ValueError(f"unknown model {name!r}; have {MODEL_NAMES}")
-    return _REGISTRY[name](num_classes, dtype, axis_name)
+    return _REGISTRY[name](num_classes, dtype, axis_name, image_size)

@@ -113,7 +113,11 @@ class Bottleneck(nn.Module):
 
 
 class ResNet(nn.Module):
-    """CIFAR-style ResNet: 3x3 stem (no maxpool), stages doubling width."""
+    """ResNet with a CIFAR stem (3x3, stride 1, no maxpool — the reference's
+    architecture, server.py:43-76) or an ImageNet stem (7x7 stride 2 + 3x3
+    maxpool stride 2) for large-resolution configs: without the 4x stem
+    downsampling, 224px inputs keep 224x224 feature maps into stage 0 and a
+    batch-128 train step needs ~37 GB of HBM."""
 
     stage_sizes: Sequence[int]
     block_cls: type = BasicBlock
@@ -121,18 +125,28 @@ class ResNet(nn.Module):
     num_filters: int = 64
     dtype: Dtype = jnp.float32
     axis_name: str | None = None
+    imagenet_stem: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
         x = x.astype(self.dtype)
-        x = nn.Conv(self.num_filters, (3, 3), padding=((1, 1), (1, 1)),
-                    use_bias=False, dtype=self.dtype, param_dtype=jnp.float32,
-                    name="stem_conv")(x)
+        if self.imagenet_stem:
+            x = nn.Conv(self.num_filters, (7, 7), strides=(2, 2),
+                        padding=((3, 3), (3, 3)), use_bias=False,
+                        dtype=self.dtype, param_dtype=jnp.float32,
+                        name="stem_conv")(x)
+        else:
+            x = nn.Conv(self.num_filters, (3, 3), padding=((1, 1), (1, 1)),
+                        use_bias=False, dtype=self.dtype,
+                        param_dtype=jnp.float32, name="stem_conv")(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                          epsilon=1e-5, dtype=self.dtype,
                          param_dtype=jnp.float32, axis_name=self.axis_name,
                          name="stem_bn")(x)
         x = nn.relu(x)
+        if self.imagenet_stem:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2),
+                            padding=((1, 1), (1, 1)))
         for stage, n_blocks in enumerate(self.stage_sizes):
             for block in range(n_blocks):
                 strides = 2 if stage > 0 and block == 0 else 1
@@ -155,9 +169,14 @@ def ResNet18(num_classes: int = 100, dtype: Dtype = jnp.float32,
 
 
 def ResNet50(num_classes: int = 1000, dtype: Dtype = jnp.float32,
-             axis_name: str | None = None) -> ResNet:
+             axis_name: str | None = None,
+             imagenet_stem: bool = False) -> ResNet:
+    """ResNet-50. The CIFAR stem is the default (matching the reference's
+    only architecture); pass ``imagenet_stem=True`` for large-resolution
+    inputs — the registry does this automatically for image_size >= 96."""
     return ResNet(stage_sizes=(3, 4, 6, 3), block_cls=Bottleneck,
-                  num_classes=num_classes, dtype=dtype, axis_name=axis_name)
+                  num_classes=num_classes, dtype=dtype, axis_name=axis_name,
+                  imagenet_stem=imagenet_stem)
 
 
 def count_params(params) -> int:

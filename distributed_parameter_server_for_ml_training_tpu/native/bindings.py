@@ -62,6 +62,7 @@ def load_library() -> ctypes.CDLL | None:
         lib.dps_store_stash_fp32.argtypes = [ctypes.c_void_p, i64, f32p]
         lib.dps_store_apply_mean.argtypes = [ctypes.c_void_p, i64p, i64]
         lib.dps_store_apply_mean.restype = i64
+        lib.dps_store_free_slot.argtypes = [ctypes.c_void_p, i64]
         _LIB = lib
         return _LIB
 

@@ -131,7 +131,7 @@ class MembershipMixin:
         # round may already be satisfied — re-evaluate WITHOUT purging (a
         # clean departure's final push is a valid contribution; only dead
         # workers' pending grads are purged, in _on_workers_expired).
-        self._on_worker_departed()
+        self._on_worker_departed(worker_id)
         if empty:
             self._finished_event.set()
 
@@ -148,7 +148,7 @@ class MembershipMixin:
     def _on_workers_expired(self, stale: list[int]) -> None:
         """Hook for stores to clean round state after expiry (no-op here)."""
 
-    def _on_worker_departed(self) -> None:
+    def _on_worker_departed(self, worker_id: int) -> None:
         """Hook after a clean JobFinished departure (no-op here)."""
 
     def expire_stale_workers(self) -> list[int]:
@@ -240,7 +240,7 @@ class AggregationBase(MembershipMixin):
                 self._gradients_received = len(self._pending)
                 self._maybe_complete_round_locked()
 
-    def _on_worker_departed(self) -> None:
+    def _on_worker_departed(self, worker_id: int) -> None:
         """Elastic: a clean departure only shrinks the round target — its
         own final push (if any) stays in the round."""
         if not getattr(self.config, "elastic", False):

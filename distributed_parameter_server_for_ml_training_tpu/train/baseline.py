@@ -105,7 +105,8 @@ class BaselineTrainer:
         from ..models import get_model
         self.model = model or get_model(cfg.model,
                                         num_classes=cfg.num_classes,
-                                        dtype=dtype)
+                                        dtype=dtype,
+                                        image_size=dataset.x_train.shape[1])
         tx = (server_sgd(cfg.learning_rate) if cfg.plain_sgd
               else baseline_optimizer(
                   cfg.learning_rate, cfg.momentum, cfg.weight_decay,

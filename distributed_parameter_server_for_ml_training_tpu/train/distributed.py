@@ -86,7 +86,8 @@ class SyncTrainer:
         from ..models import get_model
         dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
         self.model = get_model(cfg.model, num_classes=cfg.num_classes,
-                               dtype=dtype, axis_name="data")
+                               dtype=dtype, axis_name="data",
+                               image_size=dataset.x_train.shape[1])
         h, w = dataset.x_train.shape[1:3]
         self.state = create_train_state(
             self.model, jax.random.PRNGKey(cfg.seed),
@@ -223,7 +224,8 @@ class AsyncTrainer:
         from ..models import get_model
         dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
         self.model = get_model(cfg.model, num_classes=cfg.num_classes,
-                               dtype=dtype)
+                               dtype=dtype,
+                               image_size=dataset.x_train.shape[1])
         h, w = dataset.x_train.shape[1:3]
         variables = self.model.init(
             jax.random.PRNGKey(cfg.seed),

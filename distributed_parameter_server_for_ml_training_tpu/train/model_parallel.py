@@ -206,8 +206,9 @@ class TPTrainer(_EpochTrainer):
 
         from ..models import get_model
         dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        h, w = dataset.x_train.shape[1:3]
         self.model = get_model(cfg.model, num_classes=cfg.num_classes,
-                               dtype=dtype)
+                               dtype=dtype, image_size=h)
         h, w = dataset.x_train.shape[1:3]
         state = create_train_state(self.model, jax.random.PRNGKey(cfg.seed),
                                    server_sgd(cfg.learning_rate),

@@ -259,6 +259,14 @@ void dps_store_stash_fp32(void* h, int64_t slot, const float* grads) {
   std::memcpy(buf.data(), grads, buf.size() * sizeof(float));
 }
 
+// Release a departed/expired worker's slot buffer (caller must guarantee no
+// concurrent stash/apply for this slot — the Python sync lock does).
+void dps_store_free_slot(void* h, int64_t slot) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->slots_lock);
+  if (slot >= 0 && slot < (int64_t)s->slots.size()) s->slots[slot].reset();
+}
+
 // Fused p -= lr * mean(slots): one pass, all threads. Returns the new
 // global step. Caller guarantees the listed slots are fully stashed and
 // holds its own round lock (matching the Python store's sync_lock).

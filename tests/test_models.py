@@ -44,6 +44,7 @@ def test_batchnorm_updates_in_train_mode(tiny_model):
 
 
 def test_resnet50_builds():
+    # Default = CIFAR stem (3x3 stride 1), the reference's architecture.
     m = ResNet50(num_classes=10)
     vs = m.init(jax.random.PRNGKey(0), jnp.ones((1, 32, 32, 3)), train=False)
     out = m.apply(vs, jnp.ones((2, 32, 32, 3)), train=False)
@@ -52,3 +53,26 @@ def test_resnet50_builds():
     # 10-class head (-2.03M) and a 3x3 CIFAR stem (-4.7k) this variant lands
     # in 23-24M.
     assert 23_000_000 < count_params(vs["params"]) < 24_000_000
+    assert "stem_conv" in vs["params"]
+    assert vs["params"]["stem_conv"]["kernel"].shape[:2] == (3, 3)
+
+
+def test_resnet50_imagenet_stem_via_registry():
+    """The registry picks the 7x7/2 + maxpool/2 stem at large resolutions
+    (the CIFAR stem needs ~37 GB HBM for one 224px batch-128 step)."""
+    from distributed_parameter_server_for_ml_training_tpu.models import (
+        get_model)
+
+    m = get_model("resnet50", num_classes=10, dtype=jnp.float32,
+                  image_size=224)
+    vs = m.init(jax.random.PRNGKey(0), jnp.ones((1, 64, 64, 3)), train=False)
+    assert vs["params"]["stem_conv"]["kernel"].shape[:2] == (7, 7)
+    # Stem downsamples 4x before stage 0.
+    out = m.apply(vs, jnp.ones((2, 64, 64, 3)), train=False)
+    assert out.shape == (2, 10)
+    # CIFAR-resolution requests keep the reference stem.
+    m32 = get_model("resnet50", num_classes=10, dtype=jnp.float32,
+                    image_size=32)
+    vs32 = m32.init(jax.random.PRNGKey(0), jnp.ones((1, 32, 32, 3)),
+                    train=False)
+    assert vs32["params"]["stem_conv"]["kernel"].shape[:2] == (3, 3)
