@@ -15,11 +15,12 @@ from .resnet import ResNet18, ResNet50
 from .vit import ViT_B16, ViT_Tiny
 
 _REGISTRY = {
-    "resnet18": lambda num_classes, dtype, axis_name, image_size: ResNet18(
-        num_classes=num_classes, dtype=dtype, axis_name=axis_name),
-    # ResNet-50 switches to the ImageNet stem (7x7/2 + maxpool/2) at large
+    # ResNets switch to the ImageNet stem (7x7/2 + maxpool/2) at large
     # resolutions: the CIFAR stem carries full-resolution feature maps into
     # stage 0 and needs ~37 GB HBM for one 224px batch-128 train step.
+    "resnet18": lambda num_classes, dtype, axis_name, image_size: ResNet18(
+        num_classes=num_classes, dtype=dtype, axis_name=axis_name,
+        imagenet_stem=image_size >= 96),
     "resnet50": lambda num_classes, dtype, axis_name, image_size: ResNet50(
         num_classes=num_classes, dtype=dtype, axis_name=axis_name,
         imagenet_stem=image_size >= 96),

@@ -163,9 +163,11 @@ class ResNet(nn.Module):
 
 
 def ResNet18(num_classes: int = 100, dtype: Dtype = jnp.float32,
-             axis_name: str | None = None) -> ResNet:
+             axis_name: str | None = None,
+             imagenet_stem: bool = False) -> ResNet:
     return ResNet(stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock,
-                  num_classes=num_classes, dtype=dtype, axis_name=axis_name)
+                  num_classes=num_classes, dtype=dtype, axis_name=axis_name,
+                  imagenet_stem=imagenet_stem)
 
 
 def ResNet50(num_classes: int = 1000, dtype: Dtype = jnp.float32,

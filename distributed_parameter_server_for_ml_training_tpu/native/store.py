@@ -211,8 +211,15 @@ class NativeParameterStore(MembershipMixin):
                 self.stats.total_parameter_updates += 1
                 self.stats.update_times.append(time.time() - t0)
             finally:
+                # Workers that departed/expired while this round was still
+                # pending had their slot release deferred (their stash was a
+                # live contribution) — sweep them now that it is consumed.
+                departed = [w for w in self._pending
+                            if w not in self.active_workers]
                 self._pending.clear()
                 self._gradients_received = 0
+                for w in departed:
+                    self._release_slot_locked(w)
 
     def _release_slot_locked(self, worker_id: int) -> None:
         """Free the worker's C++ slot buffer and recycle its index (safe:

@@ -209,7 +209,6 @@ class TPTrainer(_EpochTrainer):
         h, w = dataset.x_train.shape[1:3]
         self.model = get_model(cfg.model, num_classes=cfg.num_classes,
                                dtype=dtype, image_size=h)
-        h, w = dataset.x_train.shape[1:3]
         state = create_train_state(self.model, jax.random.PRNGKey(cfg.seed),
                                    server_sgd(cfg.learning_rate),
                                    input_shape=(1, h, w, 3))

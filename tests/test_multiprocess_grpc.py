@@ -1,0 +1,78 @@
+"""True multi-process gRPC: `cli serve` and `cli worker` as separate OS
+processes over localhost — the reference's multi-machine topology
+(terraform/main.tf:387-435, worker -> NLB -> server) minus the NLB.
+
+The in-process gRPC tests (test_comms.py) exercise the wire format and the
+4-RPC protocol; this one proves the actual CLI entry points interoperate
+across process boundaries end-to-end: register -> fetch/push epochs ->
+JobFinished -> server exits cleanly and emits METRICS_JSON.
+"""
+
+import json
+import os
+import re
+import socket
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_serve_and_worker_processes():
+    port = _free_port()
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        JAX_COMPILATION_CACHE_DIR=os.path.join(REPO, ".jax_cache"),
+    )
+    common = [sys.executable, "-m",
+              "distributed_parameter_server_for_ml_training_tpu.cli"]
+    server = subprocess.Popen(
+        common + ["serve", "--mode", "async", "--workers", "1",
+                  "--port", str(port), "--model", "vit_tiny",
+                  "--num-classes", "100", "--image-size", "32",
+                  "--platform", "cpu", "--emit-metrics"],
+        cwd=REPO, env=env,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+    worker = None
+    try:
+        worker = subprocess.Popen(
+            common + ["worker", "--server", f"localhost:{port}",
+                      "--worker-name", "proc-w0", "--model", "vit_tiny",
+                      "--synthetic", "--num-train", "64", "--num-test", "32",
+                      "--epochs", "1", "--batch-size", "32",
+                      "--platform", "cpu", "--dtype", "float32",
+                      "--no-augment", "--emit-metrics"],
+            cwd=REPO, env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        # Generous: two cold jit compiles on a potentially shared/slow CPU.
+        w_out, _ = worker.communicate(timeout=540)
+        # Server exits on its own once all registered workers JobFinished.
+        s_out, _ = server.communicate(timeout=120)
+    finally:
+        for p in (server, worker):
+            if p is not None and p.poll() is None:
+                p.kill()
+
+    w_text = w_out.decode(errors="replace")
+    s_text = s_out.decode(errors="replace")
+    assert worker.returncode == 0, w_text
+    assert server.returncode == 0, s_text
+
+    # Both ends emitted the reference's METRICS_JSON convention
+    # (server.py:367, worker.py:435; parsed like parse_cloudwatch_logs).
+    sm = json.loads(re.search(r"METRICS_JSON:\s*(\{.*\})", s_text).group(1))
+    wm = json.loads(re.search(r"METRICS_JSON:\s*(\{.*\})", w_text).group(1))
+    assert sm["mode"] == "async"
+    assert sm["global_steps_completed"] == 2   # 64 imgs / batch 32
+    assert sm["gradients_processed"] == 2
+    assert wm["local_steps_completed"] == 2
+    assert wm["worker_id"] == 0

@@ -242,3 +242,20 @@ class TestNativeStore:
         assert nat.global_step == 12  # 48 pushes / 4 per round
         for k, v in nat.parameters.items():
             assert np.all(np.isfinite(v)), k
+
+    def test_departed_pending_slot_released_after_round(self):
+        """A worker that departs while its final push is pending gets its
+        C++ slot released once the round consumes it (no per-churn arena
+        leak)."""
+        nat = NativeParameterStore(params(), StoreConfig(
+            mode="sync", total_workers=2, push_codec="none"))
+        w0, _ = nat.register_worker()
+        w1, _ = nat.register_worker()
+        g = {k: v.astype(np.float32) for k, v in grads(4).items()}
+        nat.push(w0, g, 0)
+        nat.job_finished(w0)         # deferred: its push is still pending
+        assert w0 in nat._slot_of    # not yet released
+        nat.push(w1, g, 0)           # completes the round
+        assert nat.global_step == 1
+        assert w0 not in nat._slot_of
+        assert nat._free_slots       # the slot index was recycled
