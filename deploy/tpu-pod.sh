@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Multi-host SPMD sync training on a Cloud TPU pod slice — the TPU-native
+# replacement for the reference's terraform/deploy.sh (ECS cluster + NLB).
+# One jax.distributed job across all hosts; coordinator/process counts are
+# auto-detected on TPU VMs, so every host runs the SAME command.
+#
+#   ./deploy/tpu-pod.sh v5e-16 my-pod us-west4-a
+set -euo pipefail
+
+ACCEL=${1:?accelerator type, e.g. v5e-16}
+NAME=${2:?TPU name}
+ZONE=${3:?zone}
+
+gcloud compute tpus tpu-vm create "$NAME" \
+    --zone "$ZONE" --accelerator-type "$ACCEL" \
+    --version tpu-ubuntu2204-base
+
+REPO_URL=${REPO_URL:?set REPO_URL to the git URL of this repository}
+gcloud compute tpus tpu-vm ssh "$NAME" --zone "$ZONE" --worker=all \
+    --command "pip install 'jax[tpu]' && git clone '$REPO_URL' dps \
+               && pip install ./dps"
+
+# --multihost with no coordinator flags: jax.distributed.initialize()
+# auto-detects the pod topology on TPU VMs.
+gcloud compute tpus tpu-vm ssh "$NAME" --zone "$ZONE" --worker=all \
+    --command 'dps-tpu train --mode sync --multihost --epochs 20 \
+               --emit-metrics'

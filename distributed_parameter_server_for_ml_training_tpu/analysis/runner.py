@@ -43,18 +43,11 @@ def run_cell(dataset: Dataset, mode: str, n_workers: int, *,
     flat = flatten_params(variables["params"])
     cfg = StoreConfig(mode=mode, total_workers=n_workers, learning_rate=lr,
                       staleness_bound=staleness_bound)
-    if backend == "native":
-        from ..native import NativeParameterStore
-        store = NativeParameterStore(flat, cfg)
-    elif backend == "device":
-        # Device-resident store: tensors never cross the host<->device link —
-        # the only backend that runs reference-scale cells at full speed on
-        # a remote-attached TPU (~3 MB/s tunnel would otherwise move ~90 MB
-        # per worker step).
-        from ..ps.device_store import DeviceParameterStore
-        store = DeviceParameterStore(flat, cfg)
-    else:
-        store = ParameterStore(flat, cfg)
+    # 'device' keeps tensors in HBM — the only backend that runs
+    # reference-scale cells at full speed on a remote-attached TPU (the
+    # ~3 MB/s tunnel would otherwise move ~90 MB per worker step).
+    from ..ps import make_store
+    store = make_store(backend, flat, cfg)
 
     results = run_workers(
         store, model, dataset, n_workers,

@@ -112,6 +112,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "restart pollution, README.md:368-371)")
     t.add_argument("--worker-timeout", type=float, default=None,
                    help="expire workers unseen for this many seconds")
+    t.add_argument("--store-backend",
+                   choices=["python", "native", "device"],
+                   default="python",
+                   help="async parameter-store backend: host numpy, C++ "
+                        "arena, or HBM-resident (zero host-link bytes per "
+                        "step)")
     t.add_argument("--plot", default=None, help="save a results plot (png)")
     t.add_argument("--checkpoint-dir", default=None,
                    help="save checkpoints each epoch (gap-fill, SURVEY §5.4)")
@@ -279,7 +285,8 @@ def cmd_train(args) -> int:
         sync_steps=args.sync_steps, k_step_mode=args.k_step_mode,
         staleness_bound=args.staleness_bound, compression=args.compression,
         strict_rounds=args.strict_rounds, elastic=args.elastic,
-        worker_timeout=args.worker_timeout, augment=not args.no_augment,
+        worker_timeout=args.worker_timeout,
+        store_backend=args.store_backend, augment=not args.no_augment,
         dtype=args.dtype, model=args.model, num_classes=num_classes,
         seed=args.seed)
     trainer = (SyncTrainer if args.mode == "sync" else AsyncTrainer)(

@@ -12,9 +12,23 @@ import numpy as np
 _LIB = None
 _LIB_LOCK = threading.Lock()
 
+# Search order for libps_core.so: explicit override, the source checkout's
+# native/ dir, or alongside this module (where installed images copy it —
+# a pip-installed package has no ../../native).
 _NATIVE_DIR = os.path.abspath(
     os.path.join(os.path.dirname(__file__), "..", "..", "native"))
-_SO_PATH = os.path.join(_NATIVE_DIR, "libps_core.so")
+_SO_CANDIDATES = [
+    os.environ.get("DPS_NATIVE_LIB", ""),
+    os.path.join(_NATIVE_DIR, "libps_core.so"),
+    os.path.join(os.path.dirname(__file__), "libps_core.so"),
+]
+
+
+def _find_so() -> str | None:
+    for p in _SO_CANDIDATES:
+        if p and os.path.isfile(p):
+            return p
+    return None
 
 
 def _build() -> bool:
@@ -23,7 +37,7 @@ def _build() -> bool:
     try:
         subprocess.run(["make", "-C", _NATIVE_DIR], check=True,
                        capture_output=True, timeout=120)
-        return os.path.isfile(_SO_PATH)
+        return _find_so() is not None
     except (subprocess.SubprocessError, OSError):
         return False
 
@@ -34,9 +48,9 @@ def load_library() -> ctypes.CDLL | None:
     with _LIB_LOCK:
         if _LIB is not None:
             return _LIB
-        if not os.path.isfile(_SO_PATH) and not _build():
+        if _find_so() is None and not _build():
             return None
-        lib = ctypes.CDLL(_SO_PATH)
+        lib = ctypes.CDLL(_find_so())
 
         u16p = ctypes.POINTER(ctypes.c_uint16)
         f32p = ctypes.POINTER(ctypes.c_float)

@@ -18,9 +18,24 @@ from .device_store import DeviceParameterStore
 from .store import ParameterStore, StoreConfig
 from .worker import PSWorker, WorkerConfig, WorkerResult, run_workers
 
+
+def make_store(backend: str, flat_params, config: StoreConfig):
+    """Build a parameter store by backend name: 'python' (host numpy),
+    'native' (C++ arena), or 'device' (HBM-resident)."""
+    if backend == "native":
+        from ..native import NativeParameterStore
+        return NativeParameterStore(flat_params, config)
+    if backend == "device":
+        return DeviceParameterStore(flat_params, config)
+    if backend != "python":
+        raise ValueError(f"unknown store backend {backend!r}")
+    return ParameterStore(flat_params, config)
+
+
 __all__ = [
     "ParameterStore",
     "DeviceParameterStore",
+    "make_store",
     "StoreConfig",
     "PSWorker",
     "WorkerConfig",

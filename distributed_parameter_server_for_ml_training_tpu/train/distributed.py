@@ -46,6 +46,11 @@ class DistributedConfig:
     strict_rounds: bool = False
     elastic: bool = False          # elastic membership (StoreConfig.elastic)
     worker_timeout: float | None = None  # liveness expiry (seconds)
+    # Async store backend: 'python' (host numpy), 'native' (C++ arena), or
+    # 'device' (HBM-resident — zero host-link bytes per worker step; the
+    # only backend that runs reference-scale async on a remote-attached
+    # chip).
+    store_backend: str = "python"
     augment: bool = True
     num_classes: int = 100
     dtype: str = "bfloat16"
@@ -230,8 +235,9 @@ class AsyncTrainer:
         variables = self.model.init(
             jax.random.PRNGKey(cfg.seed),
             np.zeros((1, h, w, 3), np.float32), train=False)
-        self.store = ParameterStore(
-            flatten_params(variables["params"]),
+        from ..ps import make_store
+        self.store = make_store(
+            cfg.store_backend, flatten_params(variables["params"]),
             StoreConfig(mode=cfg.mode, total_workers=cfg.num_workers,
                         learning_rate=cfg.learning_rate,
                         staleness_bound=cfg.staleness_bound,

@@ -142,3 +142,18 @@ def test_run_workers_with_device_store_learns(devices, tiny_model):
     # Clearly above the 10-class chance floor after 3 epochs.
     assert all(a > 0.15 for a in accs), accs
     assert store.metrics()["store_backend"] == "device"
+
+
+def test_async_trainer_store_backend_dispatch(devices):
+    """DistributedConfig.store_backend selects the store implementation."""
+    from distributed_parameter_server_for_ml_training_tpu.data import (
+        synthetic_cifar100)
+    from distributed_parameter_server_for_ml_training_tpu.train.distributed import (
+        AsyncTrainer, DistributedConfig)
+
+    ds = synthetic_cifar100(n_train=64, n_test=32, num_classes=10)
+    t = AsyncTrainer(ds, DistributedConfig(
+        mode="async", num_workers=2, store_backend="device",
+        num_classes=10))
+    assert t.store.store_backend == "device"
+    assert t.store.push_codec == "none"  # nothing crosses a wire
