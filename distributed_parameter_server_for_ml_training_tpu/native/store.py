@@ -27,8 +27,8 @@ from typing import Mapping
 
 import numpy as np
 
-from ..ps.semantics import DEFAULT_STALENESS_BOUND
-from ..ps.store import MAX_WORKERS, MembershipMixin, StoreConfig, _Stats
+
+from ..ps.store import MembershipMixin, StoreConfig, _Stats
 from .bindings import _f32p, _i64p, _u16p, load_library
 
 

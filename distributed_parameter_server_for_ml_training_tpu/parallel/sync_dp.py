@@ -28,8 +28,6 @@ no-barrier behavior is unreproducible in SPMD and documented as such.
 """
 
 from __future__ import annotations
-
-from functools import partial
 from typing import Callable
 
 import jax

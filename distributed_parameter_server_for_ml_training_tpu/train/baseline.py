@@ -17,7 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..data.cifar import Dataset, make_batches
-from ..models import ResNet18
+
 from ..utils.metrics import emit_metrics_json
 from .optimizers import baseline_optimizer, server_sgd
 from .steps import make_eval_step, make_train_step
