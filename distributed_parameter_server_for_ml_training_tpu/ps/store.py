@@ -138,6 +138,12 @@ class MembershipMixin:
     def wait_all_finished(self, timeout: float | None = None) -> bool:
         return self._finished_event.wait(timeout)
 
+    def membership_snapshot(self) -> list[int]:
+        """Sorted copy of the live worker ids, taken under the registration
+        lock (safe against concurrent register/finish/expire)."""
+        with self._registration_lock:
+            return sorted(self.active_workers)
+
     def _round_target(self) -> int:
         """Sync-round completion size: fixed total (server.py:271-274) or,
         in elastic mode, the live membership count."""

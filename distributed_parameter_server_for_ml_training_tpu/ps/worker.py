@@ -128,6 +128,30 @@ class PSWorker(threading.Thread):
             except Exception:
                 pass  # transient failures are what registration retry is for
 
+    def _compute_shard(self, worker_id: int, total_workers: int):
+        """This worker's contiguous data shard.
+
+        Faithful mode: fixed split by registration id over the configured
+        total (worker.py:166-179), ids wrapping into range. Elastic mode:
+        split over the LIVE membership by rank among active workers — at
+        epoch boundaries this rebalances coverage as workers join/leave.
+        """
+        n = len(self.dataset.x_train)
+        # Remote (gRPC) stores don't expose membership; they use the fixed
+        # split.
+        cfg = getattr(self.store, "config", None)
+        if getattr(cfg, "elastic", False) \
+                and hasattr(self.store, "membership_snapshot"):
+            active = self.store.membership_snapshot()
+            if worker_id in active:
+                rank, total = active.index(worker_id), len(active)
+            else:  # raced with own expiry: keep the fallback split
+                rank, total = worker_id % total_workers, total_workers
+        else:
+            rank, total = worker_id % total_workers, total_workers
+        lo, hi = shard_range(n, rank, total)
+        return self.dataset.x_train[lo:hi], self.dataset.y_train[lo:hi]
+
     def _run(self) -> None:
         cfg = self.config
         worker_id, total_workers = self.store.register_worker(self.worker_name)
@@ -137,14 +161,6 @@ class PSWorker(threading.Thread):
                 target=self._heartbeat_loop,
                 args=(worker_id, cfg.heartbeat_interval),
                 daemon=True).start()
-
-        # Contiguous shard by worker id (worker.py:166-179). Worker ids beyond
-        # total_workers (late re-registrations) wrap, unlike the reference
-        # where they'd skew coverage (SURVEY.md quirk 10).
-        lo, hi = shard_range(len(self.dataset.x_train),
-                             worker_id % total_workers, total_workers)
-        x_shard = self.dataset.x_train[lo:hi]
-        y_shard = self.dataset.y_train[lo:hi]
 
         # Template structure for flat<->pytree conversion.
         h, w = self.dataset.x_train.shape[1:3]
@@ -162,6 +178,12 @@ class PSWorker(threading.Thread):
 
         for epoch in range(cfg.num_epochs):
             t_epoch = time.time()
+            # Contiguous shard by worker id (worker.py:166-179); ids beyond
+            # total_workers wrap (vs the reference's skewed coverage,
+            # SURVEY.md quirk 10). Recomputed each epoch: in elastic mode
+            # the split covers the LIVE membership, so a net-new joiner
+            # takes a fair slice instead of doubling up on a shard.
+            x_shard, y_shard = self._compute_shard(worker_id, total_workers)
             for batch_idx, (xb, yb) in enumerate(make_batches(
                     x_shard, y_shard, cfg.batch_size,
                     seed=cfg.seed * 1000 + epoch)):

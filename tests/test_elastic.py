@@ -216,3 +216,77 @@ def test_run_workers_reaper_unwedges_elastic_round(devices, tiny_model):
     assert all(r.error is None for r in results)
     assert ghost_id not in store.active_workers  # reaper expired it
     assert store.global_step > 0                 # rounds completed at size 2
+
+
+def test_elastic_shard_rebalance_unit(devices, tiny_model):
+    """_compute_shard splits over LIVE membership by rank in elastic mode,
+    and over the fixed total (id-wrapped) in faithful mode."""
+    from distributed_parameter_server_for_ml_training_tpu.data import (
+        synthetic_cifar100)
+    from distributed_parameter_server_for_ml_training_tpu.ps.worker import (
+        PSWorker)
+
+    ds = synthetic_cifar100(n_train=300, n_test=32, num_classes=10)
+    el = ParameterStore(_params(), StoreConfig(
+        mode="async", total_workers=2, elastic=True, push_codec="none"))
+    for _ in range(3):
+        el.register_worker()          # live membership: {0, 1, 2}
+    w = PSWorker(el, tiny_model(), ds)
+    x1, _ = w._compute_shard(1, total_workers=2)
+    assert len(x1) == 100             # 300 / 3 live workers, rank 1
+    x2, _ = w._compute_shard(2, total_workers=2)
+    assert len(x2) == 100             # net-new joiner gets a fair slice
+    el.job_finished(2)
+    x1b, _ = w._compute_shard(1, total_workers=2)
+    assert len(x1b) == 150            # rebalanced over the 2 survivors
+
+    faithful = ParameterStore(_params(), StoreConfig(
+        mode="async", total_workers=2, push_codec="none"))
+    for _ in range(3):
+        faithful.register_worker()
+    wf = PSWorker(faithful, tiny_model(), ds)
+    xf, _ = wf._compute_shard(2, total_workers=2)
+    assert len(xf) == 150             # id 2 wraps onto shard 0 (quirk 10)
+
+
+def test_elastic_join_midrun_rebalances(devices, tiny_model):
+    """A net-new worker joining mid-run takes a fair shard at the next
+    epoch boundary and every worker completes."""
+    from distributed_parameter_server_for_ml_training_tpu.data import (
+        synthetic_cifar100)
+    from distributed_parameter_server_for_ml_training_tpu.ps.worker import (
+        PSWorker)
+    from distributed_parameter_server_for_ml_training_tpu.train.steps import (
+        make_eval_step, make_grad_step)
+    from distributed_parameter_server_for_ml_training_tpu.utils import (
+        flatten_params)
+
+    ds = synthetic_cifar100(n_train=384, n_test=64, num_classes=10, seed=15)
+    model = tiny_model()
+    variables = model.init(jax.random.PRNGKey(0),
+                           np.zeros((1, 32, 32, 3), np.float32), train=False)
+    store = ParameterStore(
+        flatten_params(variables["params"]),
+        StoreConfig(mode="async", total_workers=2, elastic=True,
+                    push_codec="none"))
+    grad_step = make_grad_step(model, augment=False)
+    eval_step = jax.jit(make_eval_step())
+    wc = WorkerConfig(batch_size=32, num_epochs=3, augment=False,
+                      eval_each_epoch=False)
+
+    first = [PSWorker(store, model, ds, wc, grad_step=grad_step,
+                      eval_step=eval_step, worker_name=f"w{i}")
+             for i in range(2)]
+    for w in first:
+        w.start()
+    time.sleep(0.5)  # let epoch 1 begin with 2 workers
+    late = PSWorker(store, model, ds, wc, grad_step=grad_step,
+                    eval_step=eval_step, worker_name="late")
+    late.start()
+    for w in first + [late]:
+        w.join(180)
+    assert all(not w.is_alive() for w in first + [late]), "worker wedged"
+    assert all(w.result.error is None for w in first + [late])
+    assert late.result.worker_id == 2
+    assert late.result.local_steps_completed > 0
+    assert store.global_step > 0
