@@ -79,21 +79,15 @@ class CheckpointManager:
 def save_store(store: ParameterStore, directory: str) -> str:
     """Atomic snapshot of a parameter store: params npz + metadata JSON.
 
-    Works for both the host-numpy ParameterStore and the HBM-resident
-    DeviceParameterStore (whose jax arrays are immutable — the reference
-    grab stays consistent; np.savez pulls them to host once per snapshot).
-    Enables the <30 s recovery the reference targeted but never built
+    Works for every store backend through the uniform ``snapshot()`` surface:
+    host-numpy ParameterStore (copy under param_lock), HBM-resident
+    DeviceParameterStore (immutable refs pulled to host), and the C++
+    NativeParameterStore (seqlock-consistent arena fetch). Enables the <30 s
+    recovery the reference targeted but never built
     (baseline_summary.json distributed_system_targets; SURVEY.md §4).
     """
     os.makedirs(directory, exist_ok=True)
-    step = store.global_step
-    device_arrays = getattr(store, "keeps_device_arrays", False)
-    with store._param_lock:  # consistent (params, step) pair
-        arrays = {k: (v if device_arrays else v.copy())
-                  for k, v in store.parameters.items()}
-        step = store.global_step
-    if device_arrays:
-        arrays = {k: np.asarray(v) for k, v in arrays.items()}
+    arrays, step = store.snapshot()
     # Unique temp name per call: concurrent snapshots (periodic thread +
     # final snapshot) must never interleave writes into one file.
     tmp = os.path.join(directory,
@@ -131,19 +125,20 @@ def restore_store(store: ParameterStore, directory: str,
     with open(os.path.join(directory,
                            name.replace(".npz", ".json"))) as f:
         meta = json.load(f)
-    if getattr(store, "keeps_device_arrays", False):
-        import jax.numpy as jnp
-        params = {k: jnp.asarray(data[k], jnp.float32) for k in data.files}
-    else:
-        params = {k: np.array(data[k], np.float32) for k in data.files}
-    with store._param_lock:
-        store.parameters = params
-        store.global_step = int(meta["global_step"])
+    params = {k: np.array(data[k], np.float32) for k in data.files}
+    store.load_snapshot(params, int(meta["global_step"]))
     return store.global_step
 
 
 class PeriodicStoreCheckpointer(threading.Thread):
-    """Background thread snapshotting the store every ``interval`` seconds."""
+    """Background thread snapshotting the store every ``interval`` seconds.
+
+    A failed periodic snapshot (disk full, permissions) is logged and
+    retried at the next tick rather than silently killing the thread — one
+    transient failure must not permanently disable the <30 s recovery path.
+    The most recent failure (cleared by any later success) is kept in
+    ``last_error`` and returned by ``stop()``.
+    """
 
     def __init__(self, store: ParameterStore, directory: str,
                  interval: float = 30.0):
@@ -151,17 +146,31 @@ class PeriodicStoreCheckpointer(threading.Thread):
         self.store = store
         self.directory = directory
         self.interval = interval
+        self.last_error: Exception | None = None
         # NB: must not be named _stop — that would shadow
         # threading.Thread._stop(), which join() calls internally.
         self._stop_event = threading.Event()
 
     def run(self):
         while not self._stop_event.wait(self.interval):
-            save_store(self.store, self.directory)
+            try:
+                save_store(self.store, self.directory)
+                self.last_error = None
+            except Exception as e:  # noqa: BLE001 — keep snapshotting
+                self.last_error = e
+                print(f"periodic store snapshot failed (will retry in "
+                      f"{self.interval:.0f}s): {e!r}")
 
-    def stop(self, final_snapshot: bool = True):
+    def stop(self, final_snapshot: bool = True) -> Exception | None:
+        """Stop the thread; returns the last unrecovered periodic failure
+        (None if the latest snapshot attempt succeeded)."""
         self._stop_event.set()
         if self.is_alive():
             self.join()  # let an in-flight periodic snapshot finish first
         if final_snapshot:
+            # The final snapshot still raises on failure: unlike a periodic
+            # tick there is no later retry, and the caller must know the
+            # run's end state was not persisted.
             save_store(self.store, self.directory)
+            self.last_error = None
+        return self.last_error

@@ -42,13 +42,27 @@ def _build() -> bool:
         return False
 
 
+def _stale(so: str) -> bool:
+    """True when the checkout's C++ source is newer than the found .so (a
+    rebuilt source must not bind against a stale library missing symbols)."""
+    src = os.path.join(_NATIVE_DIR, "ps_core.cpp")
+    try:
+        return os.path.getmtime(src) > os.path.getmtime(so)
+    except OSError:
+        return False
+
+
 def load_library() -> ctypes.CDLL | None:
     """Load (building if needed) the native library; None if unavailable."""
     global _LIB
     with _LIB_LOCK:
         if _LIB is not None:
             return _LIB
-        if _find_so() is None and not _build():
+        so = _find_so()
+        if (so is None or _stale(so)) and not _build():
+            # Missing OR stale-and-unbuildable: a stale .so may lack newer
+            # symbols, and binding it would raise AttributeError below —
+            # report the native backend unavailable instead.
             return None
         lib = ctypes.CDLL(_find_so())
 
@@ -67,6 +81,7 @@ def load_library() -> ctypes.CDLL | None:
         lib.dps_store_rejected.restype = i64
         lib.dps_store_fetch.argtypes = [ctypes.c_void_p, f32p]
         lib.dps_store_fetch.restype = i64
+        lib.dps_store_load.argtypes = [ctypes.c_void_p, f32p, i64]
         lib.dps_store_push_fp16.argtypes = [ctypes.c_void_p, u16p, i64, i64]
         lib.dps_store_push_fp16.restype = i64
         lib.dps_store_push_fp32.argtypes = [ctypes.c_void_p, f32p, i64, i64]

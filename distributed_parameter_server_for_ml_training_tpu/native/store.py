@@ -35,9 +35,13 @@ from .bindings import _f32p, _i64p, _u16p, load_library
 class NativeParameterStore(MembershipMixin):
     """ParameterStore drop-in with the C++ core under the hot path."""
 
+    store_backend = "native"
+
     def __init__(self, initial_params: Mapping[str, np.ndarray],
                  config: StoreConfig | None = None):
         self.config = config or StoreConfig(mode="async")
+        if self.config.push_codec is None:
+            self.config.push_codec = "fp16"  # reference default
         if self.config.fetch_codec != "none":
             raise ValueError(
                 "NativeParameterStore fetches fp32 from the arena; "
@@ -123,6 +127,22 @@ class NativeParameterStore(MembershipMixin):
         if worker_id is not None:
             self.last_seen[worker_id] = time.time()
         return self._unpack(flat), step
+
+    # -- checkpoint surface (same contract as AggregationBase.snapshot) ------
+
+    def snapshot(self) -> tuple[dict[str, np.ndarray], int]:
+        """Consistent (params, step) via the seqlock fetch — pushes are never
+        blocked while a snapshot copies the arena."""
+        flat, step = self._fetch_flat()
+        return self._unpack(flat), step
+
+    def load_snapshot(self, params: Mapping[str, np.ndarray],
+                      step: int) -> None:
+        """Write a snapshot back into the C++ arena under its write lock
+        (dps_store_load brackets the copy with the seqlock, so concurrent
+        fetches retry rather than observe a half-restored arena)."""
+        flat = self._pack(params, np.float32)
+        self._lib.dps_store_load(self._handle, _f32p(flat), int(step))
 
     def _pack(self, gradients: Mapping[str, np.ndarray],
               dtype) -> np.ndarray:
@@ -259,7 +279,9 @@ class NativeParameterStore(MembershipMixin):
         elapsed = time.time() - self.stats.start_time
         out = {
             "mode": self.config.mode,
-            "backend": "native",
+            # Same key as AggregationBase.metrics so the ETL can filter
+            # records from all three backends uniformly.
+            "store_backend": self.store_backend,
             "total_workers": self.config.total_workers,
             "total_training_time_seconds": round(elapsed, 2),
             "global_steps_completed": self.global_step,

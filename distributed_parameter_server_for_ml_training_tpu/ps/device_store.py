@@ -75,6 +75,21 @@ class DeviceParameterStore(AggregationBase):
     def __init__(self, initial_params: Mapping[str, np.ndarray],
                  config: StoreConfig | None = None):
         self.config = config or StoreConfig()
+        if self.config.push_codec is None:
+            self.config.push_codec = "none"  # no wire to compress
+        elif self.config.push_codec != "none":
+            # An EXPLICITLY requested codec cannot apply: nothing crosses a
+            # wire here, so the reference's fp16 gradient quantization
+            # (worker.py:264-268) is skipped — gradient numerics differ
+            # from the python/native backends. Make that explicit instead of
+            # silently ignoring the config.
+            import warnings
+            warnings.warn(
+                f"DeviceParameterStore ignores push_codec="
+                f"{self.config.push_codec!r}: device-resident pushes are "
+                f"uncompressed fp32 (no wire); gradients skip the fp16 "
+                f"quantization the python/native backends apply",
+                stacklevel=2)
         self.parameters: dict[str, jax.Array] = {
             k: jnp.asarray(v, jnp.float32) for k, v in initial_params.items()
         }

@@ -53,7 +53,11 @@ class StoreConfig:
     total_workers: int = 4
     learning_rate: float = 0.1  # server.py:84, 413
     staleness_bound: int = DEFAULT_STALENESS_BOUND
-    push_codec: str = "fp16"  # 'none' | 'fp16' (reference pushes fp16)
+    # 'none' | 'fp16' | None = backend default ('fp16' for the wire-crossing
+    # python/native stores, matching the reference's worker-side cast
+    # (worker.py:264-268); 'none' for the device store, which crosses no
+    # wire). Stores resolve the sentinel at construction.
+    push_codec: str | None = None
     fetch_codec: str = "none"  # reference fetches fp32 (server.py:222)
     strict_rounds: bool = False  # True = corrected double-push semantics
     # Membership expiry. The reference tracks last_seen but NEVER expires
@@ -146,9 +150,14 @@ class MembershipMixin:
 
     def _round_target(self) -> int:
         """Sync-round completion size: fixed total (server.py:271-274) or,
-        in elastic mode, the live membership count."""
+        in elastic mode, the live membership count (snapshotted under the
+        registration lock — callers hold only the sync lock, and a racing
+        register/expire must not yield a torn count; lock order sync ->
+        registration is safe because no path takes them the other way
+        round)."""
         if getattr(self.config, "elastic", False):
-            return max(1, len(self.active_workers))
+            with self._registration_lock:
+                return max(1, len(self.active_workers))
         return self.config.total_workers
 
     def _on_workers_expired(self, stale: list[int]) -> None:
@@ -275,6 +284,36 @@ class AggregationBase(MembershipMixin):
         self.stats.update_times.append(time.time() - t0)
         return True
 
+    # -- checkpoint surface --------------------------------------------------
+
+    def snapshot(self) -> tuple[dict[str, np.ndarray], int]:
+        """Consistent (host-numpy params copy, global_step) pair for
+        checkpointing — the capability the reference listed as future work
+        (DEPLOYMENT.md:309). Device-array stores convert to host OUTSIDE the
+        lock (jax arrays are immutable, so the references stay consistent
+        while the transfer runs)."""
+        device_arrays = getattr(self, "keeps_device_arrays", False)
+        with self._param_lock:
+            params = {k: (v if device_arrays else v.copy())
+                      for k, v in self.parameters.items()}
+            step = self.global_step
+        if device_arrays:
+            params = {k: np.asarray(v) for k, v in params.items()}
+        return params, step
+
+    def load_snapshot(self, params: Mapping[str, np.ndarray],
+                      step: int) -> None:
+        """Restore a (params, step) snapshot; conversion happens outside the
+        lock, the swap inside it."""
+        if getattr(self, "keeps_device_arrays", False):
+            import jax.numpy as jnp
+            new = {k: jnp.asarray(v, jnp.float32) for k, v in params.items()}
+        else:
+            new = {k: np.array(v, np.float32) for k, v in params.items()}
+        with self._param_lock:
+            self.parameters = new
+            self.global_step = int(step)
+
     # -- observability -------------------------------------------------------
 
     def metrics(self) -> dict:
@@ -315,6 +354,8 @@ class ParameterStore(AggregationBase):
     def __init__(self, initial_params: Mapping[str, np.ndarray],
                  config: StoreConfig | None = None):
         self.config = config or StoreConfig()
+        if self.config.push_codec is None:
+            self.config.push_codec = "fp16"  # reference default
         self.parameters: dict[str, np.ndarray] = {
             k: np.array(v, np.float32) for k, v in initial_params.items()
         }

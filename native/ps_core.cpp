@@ -176,6 +176,19 @@ int64_t dps_store_fetch(void* h, float* out) {
   }
 }
 
+// Checkpoint restore: overwrite the arena + step under the write lock with
+// the seqlock odd/even bracket, so concurrent fetches never observe a
+// half-restored parameter set (the write-side dual of dps_store_fetch).
+void dps_store_load(void* h, const float* src, int64_t step) {
+  auto* s = static_cast<Store*>(h);
+  std::lock_guard<std::mutex> g(s->write_lock);
+  const int64_t n = (int64_t)s->params.size();
+  s->version.fetch_add(1, std::memory_order_acq_rel);  // odd: writing
+  std::memcpy(s->params.data(), src, n * sizeof(float));
+  s->global_step.store(step);  // before even bump, like the push paths
+  s->version.fetch_add(1, std::memory_order_acq_rel);  // even: stable
+}
+
 // Fused fp16-decode + staleness-weighted SGD apply (async push).
 // Returns the new global step, or -1 if rejected by the staleness bound.
 int64_t dps_store_push_fp16(void* h, const uint16_t* grads,
