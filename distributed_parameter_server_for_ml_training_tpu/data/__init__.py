@@ -5,6 +5,7 @@ from .cifar import (
     CIFAR100_STD,
     Dataset,
     augment_batch,
+    compositional_cifar100,
     load_cifar100,
     make_batches,
     normalize,
@@ -19,6 +20,7 @@ __all__ = [
     "CIFAR100_STD",
     "Dataset",
     "augment_batch",
+    "compositional_cifar100",
     "load_cifar100",
     "make_batches",
     "normalize",
