@@ -22,6 +22,18 @@ import numpy as np
 from .service import GRPC_OPTIONS, SERVICE_NAME, pack_msg, unpack_msg
 
 
+class _RemoteConfig:
+    """Server-side StoreConfig facts the client learns at registration.
+    PSWorker duck-types ``store.config`` for the elastic flag
+    (ps/worker.py:_compute_shard); this is the remote half of that
+    contract."""
+
+    def __init__(self):
+        self.elastic = False
+        self.mode = "sync"
+        self.learning_rate = 0.1
+
+
 class RemoteStore:
     """Client-side stand-in for ParameterStore over gRPC."""
 
@@ -43,6 +55,22 @@ class RemoteStore:
         #: (worker.py:264-268) and decompress after fetch.
         self.push_codec = "none"
         self.fetch_codec = "none"
+        self.config = _RemoteConfig()
+        # Last membership seen on the wire (elastic servers piggyback it on
+        # Register/Fetch replies). Workers fetch at least once per K-step
+        # window, so by an epoch boundary this reflects recent churn.
+        self._membership: list[int] = []
+
+    def _note_membership(self, reply_meta: dict) -> None:
+        m = reply_meta.get("active_workers")
+        if m is not None:
+            self._membership = [int(w) for w in m]
+
+    def membership_snapshot(self) -> list[int]:
+        """Client-side view of the server's live membership (sorted ids),
+        as of the most recent Register/Fetch reply. Empty until the first
+        reply from an elastic server."""
+        return list(self._membership)
 
     def register_worker(self, worker_name: str = "") -> tuple[int, int]:
         """Retry x5 with exponential backoff (worker.py:215-229)."""
@@ -54,6 +82,11 @@ class RemoteStore:
                     pack_msg({"worker_name": worker_name})))
                 self.push_codec = reply.get("push_codec", "none")
                 self.fetch_codec = reply.get("fetch_codec", "none")
+                self.config.elastic = bool(reply.get("elastic", False))
+                self.config.mode = reply.get("mode", "sync")
+                self.config.learning_rate = float(
+                    reply.get("learning_rate", 0.1))
+                self._note_membership(reply)
                 return int(reply["worker_id"]), int(reply["total_workers"])
             except grpc.RpcError as e:
                 last_err = e
@@ -69,6 +102,7 @@ class RemoteStore:
         meta = {} if worker_id is None else {"worker_id": worker_id}
         reply = self._call["FetchParameters"](pack_msg(meta))
         rmeta, payload = unpack_msg(reply)
+        self._note_membership(rmeta)
         return decode_tensor_dict(payload), int(rmeta["global_step"])
 
     def push(self, worker_id: int, gradients: dict, fetched_step: int) -> bool:

@@ -137,8 +137,10 @@ class PSWorker(threading.Thread):
         epoch boundaries this rebalances coverage as workers join/leave.
         """
         n = len(self.dataset.x_train)
-        # Remote (gRPC) stores don't expose membership; they use the fixed
-        # split.
+        # Works for remote (gRPC) stores too: elastic servers piggyback live
+        # membership on Register/Fetch replies and RemoteStore caches it, so
+        # its membership_snapshot() serves the same role as the in-process
+        # store's lock-guarded one.
         cfg = getattr(self.store, "config", None)
         if getattr(cfg, "elastic", False) \
                 and hasattr(self.store, "membership_snapshot"):
@@ -178,6 +180,13 @@ class PSWorker(threading.Thread):
 
         for epoch in range(cfg.num_epochs):
             t_epoch = time.time()
+            # The epoch's first fetch happens BEFORE the shard computation:
+            # batch 0 is always a fetch boundary anyway (batch_idx % K == 0),
+            # and hoisting it means a REMOTE store's membership cache is
+            # fresh when the shard is computed — at registration time the
+            # first worker only sees itself, and an epoch-1 shard computed
+            # from that would cover the whole dataset.
+            params, fetched_step = self._fetch_params(worker_id)
             # Contiguous shard by worker id (worker.py:166-179); ids beyond
             # total_workers wrap (vs the reference's skewed coverage,
             # SURVEY.md quirk 10). Recomputed each epoch: in elastic mode
@@ -188,12 +197,8 @@ class PSWorker(threading.Thread):
                     x_shard, y_shard, cfg.batch_size,
                     seed=cfg.seed * 1000 + epoch)):
                 boundary = batch_idx % k == 0
-                if boundary:
-                    flat, fetched_step = self.store.fetch(worker_id)
-                    if getattr(self.store, "fetch_codec", "none") == "fp16":
-                        from ..ops.compression import fp16_decompress
-                        flat = fp16_decompress(flat)
-                    params = unflatten_params(flat)
+                if boundary and batch_idx > 0:
+                    params, fetched_step = self._fetch_params(worker_id)
 
                 grads, batch_stats, loss, acc = self._grad_step(
                     params, batch_stats, xb, yb, rng,
@@ -225,6 +230,14 @@ class PSWorker(threading.Thread):
             if cfg.eval_each_epoch:
                 self.result.test_accuracies.append(
                     self.evaluate(params, batch_stats))
+
+    def _fetch_params(self, worker_id: int):
+        """One FetchParameters round trip -> (params pytree, fetched step)."""
+        flat, fetched_step = self.store.fetch(worker_id)
+        if getattr(self.store, "fetch_codec", "none") == "fp16":
+            from ..ops.compression import fp16_decompress
+            flat = fp16_decompress(flat)
+        return unflatten_params(flat), fetched_step
 
     def _push_mean(self, worker_id, accum_tree, n: int,
                    fetched_step) -> None:

@@ -26,7 +26,14 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_serve_and_worker_processes():
+@pytest.mark.slow
+@pytest.mark.parametrize("backend", ["python", "native"])
+def test_serve_and_worker_processes(backend):
+    if backend == "native":
+        from distributed_parameter_server_for_ml_training_tpu.native import (
+            bindings)
+        if not bindings.native_available():
+            pytest.skip("libps_core.so not built and no toolchain")
     port = _free_port()
     env = dict(
         os.environ,
@@ -39,6 +46,7 @@ def test_serve_and_worker_processes():
         common + ["serve", "--mode", "async", "--workers", "1",
                   "--port", str(port), "--model", "vit_tiny",
                   "--num-classes", "100", "--image-size", "32",
+                  "--store-backend", backend,
                   "--platform", "cpu", "--emit-metrics"],
         cwd=REPO, env=env,
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
