@@ -87,8 +87,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="baseline/sync/async reproduce the reference's "
                         "modes; tp = data x tensor parallel (GSPMD ViT), "
                         "pp = GPipe pipeline over ViT block groups, "
-                        "sp = ring-attention sequence parallelism, "
-                        "moe = Switch-MoE expert parallelism")
+                        "sp = ring-attention ViT sequence parallelism, "
+                        "moe = Switch-MoE ViT expert parallelism "
+                        "(all four honor --model)")
     t.add_argument("--workers", type=int,
                    default=_env("TOTAL_WORKERS_EXPECTED", 4, int))
     t.add_argument("--tp-degree", type=int, default=2,
@@ -158,8 +159,17 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--seed", type=int, default=0)
     s.add_argument("--emit-metrics", action="store_true")
     s.add_argument("--elastic", action="store_true",
-                   help="elastic membership (id reuse + live round sizing)")
+                   help="elastic membership (id reuse + live round sizing); "
+                        "live membership rides Register/Fetch replies so "
+                        "remote workers reshard at epoch boundaries")
     s.add_argument("--worker-timeout", type=float, default=None)
+    s.add_argument("--store-backend",
+                   choices=["python", "native", "device"],
+                   default="python",
+                   help="store implementation behind the service: host "
+                        "numpy, C++ arena (the multi-host host-side hot "
+                        "path the native core was built for), or "
+                        "HBM-resident")
     add_platform(s)
 
     e = sub.add_parser("experiments",
@@ -252,10 +262,6 @@ def cmd_train(args) -> int:
         from .train.model_parallel import (ModelParallelConfig, MoETrainer,
                                            PipelineTrainer, SPTrainer,
                                            TPTrainer)
-        if args.mode in ("sp", "moe"):
-            print(f"note: --mode {args.mode} trains its built-in compact "
-                  f"architecture (--model is ignored; tp/pp honor it)",
-                  file=sys.stderr)
         mp_cfg = ModelParallelConfig(
             model=args.model, num_workers=args.workers,
             tp_degree=args.tp_degree,
@@ -306,7 +312,8 @@ def cmd_serve(args) -> int:
 
     from .comms.service import serve
     from .models import get_model
-    from .ps.store import ParameterStore, StoreConfig
+    from .ps import make_store
+    from .ps.store import StoreConfig
     from .utils.metrics import emit_metrics_json
     from .utils.pytree import flatten_params
 
@@ -316,8 +323,8 @@ def cmd_serve(args) -> int:
     variables = model.init(jax.random.PRNGKey(args.seed),
                            np.zeros((1, size, size, 3), np.float32),
                            train=False)
-    store = ParameterStore(
-        flatten_params(variables["params"]),
+    store = make_store(
+        args.store_backend, flatten_params(variables["params"]),
         StoreConfig(mode=args.mode, total_workers=args.workers,
                     learning_rate=args.lr,
                     staleness_bound=args.staleness_bound,
@@ -325,7 +332,8 @@ def cmd_serve(args) -> int:
                     worker_timeout=args.worker_timeout))
     server, port = serve(store, port=args.port)
     print(f"parameter server up on :{port} "
-          f"(mode={args.mode}, workers={args.workers})", file=sys.stderr)
+          f"(mode={args.mode}, workers={args.workers}, "
+          f"backend={args.store_backend})", file=sys.stderr)
     try:
         # server.py:399-403 sleep-forever loop, but exiting cleanly once all
         # registered workers report JobFinished — and, with --worker-timeout,

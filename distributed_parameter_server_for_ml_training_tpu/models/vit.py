@@ -18,7 +18,7 @@ designed TPU-first:
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Callable
 
 import flax.linen as nn
 import jax
@@ -43,15 +43,57 @@ class MlpBlock(nn.Module):
         return x
 
 
+class SwitchMoEMlp(nn.Module):
+    """Switch-style top-1 MoE replacing the dense MLP of an encoder block.
+
+    The routing/dispatch math lives in parallel/moe.py (shard_map over the
+    ``expert`` axis, two all_to_all hops); this module owns the flax params —
+    router replicated, per-expert FFN stacked [E, ...] so a trainer shards
+    leaf axis 0 one-expert-per-device. Net-new vs the reference (no MoE
+    anywhere, SURVEY.md §2 checklist EP row).
+    """
+
+    moe_fn: Callable            # from parallel/moe.make_moe_ffn(mesh, cap)
+    n_experts: int
+    hidden_dim: int             # per-expert FFN hidden width
+
+    @nn.compact
+    def __call__(self, x):
+        b, t, d = x.shape
+        e, dh = self.n_experts, self.hidden_dim
+        params = {
+            "router": self.param("router",
+                                 nn.initializers.normal(d ** -0.5),
+                                 (d, e), jnp.float32),
+            "w1": self.param("w1", nn.initializers.normal(d ** -0.5),
+                             (e, d, dh), jnp.float32),
+            "b1": self.param("b1", nn.initializers.zeros, (e, dh),
+                             jnp.float32),
+            "w2": self.param("w2", nn.initializers.normal(dh ** -0.5),
+                             (e, dh, d), jnp.float32),
+            "b2": self.param("b2", nn.initializers.zeros, (e, d),
+                             jnp.float32),
+        }
+        # Batch-major flatten: contiguous token shards line up with batch
+        # shards on the same mesh axis (tokens route ACROSS it).
+        y = self.moe_fn(params, x.reshape(b * t, d).astype(jnp.float32))
+        return y.reshape(b, t, d).astype(x.dtype)
+
+
 class SelfAttention(nn.Module):
     """Multi-head self-attention with a fused qkv projection.
 
     einsum formulation keeps everything MXU-shaped; the qkv/out kernels are
-    the TP split points (see parallel/tensor.py rules).
+    the TP split points (see parallel/tensor.py rules). ``attention_fn``
+    swaps the dense softmax for an alternative core with the same
+    [B, T, H, D] x3 -> [B, T, H, D] contract — ring attention
+    (parallel/ring_attention.py) for sequence parallelism, or the Pallas
+    flash kernel (ops/pallas/flash_attention.py).
     """
 
     num_heads: int
     dtype: Dtype = jnp.float32
+    attention_fn: Callable | None = None
 
     @nn.compact
     def __call__(self, x):
@@ -64,11 +106,14 @@ class SelfAttention(nn.Module):
         qkv = qkv.reshape(b, t, 3, self.num_heads, head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
-        scale = 1.0 / np.sqrt(head_dim)
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-        probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-        probs = probs.astype(self.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, t, d)
+        if self.attention_fn is not None:
+            out = self.attention_fn(q, k, v).reshape(b, t, d)
+        else:
+            scale = 1.0 / np.sqrt(head_dim)
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+            probs = probs.astype(self.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, t, d)
         return nn.Dense(d, dtype=self.dtype, param_dtype=jnp.float32,
                         name="out")(out)
 
@@ -77,6 +122,10 @@ class EncoderBlock(nn.Module):
     num_heads: int
     mlp_ratio: int = 4
     dtype: Dtype = jnp.float32
+    attention_fn: Callable | None = None
+    moe_fn: Callable | None = None     # set => Switch-MoE MLP (with experts)
+    moe_experts: int = 0
+    moe_hidden: int | None = None
 
     @nn.compact
     def __call__(self, x):
@@ -84,16 +133,33 @@ class EncoderBlock(nn.Module):
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
                          name="ln1")(x)
         x = x + SelfAttention(self.num_heads, dtype=self.dtype,
+                              attention_fn=self.attention_fn,
                               name="attn")(y)
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
                          name="ln2")(x)
-        x = x + MlpBlock(self.mlp_ratio * d, d, dtype=self.dtype,
-                         name="mlp")(y)
+        if self.moe_fn is not None:
+            x = x + SwitchMoEMlp(self.moe_fn, self.moe_experts,
+                                 self.moe_hidden or self.mlp_ratio * d,
+                                 name="moe")(y)
+        else:
+            x = x + MlpBlock(self.mlp_ratio * d, d, dtype=self.dtype,
+                             name="mlp")(y)
         return x
 
 
 class ViT(nn.Module):
-    """ViT with a CLS token and learned position embeddings."""
+    """ViT with learned position embeddings.
+
+    ``pool='cls'`` (default) prepends a CLS token and classifies from it;
+    ``pool='gap'`` mean-pools the patch tokens instead — no CLS token, so
+    the sequence length stays a power of two and divides evenly across a
+    ``seq`` (ring attention) or ``expert`` (MoE) mesh axis.
+
+    ``attention_fn`` / ``moe_*`` thread down to every EncoderBlock: the
+    registry models become sequence-parallel or expert-parallel by
+    construction, not by a separate toy architecture (round-2 VERDICT
+    item 4).
+    """
 
     patch_size: int = 16
     hidden_dim: int = 768
@@ -102,9 +168,15 @@ class ViT(nn.Module):
     mlp_ratio: int = 4
     num_classes: int = 100
     dtype: Dtype = jnp.float32
+    pool: str = "cls"                       # 'cls' | 'gap'
+    attention_fn: Callable | None = None
+    moe_fn: Callable | None = None
+    moe_experts: int = 0
+    moe_hidden: int | None = None
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
+        assert self.pool in ("cls", "gap"), self.pool
         b, h, w, c = x.shape
         assert h % self.patch_size == 0 and w % self.patch_size == 0, (
             f"image {h}x{w} not divisible by patch {self.patch_size}")
@@ -117,13 +189,14 @@ class ViT(nn.Module):
                     padding="VALID", dtype=self.dtype,
                     param_dtype=jnp.float32, name="patch_embed")(x)
         x = x.reshape(b, -1, self.hidden_dim)
-        n_tokens = x.shape[1] + 1
+        n_tokens = x.shape[1] + (1 if self.pool == "cls" else 0)
 
-        cls = self.param("cls_token", nn.initializers.zeros,
-                         (1, 1, self.hidden_dim), jnp.float32)
-        x = jnp.concatenate(
-            [jnp.broadcast_to(cls, (b, 1, self.hidden_dim)).astype(self.dtype),
-             x], axis=1)
+        if self.pool == "cls":
+            cls = self.param("cls_token", nn.initializers.zeros,
+                             (1, 1, self.hidden_dim), jnp.float32)
+            x = jnp.concatenate(
+                [jnp.broadcast_to(cls, (b, 1, self.hidden_dim)
+                                  ).astype(self.dtype), x], axis=1)
         pos = self.param("pos_embed",
                          nn.initializers.normal(stddev=0.02),
                          (1, n_tokens, self.hidden_dim), jnp.float32)
@@ -131,10 +204,15 @@ class ViT(nn.Module):
 
         for i in range(self.depth):
             x = EncoderBlock(self.num_heads, self.mlp_ratio,
-                             dtype=self.dtype, name=f"block_{i}")(x)
+                             dtype=self.dtype,
+                             attention_fn=self.attention_fn,
+                             moe_fn=self.moe_fn,
+                             moe_experts=self.moe_experts,
+                             moe_hidden=self.moe_hidden,
+                             name=f"block_{i}")(x)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=jnp.float32,
                          name="ln_final")(x)
-        x = x[:, 0]  # CLS token
+        x = x[:, 0] if self.pool == "cls" else x.mean(axis=1)
         x = nn.Dense(self.num_classes, dtype=self.dtype,
                      param_dtype=jnp.float32, name="head")(x)
         return x.astype(jnp.float32)

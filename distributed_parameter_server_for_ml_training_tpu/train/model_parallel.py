@@ -360,49 +360,43 @@ class PipelineTrainer(_EpochTrainer):
 
 
 # ---------------------------------------------------------------------------
-# SP: sequence-parallel transformer (ring attention) as a trainable mode
+# SP: sequence-parallel ViT (ring attention) as a trainable mode
 # ---------------------------------------------------------------------------
 
-def _layer_norm(x, scale, bias):
-    mu = x.mean(-1, keepdims=True)
-    var = ((x - mu) ** 2).mean(-1, keepdims=True)
-    return (x - mu) * jax.lax.rsqrt(var + 1e-6) * scale + bias
-
-
-def _patchify(images, patch: int):
-    """[B, H, W, 3] -> [B, T, patch*patch*3] token sequence."""
-    b, h, w, c = images.shape
-    gh, gw = h // patch, w // patch
-    x = images.reshape(b, gh, patch, gw, patch, c)
-    x = x.transpose(0, 1, 3, 2, 4, 5)
-    return x.reshape(b, gh * gw, patch * patch * c)
-
-
 class SPTrainer(_EpochTrainer):
-    """Sequence-parallel training: every attention runs as RING attention
-    over a ``seq`` mesh axis (parallel/ring_attention.py), so no device ever
-    holds a full [T, T] score matrix or the full K/V sequence.
+    """Sequence-parallel training of the REGISTRY ViT: every encoder block's
+    attention runs as RING attention over a ``seq`` mesh axis
+    (parallel/ring_attention.py wired into models/vit.py:SelfAttention via
+    ``attention_fn``), so no device ever holds a full [T, T] score matrix or
+    the full K/V sequence.
 
     The long-context capability the reference entirely lacks (SURVEY.md
-    §5.7), demonstrated trainable end-to-end: image patches form the token
-    sequence (T = (32/patch)^2, sharded T/N per device), blocks are
-    pre-LN attention + MLP with replicated weights, mean-pool head.
+    §5.7), on the real model family: ``--mode sp --model vit_tiny|vit_b16``.
+    ``pool='gap'`` (mean-pool head, no CLS token) keeps the sequence length
+    a multiple of the shard count.
     """
 
     mode = "sp"
-    D_MODEL, N_HEADS, DEPTH, PATCH = 128, 4, 2, 4
 
     def __init__(self, dataset: Dataset, config: ModelParallelConfig | None = None):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..models.vit import ViT
         from ..parallel.ring_attention import make_ring_attention
 
         super().__init__(dataset, config or ModelParallelConfig())
         cfg = self.config
+        shape = VIT_SHAPES.get(cfg.model)
+        if shape is None:
+            raise ValueError(
+                f"--mode sp supports ViT models {tuple(VIT_SHAPES)}")
         devs = jax.devices()
         n_shards = cfg.num_workers
         if n_shards > len(devs):
             raise ValueError(f"{n_shards} seq shards > {len(devs)} devices")
         h, w = dataset.x_train.shape[1:3]
-        self.tokens = (h // self.PATCH) * (w // self.PATCH)
+        patch = shape["patch_size"]
+        self.tokens = (h // patch) * (w // patch)
         if self.tokens % n_shards:
             raise ValueError(f"{self.tokens} tokens not divisible by "
                              f"{n_shards} sequence shards")
@@ -410,57 +404,24 @@ class SPTrainer(_EpochTrainer):
                               devices=devs[:n_shards])
         ring = make_ring_attention(self.mesh, axis="seq", causal=False)
 
-        d, nh = self.D_MODEL, self.N_HEADS
-        hd = d // nh
-        rng = np.random.default_rng(cfg.seed)
-
-        def dense(shape, scale):
-            return jnp.asarray(rng.normal(0, scale, shape), jnp.float32)
-
-        in_dim = self.PATCH * self.PATCH * 3
-        params = {
-            "embed_w": dense((in_dim, d), in_dim ** -0.5),
-            "embed_b": jnp.zeros((d,)),
-            "head_w": dense((d, cfg.num_classes), d ** -0.5),
-            "head_b": jnp.zeros((cfg.num_classes,)),
-        }
-        for i in range(self.DEPTH):
-            params[f"block{i}"] = {
-                "ln1_s": jnp.ones((d,)), "ln1_b": jnp.zeros((d,)),
-                "ln2_s": jnp.ones((d,)), "ln2_b": jnp.zeros((d,)),
-                "qkv_w": dense((d, 3 * d), d ** -0.5),
-                "out_w": dense((d, d), d ** -0.5),
-                "fc1_w": dense((d, 4 * d), d ** -0.5),
-                "fc1_b": jnp.zeros((4 * d,)),
-                "fc2_w": dense((4 * d, d), (4 * d) ** -0.5),
-                "fc2_b": jnp.zeros((d,)),
-            }
-        self.state = TrainState.create(
-            apply_fn=None, params=params, batch_stats={},
-            tx=server_sgd(cfg.learning_rate))
-        depth, patch = self.DEPTH, self.PATCH
-
-        def forward(p, images_std):
-            x = _patchify(images_std, patch) @ p["embed_w"] + p["embed_b"]
-            b, t, _ = x.shape
-            for i in range(depth):
-                blk = p[f"block{i}"]
-                y = _layer_norm(x, blk["ln1_s"], blk["ln1_b"])
-                qkv = (y @ blk["qkv_w"]).reshape(b, t, 3, nh, hd)
-                # Ring attention: T sharded over 'seq'; K/V blocks rotate
-                # via ppermute, online-softmax merge per hop.
-                att = ring(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
-                x = x + att.reshape(b, t, d) @ blk["out_w"]
-                y = _layer_norm(x, blk["ln2_s"], blk["ln2_b"])
-                x = x + jax.nn.gelu(y @ blk["fc1_w"] + blk["fc1_b"]) \
-                    @ blk["fc2_w"] + blk["fc2_b"]
-            return x.mean(axis=1) @ p["head_w"] + p["head_b"]
-
-        self._step, self._eval_step = self._make_steps(forward)
+        dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        self.model = ViT(patch_size=patch, hidden_dim=shape["hidden_dim"],
+                         depth=shape["depth"], num_heads=shape["num_heads"],
+                         num_classes=cfg.num_classes, dtype=dtype,
+                         pool="gap", attention_fn=ring)
+        state = create_train_state(self.model, jax.random.PRNGKey(cfg.seed),
+                                   server_sgd(cfg.learning_rate),
+                                   input_shape=(1, h, w, 3))
+        # Weights replicate; only activations shard (along T, inside the
+        # ring shard_map).
+        self.state = jax.device_put(state, NamedSharding(self.mesh, P()))
+        self._step = jax.jit(make_train_step(augment=cfg.augment),
+                             donate_argnums=0)
+        self._eval_step = jax.jit(make_eval_step())
 
     def _label(self) -> str:
-        return (f"sp {self.config.num_workers} seq shards "
-                f"(T={self.tokens})")
+        return (f"sp {self.config.model} {self.config.num_workers} "
+                f"seq shards (T={self.tokens})")
 
     def _extra_metrics(self) -> dict:
         return {"seq_shards": self.config.num_workers,
@@ -474,34 +435,39 @@ class SPTrainer(_EpochTrainer):
         for xb, yb in make_batches(self.dataset.x_test, self.dataset.y_test,
                                    1000, shuffle=False,
                                    drop_remainder=False):
-            c, t = self._eval_step(self.state.params, xb, yb)
+            c, t = self._eval_step(self.state, xb, yb)
             correct += int(c)
             total += int(t)
         return correct / max(total, 1)
 
 
 # ---------------------------------------------------------------------------
-# EP: Switch-MoE classifier as a trainable mode
+# EP: Switch-MoE ViT as a trainable mode
 # ---------------------------------------------------------------------------
 
 class MoETrainer(_EpochTrainer):
-    """Expert-parallel training: an all-MLP token classifier whose FFN is
-    the Switch top-1 MoE over an ``expert`` mesh axis (parallel/moe.py) —
-    one expert per device, two all_to_all hops per layer. The batch shards
-    along the same axis (tokens route ACROSS it), so EP and DP share the
-    mesh exactly as Switch Transformer does.
+    """Expert-parallel training of the REGISTRY ViT: each encoder block's
+    dense MLP is replaced by the Switch top-1 MoE
+    (models/vit.py:SwitchMoEMlp over parallel/moe.py) on an ``expert`` mesh
+    axis — one expert per device, two all_to_all hops per layer. The batch
+    shards along the same axis (tokens route ACROSS it), exactly as Switch
+    Transformer composes EP with DP. ``--mode moe --model vit_tiny|vit_b16``.
     """
 
     mode = "moe"
-    D_MODEL, D_HIDDEN, DEPTH, PATCH = 128, 256, 2, 4
 
     def __init__(self, dataset: Dataset, config: ModelParallelConfig | None = None):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        from ..parallel.moe import init_moe_params, make_moe_ffn
+        from ..models.vit import ViT
+        from ..parallel.moe import make_moe_ffn
 
         super().__init__(dataset, config or ModelParallelConfig())
         cfg = self.config
+        shape = VIT_SHAPES.get(cfg.model)
+        if shape is None:
+            raise ValueError(
+                f"--mode moe supports ViT models {tuple(VIT_SHAPES)}")
         devs = jax.devices()
         n_exp = cfg.num_workers
         if n_exp > len(devs):
@@ -518,73 +484,49 @@ class MoETrainer(_EpochTrainer):
         self.mesh = make_mesh(n_exp, axis_names=("expert",),
                               devices=devs[:n_exp])
         h, w = dataset.x_train.shape[1:3]
-        self.tokens = (h // self.PATCH) * (w // self.PATCH)
-        d, dh = self.D_MODEL, self.D_HIDDEN
+        patch = shape["patch_size"]
+        self.tokens = (h // patch) * (w // patch)
+        d = shape["hidden_dim"]
         # Capacity: 2x the even-routing load per expert shard.
         tokens_per_shard = cfg.batch_size * self.tokens // n_exp
         capacity = max(8, 2 * tokens_per_shard // n_exp)
-        self._moe = make_moe_ffn(self.mesh, capacity=capacity)
 
-        rng = np.random.default_rng(cfg.seed)
-        key = jax.random.PRNGKey(cfg.seed)
-
-        def dense(shape, scale):
-            return jnp.asarray(rng.normal(0, scale, shape), jnp.float32)
-
-        in_dim = self.PATCH * self.PATCH * 3
-        params = {
-            "embed_w": dense((in_dim, d), in_dim ** -0.5),
-            "embed_b": jnp.zeros((d,)),
-            "head_w": dense((d, cfg.num_classes), d ** -0.5),
-            "head_b": jnp.zeros((cfg.num_classes,)),
-        }
-        for i in range(self.DEPTH):
-            params[f"block{i}"] = {
-                "ln_s": jnp.ones((d,)), "ln_b": jnp.zeros((d,)),
-                "moe": init_moe_params(jax.random.fold_in(key, i),
-                                       d, dh, n_exp),
-            }
-        self.state = TrainState.create(
-            apply_fn=None, params=self._place_params(params), batch_stats={},
-            tx=server_sgd(cfg.learning_rate))
-        depth, patch, tokens = self.DEPTH, self.PATCH, self.tokens
-        moe = self._moe
-
-        def forward(p, images_std):
-            x = _patchify(images_std, patch) @ p["embed_w"] + p["embed_b"]
-            b = x.shape[0]
-            for i in range(depth):
-                blk = p[f"block{i}"]
-                y = _layer_norm(x, blk["ln_s"], blk["ln_b"])
-                # Flatten batch-major so contiguous token shards == batch
-                # shards; MoE routes tokens across the expert axis.
-                y = moe(blk["moe"], y.reshape(b * tokens, d))
-                x = x + y.reshape(b, tokens, d)
-            return x.mean(axis=1) @ p["head_w"] + p["head_b"]
-
-        self._step, self._eval_step = self._make_steps(forward)
+        dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
+        self.model = ViT(patch_size=patch, hidden_dim=d,
+                         depth=shape["depth"], num_heads=shape["num_heads"],
+                         num_classes=cfg.num_classes, dtype=dtype,
+                         pool="gap",
+                         moe_fn=make_moe_ffn(self.mesh, capacity=capacity),
+                         moe_experts=n_exp)
+        state = create_train_state(self.model, jax.random.PRNGKey(cfg.seed),
+                                   server_sgd(cfg.learning_rate),
+                                   input_shape=(1, h, w, 3))
+        self.state = state.replace(params=self._place_params(state.params))
+        self._step = jax.jit(make_train_step(augment=cfg.augment),
+                             donate_argnums=0)
+        self._eval_step = jax.jit(make_eval_step())
         self._batch_sharding = NamedSharding(self.mesh, P("expert"))
 
     def _place_params(self, params: dict) -> dict:
-        """Expert-stacked MoE leaves one-per-slot; everything else
-        replicated (matches make_moe_ffn's in_specs)."""
+        """Expert-stacked SwitchMoEMlp leaves (w1/b1/w2/b2 under a 'moe'
+        module) one-per-slot; router and everything else replicated
+        (matches make_moe_ffn's in_specs)."""
         from jax.sharding import NamedSharding, PartitionSpec as P
         exp = NamedSharding(self.mesh, P("expert"))
         rep = NamedSharding(self.mesh, P())
 
-        def place(path_leaf):
-            path, leaf = path_leaf
-            sharded = ("/w1" in path or "/b1" in path or "/w2" in path
-                       or "/b2" in path)
+        def place(path, leaf):
+            sharded = "/moe/" in path and path.rsplit("/", 1)[1] in (
+                "w1", "b1", "w2", "b2")
             return jax.device_put(leaf, exp if sharded else rep)
 
         from ..utils.pytree import flatten_params, unflatten_params
         flat = flatten_params(params, as_numpy=False)
         return unflatten_params(
-            {k: place((k, v)) for k, v in flat.items()})
+            {k: place(k, v) for k, v in flat.items()})
 
     def _label(self) -> str:
-        return f"moe {self.config.num_workers} experts"
+        return f"moe {self.config.model} {self.config.num_workers} experts"
 
     def _extra_metrics(self) -> dict:
         return {"n_experts": self.config.num_workers}
@@ -607,7 +549,7 @@ class MoETrainer(_EpochTrainer):
         bs = cfg.batch_size
         for xb, yb in make_batches(self.dataset.x_test, self.dataset.y_test,
                                    bs, shuffle=False, drop_remainder=True):
-            c, t = self._eval_step(self.state.params, xb, yb)
+            c, t = self._eval_step(self.state, xb, yb)
             correct += int(c)
             total += int(t)
         return correct / max(total, 1)
