@@ -1,0 +1,312 @@
+"""Fused (flash) attention as Pallas TPU kernels, with a custom VJP.
+
+Net-new TPU capability (round-2 VERDICT item 5): the reference has no
+attention anywhere (its model layer is a CNN, SURVEY.md §2.6); this is the
+fused core for the framework's transformer path — the same
+``[B, T, H, D] x3 -> [B, T, H, D]`` contract as
+parallel/ring_attention.dense_attention, so it drops into
+models/vit.py:SelfAttention via ``attention_fn`` and serves as the per-hop
+block kernel inside ring attention.
+
+Design (standard flash attention, TPU-shaped):
+
+- forward: grid over (batch*heads, T/BLOCK_Q); each program streams K/V
+  through VMEM in BLOCK_K tiles, keeping the online-softmax running
+  (max, sum, acc) in registers — the [T, T] score matrix never
+  materializes. Saves the per-row logsumexp for the backward.
+- backward: two kernels re-using the saved LSE (no softmax recompute
+  ambiguity): dQ tiles over query blocks, dK/dV tiles over key blocks,
+  each streaming the opposite operand. delta = rowsum(dO * O) is a cheap
+  elementwise precompute.
+- sequence lengths that aren't block multiples are zero-padded; padded KEY
+  positions are masked to -inf in every kernel, padded QUERY rows fall out
+  of the backward because their dO/delta are zero.
+
+Off TPU the same math runs as a jnp fallback (exact dense formulation with
+identical masking), which is what the CPU test suite exercises; kernel-vs-
+fallback parity on real hardware is asserted by tests/test_flash_attention.py
+when a TPU is attached (and by experiments/ on-chip runs).
+
+VMEM sizing: each program holds full K and V for one (batch, head) — at
+D=64 fp32 that bounds T at ~8k per chip; beyond that, shard the sequence
+with ring attention (parallel/ring_attention.py), which calls this kernel
+per hop on T/N-sized blocks.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_NEG_INF = -1e30
+DEFAULT_BLOCK = 128  # MXU/VPU native tile edge
+
+
+def _on_tpu() -> bool:
+    return jax.default_backend() in ("tpu", "axon")
+
+
+# -- forward ------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                scale: float, block_k: int, kv_len: int):
+    import jax.experimental.pallas as pl  # noqa: F401 (pl.ds below)
+
+    q = q_ref[0]                                   # [BQ, D]
+    bq = q.shape[0]
+    n_k = k_ref.shape[1] // block_k
+
+    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, q.shape[1]), jnp.float32)
+
+    def body(i, carry):
+        m, l, acc = carry
+        kb = k_ref[0, pl.ds(i * block_k, block_k), :]      # [BK, D]
+        vb = v_ref[0, pl.ds(i * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale    # [BQ, BK]
+        col = i * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(col < kv_len, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)                             # [BQ, BK]
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc * alpha + pv
+
+    m, l, acc = jax.lax.fori_loop(0, n_k, body, (m0, l0, acc0))
+    l = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, scale: float, block_k: int, kv_len: int):
+    import jax.experimental.pallas as pl  # noqa: F401
+
+    q = q_ref[0]
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]                                       # [BQ, 1]
+    delta = delta_ref[0]
+    n_k = k_ref.shape[1] // block_k
+
+    def body(i, dq):
+        kb = k_ref[0, pl.ds(i * block_k, block_k), :]
+        vb = v_ref[0, pl.ds(i * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+        col = i * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        p = jnp.where(col < kv_len, jnp.exp(s - lse), 0.0)  # [BQ, BK]
+        dp = jax.lax.dot_general(
+            do.astype(vb.dtype), vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        return dq + jax.lax.dot_general(
+            ds.astype(kb.dtype), kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    dq = jax.lax.fori_loop(
+        0, n_k, body, jnp.zeros(q.shape[:1] + (q.shape[1],), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *,
+                    scale: float, block_q: int, kv_len: int):
+    import jax.experimental.pallas as pl
+
+    kb = k_ref[0]                                          # [BK, D]
+    vb = v_ref[0]
+    bk = kb.shape[0]
+    col = pl.program_id(1) * bk + jax.lax.broadcasted_iota(
+        jnp.int32, (1, bk), 1)                             # [1, BK] global
+    n_q = q_ref.shape[1] // block_q
+
+    def body(j, carry):
+        dk, dv = carry
+        qb = q_ref[0, pl.ds(j * block_q, block_q), :]      # [BQ, D]
+        dob = do_ref[0, pl.ds(j * block_q, block_q), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(j * block_q, block_q), :]   # [BQ, 1]
+        delta = delta_ref[0, pl.ds(j * block_q, block_q), :]
+        s = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale    # [BQ, BK]
+        p = jnp.where(col < kv_len, jnp.exp(s - lse), 0.0)
+        dv = dv + jax.lax.dot_general(
+            p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [BK, D]
+        dp = jax.lax.dot_general(
+            dob.astype(vb.dtype), vb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # [BQ, BK]
+        ds = p * (dp - delta)
+        dk = dk + jax.lax.dot_general(
+            ds.astype(qb.dtype), qb, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale    # [BK, D]
+        return dk, dv
+
+    zero = jnp.zeros((bk, kb.shape[1]), jnp.float32)
+    dk, dv = jax.lax.fori_loop(0, n_q, body, (zero, zero))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+# -- jnp fallback (identical masked math, dense) ------------------------------
+
+def _dense_fwd(q, k, v, kv_len, scale):
+    s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    mask = jnp.arange(s.shape[-1]) < kv_len
+    s = jnp.where(mask[None, None, :], s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    o = jnp.einsum("bqk,bkd->bqd", p / l, v.astype(jnp.float32))
+    lse = m + jnp.log(l)           # [BH, T, 1]
+    return o.astype(q.dtype), lse
+
+
+def _dense_bwd(q, k, v, o, lse, do, kv_len, scale):
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    dof = do.astype(jnp.float32)
+    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
+    mask = jnp.arange(s.shape[-1]) < kv_len
+    p = jnp.where(mask[None, None, :], jnp.exp(s - lse), 0.0)
+    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1, keepdims=True)
+    dv = jnp.einsum("bqk,bqd->bkd", p, dof)
+    dp = jnp.einsum("bqd,bkd->bqk", dof, vf)
+    ds = p * (dp - delta)
+    dq = jnp.einsum("bqk,bkd->bqd", ds, kf) * scale
+    dk = jnp.einsum("bqk,bqd->bkd", ds, qf) * scale
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+# -- core op on [BH, T_pad, D] with custom VJP --------------------------------
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_core(q, k, v, kv_len, block_q, block_k, use_pallas):
+    o, _ = _flash_fwd_impl(q, k, v, kv_len, block_q, block_k, use_pallas)
+    return o
+
+
+def _flash_fwd_impl(q, k, v, kv_len, block_q, block_k, use_pallas):
+    bh, tp, d = q.shape
+    scale = 1.0 / np.sqrt(d)
+    if not use_pallas:
+        return _dense_fwd(q, k, v, kv_len, scale)
+
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_q = tp // block_q
+    blk_q = pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM)
+    blk_full = pl.BlockSpec((1, tp, d), lambda b, i: (b, 0, 0),
+                            memory_space=pltpu.VMEM)
+    # LSE rides as [BH, T, 1]: a (1, BLOCK_Q, 1) block keeps the last
+    # two dims tileable ((BLOCK_Q, 1): sublanes % 8 == 0, lane dim == array).
+    blk_lse = pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0),
+                           memory_space=pltpu.VMEM)
+    o, lse = pl.pallas_call(
+        partial(_fwd_kernel, scale=scale, block_k=block_k, kv_len=kv_len),
+        grid=(bh, n_q),
+        in_specs=[blk_q, blk_full, blk_full],
+        out_specs=(blk_q, blk_lse),
+        out_shape=(jax.ShapeDtypeStruct(q.shape, q.dtype),
+                   jax.ShapeDtypeStruct((bh, tp, 1), jnp.float32)),
+    )(q, k, v)
+    return o, lse
+
+
+def _flash_core_fwd(q, k, v, kv_len, block_q, block_k, use_pallas):
+    o, lse = _flash_fwd_impl(q, k, v, kv_len, block_q, block_k, use_pallas)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_core_bwd(kv_len, block_q, block_k, use_pallas, res, do):
+    q, k, v, o, lse = res
+    bh, tp, d = q.shape
+    scale = 1.0 / np.sqrt(d)
+    if not use_pallas:
+        return _dense_bwd(q, k, v, o, lse, do, kv_len, scale)
+
+    import jax.experimental.pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)            # [BH, T, 1]
+
+    blk_q = pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM)
+    blk_k = pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0),
+                         memory_space=pltpu.VMEM)
+    blk_full = pl.BlockSpec((1, tp, d), lambda b, i: (b, 0, 0),
+                            memory_space=pltpu.VMEM)
+    blk_row_q = pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0),
+                             memory_space=pltpu.VMEM)
+    blk_row_full = pl.BlockSpec((1, tp, 1), lambda b, i: (b, 0, 0),
+                                memory_space=pltpu.VMEM)
+
+    dq = pl.pallas_call(
+        partial(_bwd_dq_kernel, scale=scale, block_k=block_k, kv_len=kv_len),
+        grid=(bh, tp // block_q),
+        in_specs=[blk_q, blk_full, blk_full, blk_q, blk_row_q, blk_row_q],
+        out_specs=blk_q,
+        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+    )(q, k, v, do, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
+                kv_len=kv_len),
+        grid=(bh, tp // block_k),
+        in_specs=[blk_full, blk_k, blk_k, blk_full, blk_row_full,
+                  blk_row_full],
+        out_specs=(blk_k, blk_k),
+        out_shape=(jax.ShapeDtypeStruct(k.shape, k.dtype),
+                   jax.ShapeDtypeStruct(v.shape, v.dtype)),
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+# -- public op ----------------------------------------------------------------
+
+def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    block_q: int = DEFAULT_BLOCK,
+                    block_k: int = DEFAULT_BLOCK,
+                    use_pallas: bool | None = None) -> jax.Array:
+    """Fused non-causal attention over ``[B, T, H, D]`` q/k/v.
+
+    Same contract as parallel/ring_attention.dense_attention — plug into
+    models/vit.py:SelfAttention via ``attention_fn=flash_attention`` (or
+    partial(...) to pin block sizes). Differentiable (custom VJP, flash
+    backward). T is padded to a block multiple internally.
+    """
+    b, t, h, d = q.shape
+    if use_pallas is None:
+        use_pallas = _on_tpu()
+    # Pad to a multiple of BOTH block sizes — the kernels floor-divide the
+    # padded length by each, so a non-divisible combination would silently
+    # skip trailing blocks.
+    block = np.lcm(block_q, block_k)
+    tp = -(-t // block) * block
+
+    def to3(x):
+        x = jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
+        return jnp.pad(x, ((0, 0), (0, tp - t), (0, 0))) if tp != t else x
+
+    o3 = _flash_core(to3(q), to3(k), to3(v), t, block_q, block_k,
+                     bool(use_pallas))
+    o = o3[:, :t].reshape(b, h, t, d)
+    return jnp.transpose(o, (0, 2, 1, 3)).astype(q.dtype)
