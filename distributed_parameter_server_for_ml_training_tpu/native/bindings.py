@@ -42,14 +42,28 @@ def _build() -> bool:
         return False
 
 
+# Every symbol the bindings below resolve; _stale() probes these directly.
+_REQUIRED_SYMBOLS = (
+    "dps_fp32_to_fp16", "dps_fp16_to_fp32",
+    "dps_store_create", "dps_store_destroy", "dps_store_step",
+    "dps_store_rejected", "dps_store_fetch", "dps_store_load",
+    "dps_store_push_fp16", "dps_store_push_fp32",
+    "dps_store_stash_fp16", "dps_store_stash_fp32",
+    "dps_store_apply_mean", "dps_store_free_slot",
+)
+
+
 def _stale(so: str) -> bool:
-    """True when the checkout's C++ source is newer than the found .so (a
-    rebuilt source must not bind against a stale library missing symbols)."""
-    src = os.path.join(_NATIVE_DIR, "ps_core.cpp")
+    """True when the found .so doesn't export every symbol these bindings
+    need (i.e. it predates the current source). Probed directly rather than
+    via mtimes — git checkout order makes source-vs-.so timestamps
+    meaningless, and a false 'stale' would disable the prebuilt library on
+    exactly the toolchain-less machines it was committed for."""
     try:
-        return os.path.getmtime(src) > os.path.getmtime(so)
+        lib = ctypes.CDLL(so)
     except OSError:
-        return False
+        return True
+    return any(not hasattr(lib, sym) for sym in _REQUIRED_SYMBOLS)
 
 
 def load_library() -> ctypes.CDLL | None:
@@ -60,7 +74,7 @@ def load_library() -> ctypes.CDLL | None:
             return _LIB
         so = _find_so()
         if (so is None or _stale(so)) and not _build():
-            # Missing OR stale-and-unbuildable: a stale .so may lack newer
+            # Missing OR stale-and-unbuildable: a stale .so lacks newer
             # symbols, and binding it would raise AttributeError below —
             # report the native backend unavailable instead.
             return None

@@ -40,8 +40,11 @@ class NativeParameterStore(MembershipMixin):
     def __init__(self, initial_params: Mapping[str, np.ndarray],
                  config: StoreConfig | None = None):
         self.config = config or StoreConfig(mode="async")
-        if self.config.push_codec is None:
-            self.config.push_codec = "fp16"  # reference default
+        # Resolve the sentinel locally; never mutate a (possibly shared)
+        # StoreConfig.
+        self._push_codec = (self.config.push_codec
+                            if self.config.push_codec is not None
+                            else "fp16")  # reference default
         if self.config.fetch_codec != "none":
             raise ValueError(
                 "NativeParameterStore fetches fp32 from the arena; "
@@ -91,7 +94,7 @@ class NativeParameterStore(MembershipMixin):
 
     @property
     def push_codec(self) -> str:
-        return self.config.push_codec
+        return self._push_codec
 
     @property
     def fetch_codec(self) -> str:
@@ -162,7 +165,7 @@ class NativeParameterStore(MembershipMixin):
         t0 = time.time()
         bound = int(self.config.staleness_bound)
         before = self.global_step
-        if self.config.push_codec == "fp16":
+        if self._push_codec == "fp16":
             flat = self._pack(gradients, np.float16)
             new_step = int(self._lib.dps_store_push_fp16(
                 self._handle, _u16p(flat.view(np.uint16)),
@@ -202,7 +205,7 @@ class NativeParameterStore(MembershipMixin):
                     slot = self._next_slot
                     self._next_slot += 1
                 self._slot_of[worker_id] = slot
-            if self.config.push_codec == "fp16":
+            if self._push_codec == "fp16":
                 flat = self._pack(gradients, np.float16)
                 self._lib.dps_store_stash_fp16(self._handle, slot,
                                                _u16p(flat.view(np.uint16)))

@@ -75,9 +75,7 @@ class DeviceParameterStore(AggregationBase):
     def __init__(self, initial_params: Mapping[str, np.ndarray],
                  config: StoreConfig | None = None):
         self.config = config or StoreConfig()
-        if self.config.push_codec is None:
-            self.config.push_codec = "none"  # no wire to compress
-        elif self.config.push_codec != "none":
+        if self.config.push_codec not in (None, "none"):
             # An EXPLICITLY requested codec cannot apply: nothing crosses a
             # wire here, so the reference's fp16 gradient quantization
             # (worker.py:264-268) is skipped — gradient numerics differ

@@ -354,8 +354,11 @@ class ParameterStore(AggregationBase):
     def __init__(self, initial_params: Mapping[str, np.ndarray],
                  config: StoreConfig | None = None):
         self.config = config or StoreConfig()
-        if self.config.push_codec is None:
-            self.config.push_codec = "fp16"  # reference default
+        # Resolve the backend-default sentinel LOCALLY — a StoreConfig may
+        # be shared across stores, so the resolution must not leak into it.
+        self._push_codec = (self.config.push_codec
+                            if self.config.push_codec is not None
+                            else "fp16")  # reference default
         self.parameters: dict[str, np.ndarray] = {
             k: np.array(v, np.float32) for k, v in initial_params.items()
         }
@@ -379,7 +382,7 @@ class ParameterStore(AggregationBase):
     def push_codec(self) -> str:
         """Codec workers must apply before pushing (worker.py:264-268 did the
         fp16 cast on the worker side)."""
-        return self.config.push_codec
+        return self._push_codec
 
     @property
     def fetch_codec(self) -> str:
@@ -414,7 +417,7 @@ class ParameterStore(AggregationBase):
         Returns True iff the gradients were accepted (sync mode always
         accepts, matching PushReply(received=True), server.py:286-288).
         """
-        if self.config.push_codec == "fp16":
+        if self._push_codec == "fp16":
             gradients = fp16_decompress(gradients)
         else:
             gradients = {k: np.asarray(v, np.float32)
