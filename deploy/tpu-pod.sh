@@ -1,27 +1,77 @@
 #!/usr/bin/env bash
 # Multi-host SPMD sync training on a Cloud TPU pod slice — the TPU-native
-# replacement for the reference's terraform/deploy.sh (ECS cluster + NLB).
-# One jax.distributed job across all hosts; coordinator/process counts are
-# auto-detected on TPU VMs, so every host runs the SAME command.
+# replacement for the reference's terraform deploy/destroy pair
+# (terraform/deploy.sh + destroy.sh: ECS cluster + NLB, then a confirmed
+# `terraform destroy`, destroy.sh:37). Subcommands:
 #
-#   ./deploy/tpu-pod.sh v5e-16 my-pod us-west4-a
+#   ./deploy/tpu-pod.sh create  v5e-16 my-pod us-west4-a   # idempotent
+#   ./deploy/tpu-pod.sh train   v5e-16 my-pod us-west4-a
+#   ./deploy/tpu-pod.sh destroy v5e-16 my-pod us-west4-a   # confirmed delete
+#
+# (legacy: invoking with just ACCEL NAME ZONE runs create + train)
+#
+# Cost hygiene (the reference documents the same discipline for its ECS
+# cluster, DEPLOYMENT.md): a pod slice bills while it exists, not while it
+# trains — run `destroy` as soon as the run ends. `create` is idempotent, so
+# create -> train -> destroy round trips are safe to script.
 set -euo pipefail
+
+case "${1:-}" in
+    create|train|destroy) CMD=$1; shift ;;
+    *) CMD=all ;;
+esac
 
 ACCEL=${1:?accelerator type, e.g. v5e-16}
 NAME=${2:?TPU name}
 ZONE=${3:?zone}
 
-gcloud compute tpus tpu-vm create "$NAME" \
-    --zone "$ZONE" --accelerator-type "$ACCEL" \
-    --version tpu-ubuntu2204-base
+tpu_exists() {
+    gcloud compute tpus tpu-vm describe "$NAME" --zone "$ZONE" \
+        >/dev/null 2>&1
+}
 
-REPO_URL=${REPO_URL:?set REPO_URL to the git URL of this repository}
-gcloud compute tpus tpu-vm ssh "$NAME" --zone "$ZONE" --worker=all \
-    --command "pip install 'jax[tpu]' && git clone '$REPO_URL' dps \
-               && pip install ./dps"
+do_create() {
+    if tpu_exists; then
+        echo "TPU $NAME already exists in $ZONE — reusing it"
+    else
+        gcloud compute tpus tpu-vm create "$NAME" \
+            --zone "$ZONE" --accelerator-type "$ACCEL" \
+            --version tpu-ubuntu2204-base
+    fi
+    REPO_URL=${REPO_URL:?set REPO_URL to the git URL of this repository}
+    gcloud compute tpus tpu-vm ssh "$NAME" --zone "$ZONE" --worker=all \
+        --command "pip install 'jax[tpu]' \
+                   && { [ -d dps ] || git clone '$REPO_URL' dps; } \
+                   && pip install ./dps"
+}
 
-# --multihost with no coordinator flags: jax.distributed.initialize()
-# auto-detects the pod topology on TPU VMs.
-gcloud compute tpus tpu-vm ssh "$NAME" --zone "$ZONE" --worker=all \
-    --command 'dps-tpu train --mode sync --multihost --epochs 20 \
-               --emit-metrics'
+do_train() {
+    # --multihost with no coordinator flags: jax.distributed.initialize()
+    # auto-detects the pod topology on TPU VMs.
+    gcloud compute tpus tpu-vm ssh "$NAME" --zone "$ZONE" --worker=all \
+        --command 'dps-tpu train --mode sync --multihost --epochs 20 \
+                   --emit-metrics'
+}
+
+do_destroy() {
+    if ! tpu_exists; then
+        echo "TPU $NAME not found in $ZONE — nothing to destroy"
+        return 0
+    fi
+    # Confirmed destructive delete, like the reference's destroy.sh:31-37.
+    echo "About to DELETE TPU pod slice $NAME ($ACCEL) in $ZONE."
+    read -r -p "Type 'yes' to confirm: " REPLY
+    if [ "$REPLY" != "yes" ]; then
+        echo "aborted"
+        return 1
+    fi
+    gcloud compute tpus tpu-vm delete "$NAME" --zone "$ZONE" --quiet
+    echo "deleted $NAME — billing for the slice has stopped"
+}
+
+case "$CMD" in
+    create)  do_create ;;
+    train)   do_train ;;
+    destroy) do_destroy ;;
+    all)     do_create; do_train ;;
+esac

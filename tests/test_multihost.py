@@ -26,6 +26,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.slow
 def test_two_process_sync_step_matches_single_process(tmp_path, devices):
     port = _free_port()
     out = tmp_path / "rank0.npz"
