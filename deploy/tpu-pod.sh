@@ -39,9 +39,11 @@ do_create() {
             --version tpu-ubuntu2204-base
     fi
     REPO_URL=${REPO_URL:?set REPO_URL to the git URL of this repository}
+    # Reused slices pull instead of keeping a stale checkout.
     gcloud compute tpus tpu-vm ssh "$NAME" --zone "$ZONE" --worker=all \
         --command "pip install 'jax[tpu]' \
-                   && { [ -d dps ] || git clone '$REPO_URL' dps; } \
+                   && if [ -d dps ]; then git -C dps pull --ff-only; \
+                      else git clone '$REPO_URL' dps; fi \
                    && pip install ./dps"
 }
 
@@ -59,11 +61,15 @@ do_destroy() {
         return 0
     fi
     # Confirmed destructive delete, like the reference's destroy.sh:31-37.
-    echo "About to DELETE TPU pod slice $NAME ($ACCEL) in $ZONE."
-    read -r -p "Type 'yes' to confirm: " REPLY
-    if [ "$REPLY" != "yes" ]; then
-        echo "aborted"
-        return 1
+    # Non-interactive callers (cron/CI teardown) set DPS_YES=1 — a destroy
+    # that silently NO-OPs without a tty would keep the slice billing.
+    if [ "${DPS_YES:-}" != "1" ]; then
+        echo "About to DELETE TPU pod slice $NAME ($ACCEL) in $ZONE."
+        read -r -p "Type 'yes' to confirm (or set DPS_YES=1): " REPLY
+        if [ "$REPLY" != "yes" ]; then
+            echo "aborted"
+            return 1
+        fi
     fi
     gcloud compute tpus tpu-vm delete "$NAME" --zone "$ZONE" --quiet
     echo "deleted $NAME — billing for the slice has stopped"

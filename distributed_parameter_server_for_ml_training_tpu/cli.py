@@ -96,6 +96,12 @@ def build_parser() -> argparse.ArgumentParser:
                    help="model-axis size for --mode tp")
     t.add_argument("--pp-microbatches", type=int, default=8,
                    help="GPipe microbatch count for --mode pp")
+    t.add_argument("--dp-degree", type=int, default=1,
+                   help="--mode pp: shard each microbatch over a 'data' "
+                        "mesh axis (dp x pp composition)")
+    t.add_argument("--pp-tp-degree", type=int, default=1,
+                   help="--mode pp: Megatron-split stage params over a "
+                        "'model' mesh axis (dp x tp x pp composition)")
     t.add_argument("--staleness-bound", type=int,
                    default=_env("STALENESS_BOUND", 5, int))
     t.add_argument("--sync-steps", type=int,
@@ -266,6 +272,8 @@ def cmd_train(args) -> int:
             model=args.model, num_workers=args.workers,
             tp_degree=args.tp_degree,
             pp_microbatches=args.pp_microbatches,
+            dp_degree=args.dp_degree,
+            pp_tp_degree=args.pp_tp_degree,
             learning_rate=args.lr, num_epochs=args.epochs,
             batch_size=args.batch_size, augment=not args.no_augment,
             num_classes=num_classes, dtype=args.dtype, seed=args.seed)
