@@ -42,7 +42,10 @@ import jax.numpy as jnp
 import numpy as np
 
 _NEG_INF = -1e30
-DEFAULT_BLOCK = 128  # MXU/VPU native tile edge
+# Measured on-chip (experiments/measure_mfu.py block sweep): 512-wide tiles
+# nearly halve the backward at T>=2048 vs 128 (bigger serial-loop bodies
+# keep the MXU fed); short sequences clamp down so padding stays small.
+MAX_BLOCK = 512
 
 
 def _on_tpu() -> bool:
@@ -283,19 +286,30 @@ _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 # -- public op ----------------------------------------------------------------
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
-                    block_q: int = DEFAULT_BLOCK,
-                    block_k: int = DEFAULT_BLOCK,
+                    block_q: int | None = None,
+                    block_k: int | None = None,
                     use_pallas: bool | None = None) -> jax.Array:
     """Fused non-causal attention over ``[B, T, H, D]`` q/k/v.
 
     Same contract as parallel/ring_attention.dense_attention — plug into
     models/vit.py:SelfAttention via ``attention_fn=flash_attention`` (or
     partial(...) to pin block sizes). Differentiable (custom VJP, flash
-    backward). T is padded to a block multiple internally.
+    backward). T is padded to a block multiple internally; default block
+    sizes adapt to T (128-tile-rounded, capped at MAX_BLOCK).
     """
     b, t, h, d = q.shape
     if use_pallas is None:
         use_pallas = _on_tpu()
+    # Default blocks: the largest 128-multiple <= MAX_BLOCK that DIVIDES the
+    # 128-rounded sequence length — a bare min() would pad e.g. T=768 up to
+    # 1024 (1.78x the attention FLOPs); 384 divides it exactly.
+    tp128 = -(-t // 128) * 128
+    if block_q is None:
+        block_q = max(b for b in range(128, MAX_BLOCK + 1, 128)
+                      if tp128 % b == 0)
+    if block_k is None:
+        block_k = max(b for b in range(128, MAX_BLOCK + 1, 128)
+                      if tp128 % b == 0)
     # Pad to a multiple of BOTH block sizes — the kernels floor-divide the
     # padded length by each, so a non-divisible combination would silently
     # skip trailing blocks.
