@@ -178,19 +178,15 @@ def _dense_fwd(q, k, v, kv_len, scale):
     return o.astype(q.dtype), lse
 
 
-def _dense_bwd(q, k, v, o, lse, do, kv_len, scale):
-    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
-    dof = do.astype(jnp.float32)
-    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
-    mask = jnp.arange(s.shape[-1]) < kv_len
-    p = jnp.where(mask[None, None, :], jnp.exp(s - lse), 0.0)
-    delta = jnp.sum(dof * o.astype(jnp.float32), axis=-1, keepdims=True)
-    dv = jnp.einsum("bqk,bqd->bkd", p, dof)
-    dp = jnp.einsum("bqd,bkd->bqk", dof, vf)
-    ds = p * (dp - delta)
-    dq = jnp.einsum("bqk,bkd->bqd", ds, kf) * scale
-    dk = jnp.einsum("bqk,bqd->bkd", ds, qf) * scale
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+def pick_block(t: int) -> int:
+    """Largest 128-multiple <= MAX_BLOCK dividing ``t`` (kernel grids
+    floor-divide, so the block must divide the length exactly)."""
+    if t % 128:
+        raise ValueError(
+            f"sequence block length {t} must be a multiple of 128 (TPU "
+            f"tile); pad the sequence or pick a shard count that divides "
+            f"it into 128-multiples")
+    return max(b for b in range(128, MAX_BLOCK + 1, 128) if t % b == 0)
 
 
 # -- core op on [BH, T_pad, D] with custom VJP --------------------------------
@@ -201,11 +197,13 @@ def _flash_core(q, k, v, kv_len, block_q, block_k, use_pallas):
     return o
 
 
-def _flash_fwd_impl(q, k, v, kv_len, block_q, block_k, use_pallas):
+def _flash_fwd_impl(q, k, v, kv_len, block_q, block_k, use_pallas,
+                    out_dtype=None):
     bh, tp, d = q.shape
     scale = 1.0 / np.sqrt(d)
     if not use_pallas:
-        return _dense_fwd(q, k, v, kv_len, scale)
+        o, lse = _dense_fwd(q, k, v, kv_len, scale)
+        return (o.astype(out_dtype) if out_dtype else o), lse
 
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -224,7 +222,7 @@ def _flash_fwd_impl(q, k, v, kv_len, block_q, block_k, use_pallas):
         grid=(bh, n_q),
         in_specs=[blk_q, blk_full, blk_full],
         out_specs=(blk_q, blk_lse),
-        out_shape=(jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=(jax.ShapeDtypeStruct(q.shape, out_dtype or q.dtype),
                    jax.ShapeDtypeStruct((bh, tp, 1), jnp.float32)),
     )(q, k, v)
     return o, lse
@@ -235,49 +233,73 @@ def _flash_core_fwd(q, k, v, kv_len, block_q, block_k, use_pallas):
     return o, (q, k, v, o, lse)
 
 
-def _flash_core_bwd(kv_len, block_q, block_k, use_pallas, res, do):
-    q, k, v, o, lse = res
-    bh, tp, d = q.shape
+def _flash_bwd_impl(q, k, v, do, lse, delta, kv_len, block_q, block_k,
+                    use_pallas, out_dtype=None):
+    """Flash backward given EXTERNAL (lse, delta) — shared by the custom
+    VJP below and by ring attention's per-hop backward
+    (parallel/ring_attention.py), where lse/delta come from the MERGED
+    softmax over the whole ring. ``out_dtype`` overrides the gradient
+    dtype (the ring accumulates partials in fp32)."""
+    bh, tq, d = q.shape
+    tk = k.shape[1]
     scale = 1.0 / np.sqrt(d)
+    dts = [out_dtype or x.dtype for x in (q, k, v)]
     if not use_pallas:
-        return _dense_bwd(q, k, v, o, lse, do, kv_len, scale)
+        qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+        dof = do.astype(jnp.float32)
+        s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
+        mask = jnp.arange(tk) < kv_len
+        p = jnp.where(mask[None, None, :], jnp.exp(s - lse), 0.0)
+        dv = jnp.einsum("bqk,bqd->bkd", p, dof)
+        dp = jnp.einsum("bqd,bkd->bqk", dof, vf)
+        ds = p * (dp - delta)
+        dq = jnp.einsum("bqk,bkd->bqd", ds, kf) * scale
+        dk = jnp.einsum("bqk,bqd->bkd", ds, qf) * scale
+        return (dq.astype(dts[0]), dk.astype(dts[1]), dv.astype(dts[2]))
 
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
-
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1, keepdims=True)            # [BH, T, 1]
 
     blk_q = pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
                          memory_space=pltpu.VMEM)
     blk_k = pl.BlockSpec((1, block_k, d), lambda b, i: (b, i, 0),
                          memory_space=pltpu.VMEM)
-    blk_full = pl.BlockSpec((1, tp, d), lambda b, i: (b, 0, 0),
-                            memory_space=pltpu.VMEM)
+    blk_qfull = pl.BlockSpec((1, tq, d), lambda b, i: (b, 0, 0),
+                             memory_space=pltpu.VMEM)
+    blk_kfull = pl.BlockSpec((1, tk, d), lambda b, i: (b, 0, 0),
+                             memory_space=pltpu.VMEM)
     blk_row_q = pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0),
                              memory_space=pltpu.VMEM)
-    blk_row_full = pl.BlockSpec((1, tp, 1), lambda b, i: (b, 0, 0),
-                                memory_space=pltpu.VMEM)
+    blk_row_qfull = pl.BlockSpec((1, tq, 1), lambda b, i: (b, 0, 0),
+                                 memory_space=pltpu.VMEM)
 
     dq = pl.pallas_call(
         partial(_bwd_dq_kernel, scale=scale, block_k=block_k, kv_len=kv_len),
-        grid=(bh, tp // block_q),
-        in_specs=[blk_q, blk_full, blk_full, blk_q, blk_row_q, blk_row_q],
+        grid=(bh, tq // block_q),
+        in_specs=[blk_q, blk_kfull, blk_kfull, blk_q, blk_row_q, blk_row_q],
         out_specs=blk_q,
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+        out_shape=jax.ShapeDtypeStruct(q.shape, dts[0]),
     )(q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
         partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
                 kv_len=kv_len),
-        grid=(bh, tp // block_k),
-        in_specs=[blk_full, blk_k, blk_k, blk_full, blk_row_full,
-                  blk_row_full],
+        grid=(bh, tk // block_k),
+        in_specs=[blk_qfull, blk_k, blk_k, blk_qfull, blk_row_qfull,
+                  blk_row_qfull],
         out_specs=(blk_k, blk_k),
-        out_shape=(jax.ShapeDtypeStruct(k.shape, k.dtype),
-                   jax.ShapeDtypeStruct(v.shape, v.dtype)),
+        out_shape=(jax.ShapeDtypeStruct(k.shape, dts[1]),
+                   jax.ShapeDtypeStruct(v.shape, dts[2])),
     )(q, k, v, do, lse, delta)
     return dq, dk, dv
+
+
+def _flash_core_bwd(kv_len, block_q, block_k, use_pallas, res, do):
+    q, k, v, o, lse = res
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1, keepdims=True)            # [BH, T, 1]
+    return _flash_bwd_impl(q, k, v, do, lse, delta, kv_len, block_q,
+                           block_k, use_pallas)
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
@@ -305,11 +327,9 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     # 1024 (1.78x the attention FLOPs); 384 divides it exactly.
     tp128 = -(-t // 128) * 128
     if block_q is None:
-        block_q = max(b for b in range(128, MAX_BLOCK + 1, 128)
-                      if tp128 % b == 0)
+        block_q = pick_block(tp128)
     if block_k is None:
-        block_k = max(b for b in range(128, MAX_BLOCK + 1, 128)
-                      if tp128 % b == 0)
+        block_k = pick_block(tp128)
     # Pad to a multiple of BOTH block sizes — the kernels floor-divide the
     # padded length by each, so a non-divisible combination would silently
     # skip trailing blocks.

@@ -8,6 +8,7 @@ from .multihost import (fetch_replicated, host_local_slice, make_global_mesh,
 from .multihost import initialize as initialize_multihost
 from .pipeline import make_pipeline_apply, stack_stage_params
 from .ring_attention import (dense_attention, make_ring_attention,
+                             make_ring_flash_attention,
                              ring_attention_local)
 from .sync_dp import make_sync_dp_step, shard_batch
 from .tensor import param_shardings, shard_train_state, tp_spec_for_path
@@ -24,6 +25,7 @@ __all__ = [
     "make_sync_dp_step",
     "shard_batch",
     "make_ring_attention",
+    "make_ring_flash_attention",
     "ring_attention_local",
     "dense_attention",
     "param_shardings",

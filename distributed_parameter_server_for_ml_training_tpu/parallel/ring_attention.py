@@ -86,6 +86,155 @@ def make_ring_attention(mesh: Mesh, axis: str = "data",
     return jax.jit(fn)
 
 
+# ---------------------------------------------------------------------------
+# Ring x flash: the Pallas flash kernels as the per-hop block core
+# ---------------------------------------------------------------------------
+
+def _hop_fwd(q4, k4, v4, use_pallas: bool):
+    """One hop's flash forward on [B, Tq, H, D] q against a [B, Tk, H, D]
+    K/V block -> (normalized fp32 partial out [B,Tq,H,D], lse [B*H,Tq,1]).
+    Partials stay fp32: the ring accumulators merge N of them, and rounding
+    each hop to the input dtype would stack N quantization errors."""
+    from ..ops.pallas.flash_attention import _flash_fwd_impl, pick_block
+
+    b, tq, h, d = q4.shape
+    tk = k4.shape[1]
+
+    def to3(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, x.shape[1], d)
+
+    o3, lse3 = _flash_fwd_impl(to3(q4), to3(k4), to3(v4), tk,
+                               pick_block(tq), pick_block(tk), use_pallas,
+                               out_dtype=jnp.float32)
+    o = jnp.transpose(o3.reshape(b, h, tq, d), (0, 2, 1, 3))
+    return o, lse3
+
+
+def _hop_bwd(q4, k4, v4, do4, lse_tot, delta, use_pallas: bool):
+    """One hop's flash backward: fp32 (dq_partial, dk_block, dv_block)
+    given the TOTAL logsumexp and delta — the flash backward never
+    differentiates through the merge (p_i = exp(s_i - lse_total) directly;
+    shared impl in ops/pallas/flash_attention._flash_bwd_impl)."""
+    from ..ops.pallas.flash_attention import _flash_bwd_impl, pick_block
+
+    b, tq, h, d = q4.shape
+    tk = k4.shape[1]
+
+    def to3(x):
+        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, x.shape[1], d)
+
+    dq3, dk3, dv3 = _flash_bwd_impl(
+        to3(q4), to3(k4), to3(v4), to3(do4), lse_tot, delta,
+        kv_len=tk, block_q=pick_block(tq), block_k=pick_block(tk),
+        use_pallas=use_pallas, out_dtype=jnp.float32)
+
+    def to4(x3, t):
+        return jnp.transpose(x3.reshape(b, h, t, d), (0, 2, 1, 3))
+
+    return to4(dq3, tq), to4(dk3, tk), to4(dv3, tk)
+
+
+def make_ring_flash_attention(mesh: Mesh, axis: str = "seq",
+                              use_pallas: bool | None = None) -> Callable:
+    """Ring attention whose per-hop block core is the Pallas flash kernel.
+
+    Composition of the two long-context mechanisms: the sequence is sharded
+    T/N per device (ring hops via ``ppermute`` over ``axis``), and within
+    each hop the resident [Tq_local, Tk_block] attention runs as the fused
+    flash kernel (ops/pallas/flash_attention.py) instead of a dense einsum
+    — neither the [T, T] nor even a [T/N, T/N] score matrix reaches HBM.
+
+    Forward: each hop's flash fwd yields a normalized partial (o_i, lse_i);
+    partials merge associatively (out = sum_i exp(lse_i - M) o_i /
+    sum_i exp(lse_i - M), lse = M + log-sum). Backward (custom VJP): the
+    flash backward never differentiates the merge — with the TOTAL lse and
+    delta = rowsum(dO * O), each hop's dq/dk/dv come from the same flash
+    backward kernels, with dK/dV accumulators rotating in lockstep with
+    their K/V blocks so each block's gradient arrives home after a full
+    cycle (standard ring-attention backward).
+
+    Off TPU (CPU tests) the hops run the identical-math jnp fallback; the
+    kernels themselves are validated on-chip by tests/test_flash_attention.
+    Non-causal (the SP/ViT path); T/N must be a multiple of 128.
+    """
+    axis_size = mesh.shape[axis]
+    if use_pallas is None:
+        from ..ops.pallas.flash_attention import _on_tpu
+        use_pallas = _on_tpu()
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    @jax.custom_vjp
+    def local_ring(q, k, v):
+        out, _ = _ring_fwd(q, k, v)
+        return out
+
+    def _ring_fwd(q, k, v):
+        b, tl, h, d = q.shape
+        bh = b * h
+        m = jnp.full((bh, tl, 1), _NEG_INF, jnp.float32)
+        l = jnp.zeros((bh, tl, 1), jnp.float32)
+        acc = jnp.zeros((b, tl, h, d), jnp.float32)
+        kk, vv = k, v
+        for step in range(axis_size):
+            o_i, lse_i = _hop_fwd(q, kk, vv, use_pallas)
+            m_new = jnp.maximum(m, lse_i)
+            w_prev = jnp.exp(m - m_new)
+            w_i = jnp.exp(lse_i - m_new)
+            l = l * w_prev + w_i
+            # [BH, T, 1] weights -> [B, T, H, 1] to scale the partials.
+            def w4(w):
+                return jnp.transpose(w.reshape(b, h, tl, 1), (0, 2, 1, 3))
+            acc = acc * w4(w_prev) + o_i * w4(w_i)  # o_i already fp32
+            m = m_new
+            if step != axis_size - 1:
+                kk = jax.lax.ppermute(kk, axis, perm)
+                vv = jax.lax.ppermute(vv, axis, perm)
+        l = jnp.maximum(l, 1e-30)
+        lse_tot = m + jnp.log(l)
+        out = (acc / jnp.transpose(l.reshape(b, h, tl, 1), (0, 2, 1, 3))
+               ).astype(q.dtype)
+        return out, lse_tot
+
+    def fwd_rule(q, k, v):
+        out, lse_tot = _ring_fwd(q, k, v)
+        return out, (q, k, v, out, lse_tot)
+
+    def bwd_rule(res, do):
+        q, k, v, out, lse_tot = res
+        b, tl, h, d = q.shape
+        delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1)                       # [B, T, H]
+        delta = jnp.transpose(delta, (0, 2, 1)).reshape(b * h, tl, 1)
+        dq = jnp.zeros_like(q, jnp.float32)
+        kk, vv = k, v
+        dkk = jnp.zeros_like(k, jnp.float32)
+        dvv = jnp.zeros_like(v, jnp.float32)
+        for step in range(axis_size):
+            dq_i, dk_i, dv_i = _hop_bwd(q, kk, vv, do, lse_tot, delta,
+                                        use_pallas)
+            dq = dq + dq_i
+            dkk = dkk + dk_i
+            dvv = dvv + dv_i
+            # Rotate blocks AND their gradient accumulators together; the
+            # accumulators always rotate (N hops bring each one home with
+            # every shard's contribution), the K/V blocks skip the final
+            # rotation — they are never read again.
+            if step != axis_size - 1:
+                kk = jax.lax.ppermute(kk, axis, perm)
+                vv = jax.lax.ppermute(vv, axis, perm)
+            dkk = jax.lax.ppermute(dkk, axis, perm)
+            dvv = jax.lax.ppermute(dvv, axis, perm)
+        return (dq.astype(q.dtype), dkk.astype(k.dtype),
+                dvv.astype(v.dtype))
+
+    local_ring.defvjp(fwd_rule, bwd_rule)
+
+    spec = P(None, axis)
+    fn = jax.shard_map(local_ring, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return jax.jit(fn)
+
+
 def dense_attention(q, k, v, causal: bool = False):
     """Reference dense softmax attention (for tests / single-device)."""
     d = q.shape[-1]

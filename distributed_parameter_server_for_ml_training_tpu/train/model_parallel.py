@@ -445,7 +445,15 @@ class SPTrainer(_EpochTrainer):
                              f"{n_shards} sequence shards")
         self.mesh = make_mesh(n_shards, axis_names=("seq",),
                               devices=devs[:n_shards])
-        ring = make_ring_attention(self.mesh, axis="seq", causal=False)
+        # Long-context configs (>=128 tokens per shard) run the fused
+        # ring x flash composition — flash kernels per hop, ppermute
+        # between; short CIFAR-scale shards use the dense-hop ring.
+        per_shard = self.tokens // n_shards
+        if per_shard % 128 == 0:
+            from ..parallel.ring_attention import make_ring_flash_attention
+            ring = make_ring_flash_attention(self.mesh, axis="seq")
+        else:
+            ring = make_ring_attention(self.mesh, axis="seq", causal=False)
 
         dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
         self.model = ViT(patch_size=patch, hidden_dim=shape["hidden_dim"],
