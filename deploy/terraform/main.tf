@@ -1,0 +1,60 @@
+# Declarative IaC for the multi-host training pod — SURVEY.md §2.22 parity.
+#
+# The reference provisions its cluster declaratively (terraform: VPC + ECS
+# services + NLB on AWS); the TPU-native equivalent is ONE resource — a TPU
+# pod slice VM group — because the interconnect (ICI), process placement,
+# and "service discovery" (jax.distributed auto-detection on TPU VMs) are
+# properties of the slice itself, not separate infrastructure. What took
+# the reference ~590 lines of HCL (VPC, subnets, security groups, ECR,
+# CloudWatch, cluster, task definitions, NLB, listeners, services) is a
+# single google_tpu_v2_vm plus a startup script.
+#
+#   terraform init
+#   terraform apply  -var name=my-pod -var zone=us-west4-a \
+#                    -var accelerator_type=v5litepod-16 -var repo_url=...
+#   terraform destroy ...        # the reference's destroy.sh equivalent
+#
+# Imperative alternative with the same lifecycle: ../tpu-pod.sh
+# create|train|destroy. Cost hygiene applies identically: the slice bills
+# while it exists — destroy as soon as the run ends.
+
+terraform {
+  required_providers {
+    google = {
+      source  = "hashicorp/google"
+      version = ">= 5.0"
+    }
+  }
+}
+
+provider "google" {
+  project = var.project
+  zone    = var.zone
+}
+
+resource "google_tpu_v2_vm" "pod" {
+  name             = var.name
+  zone             = var.zone
+  accelerator_type = var.accelerator_type
+  runtime_version  = var.runtime_version
+
+  metadata = {
+    # Every host runs the same bootstrap; jax.distributed.initialize()
+    # auto-detects the pod topology (coordinator, process count, id), so
+    # no per-host configuration is injected — contrast the reference's
+    # per-task env blocks (SERVER_MODE / TOTAL_WORKERS_EXPECTED /
+    # PARAMETER_SERVER_ADDRESS).
+    startup-script = <<-EOT
+      #!/bin/bash
+      set -e
+      pip install 'jax[tpu]'
+      if [ -d /opt/dps ]; then git -C /opt/dps pull --ff-only
+      else git clone '${var.repo_url}' /opt/dps; fi
+      pip install /opt/dps
+    EOT
+  }
+
+  labels = {
+    purpose = "dps-tpu-training"
+  }
+}

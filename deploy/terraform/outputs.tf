@@ -1,0 +1,8 @@
+output "name" {
+  value = google_tpu_v2_vm.pod.name
+}
+
+output "train_command" {
+  description = "run the 20-epoch multihost job on every host of the slice"
+  value       = "gcloud compute tpus tpu-vm ssh ${google_tpu_v2_vm.pod.name} --project ${var.project} --zone ${var.zone} --worker=all --command 'dps-tpu train --mode sync --multihost --epochs 20 --emit-metrics'"
+}

@@ -1,0 +1,41 @@
+variable "project" {
+  type        = string
+  description = "GCP project id"
+}
+
+variable "name" {
+  type        = string
+  description = "TPU pod slice name"
+  default     = "dps-tpu-pod"
+}
+
+variable "zone" {
+  type        = string
+  description = "zone with the requested accelerator capacity"
+  default     = "us-west4-a"
+}
+
+variable "accelerator_type" {
+  type        = string
+  description = "pod slice shape, e.g. v5litepod-16"
+  default     = "v5litepod-16"
+
+  validation {
+    # Mirrors the reference's server_mode validation discipline
+    # (its variables.tf validated the mode enum): fail at plan time,
+    # not after a slice was created.
+    condition     = can(regex("^(v5litepod|v5p|v4|v3|v2)-[0-9]+$", var.accelerator_type))
+    error_message = "accelerator_type must look like v5litepod-16 / v4-8 / ..."
+  }
+}
+
+variable "runtime_version" {
+  type        = string
+  description = "TPU VM runtime image"
+  default     = "tpu-ubuntu2204-base"
+}
+
+variable "repo_url" {
+  type        = string
+  description = "git URL of this repository (cloned by the startup script)"
+}

@@ -165,7 +165,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 # -- jnp fallback (identical masked math, dense) ------------------------------
 
-def _dense_fwd(q, k, v, kv_len, scale):
+def _dense_fwd(q, k, v, kv_len, scale, out_dtype=None):
     s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     mask = jnp.arange(s.shape[-1]) < kv_len
@@ -175,7 +175,7 @@ def _dense_fwd(q, k, v, kv_len, scale):
     l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
     o = jnp.einsum("bqk,bkd->bqd", p / l, v.astype(jnp.float32))
     lse = m + jnp.log(l)           # [BH, T, 1]
-    return o.astype(q.dtype), lse
+    return o.astype(out_dtype or q.dtype), lse
 
 
 def pick_block(t: int) -> int:
@@ -202,8 +202,10 @@ def _flash_fwd_impl(q, k, v, kv_len, block_q, block_k, use_pallas,
     bh, tp, d = q.shape
     scale = 1.0 / np.sqrt(d)
     if not use_pallas:
-        o, lse = _dense_fwd(q, k, v, kv_len, scale)
-        return (o.astype(out_dtype) if out_dtype else o), lse
+        # out_dtype reaches the FINAL cast — an intermediate round-trip
+        # through q.dtype would quantize the fp32 partials the ring merge
+        # depends on.
+        return _dense_fwd(q, k, v, kv_len, scale, out_dtype)
 
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu

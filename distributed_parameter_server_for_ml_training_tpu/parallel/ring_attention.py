@@ -90,6 +90,18 @@ def make_ring_attention(mesh: Mesh, axis: str = "data",
 # Ring x flash: the Pallas flash kernels as the per-hop block core
 # ---------------------------------------------------------------------------
 
+def _to3(x):
+    """[B, T, H, D] -> [B*H, T, D] (the flash kernels' layout)."""
+    b, t, h, d = x.shape
+    return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, t, d)
+
+
+def _to4(x3, b, h):
+    """[B*H, T, D] -> [B, T, H, D] (inverse of _to3)."""
+    _, t, d = x3.shape
+    return jnp.transpose(x3.reshape(b, h, t, d), (0, 2, 1, 3))
+
+
 def _hop_fwd(q4, k4, v4, use_pallas: bool):
     """One hop's flash forward on [B, Tq, H, D] q against a [B, Tk, H, D]
     K/V block -> (normalized fp32 partial out [B,Tq,H,D], lse [B*H,Tq,1]).
@@ -99,15 +111,10 @@ def _hop_fwd(q4, k4, v4, use_pallas: bool):
 
     b, tq, h, d = q4.shape
     tk = k4.shape[1]
-
-    def to3(x):
-        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, x.shape[1], d)
-
-    o3, lse3 = _flash_fwd_impl(to3(q4), to3(k4), to3(v4), tk,
+    o3, lse3 = _flash_fwd_impl(_to3(q4), _to3(k4), _to3(v4), tk,
                                pick_block(tq), pick_block(tk), use_pallas,
                                out_dtype=jnp.float32)
-    o = jnp.transpose(o3.reshape(b, h, tq, d), (0, 2, 1, 3))
-    return o, lse3
+    return _to4(o3, b, h), lse3
 
 
 def _hop_bwd(q4, k4, v4, do4, lse_tot, delta, use_pallas: bool):
@@ -119,19 +126,11 @@ def _hop_bwd(q4, k4, v4, do4, lse_tot, delta, use_pallas: bool):
 
     b, tq, h, d = q4.shape
     tk = k4.shape[1]
-
-    def to3(x):
-        return jnp.transpose(x, (0, 2, 1, 3)).reshape(b * h, x.shape[1], d)
-
     dq3, dk3, dv3 = _flash_bwd_impl(
-        to3(q4), to3(k4), to3(v4), to3(do4), lse_tot, delta,
+        _to3(q4), _to3(k4), _to3(v4), _to3(do4), lse_tot, delta,
         kv_len=tk, block_q=pick_block(tq), block_k=pick_block(tk),
         use_pallas=use_pallas, out_dtype=jnp.float32)
-
-    def to4(x3, t):
-        return jnp.transpose(x3.reshape(b, h, t, d), (0, 2, 1, 3))
-
-    return to4(dq3, tq), to4(dk3, tk), to4(dv3, tk)
+    return _to4(dq3, b, h), _to4(dk3, b, h), _to4(dv3, b, h)
 
 
 def make_ring_flash_attention(mesh: Mesh, axis: str = "seq",

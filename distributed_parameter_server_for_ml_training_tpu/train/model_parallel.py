@@ -445,9 +445,10 @@ class SPTrainer(_EpochTrainer):
                              f"{n_shards} sequence shards")
         self.mesh = make_mesh(n_shards, axis_names=("seq",),
                               devices=devs[:n_shards])
-        # Long-context configs (>=128 tokens per shard) run the fused
+        # Long-context configs (a 128-MULTIPLE of tokens per shard — the
+        # Pallas tile constraint pick_block enforces) run the fused
         # ring x flash composition — flash kernels per hop, ppermute
-        # between; short CIFAR-scale shards use the dense-hop ring.
+        # between; other shard sizes use the dense-hop ring.
         per_shard = self.tokens // n_shards
         if per_shard % 128 == 0:
             from ..parallel.ring_attention import make_ring_flash_attention
