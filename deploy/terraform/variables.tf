@@ -24,8 +24,8 @@ variable "accelerator_type" {
     # Mirrors the reference's server_mode validation discipline
     # (its variables.tf validated the mode enum): fail at plan time,
     # not after a slice was created.
-    condition     = can(regex("^(v5litepod|v5p|v4|v3|v2)-[0-9]+$", var.accelerator_type))
-    error_message = "accelerator_type must look like v5litepod-16 / v4-8 / ..."
+    condition     = can(regex("^(v6e|v5litepod|v5p|v4|v3|v2)-[0-9]+$", var.accelerator_type))
+    error_message = "accelerator_type must look like v6e-16 / v5litepod-16 / v4-8 / ..."
   }
 }
 
