@@ -9,7 +9,7 @@ from distributed_parameter_server_for_ml_training_tpu.comms import (
 from distributed_parameter_server_for_ml_training_tpu.comms.service import (
     pack_msg, unpack_msg)
 from distributed_parameter_server_for_ml_training_tpu.ps import (
-    ParameterStore, StoreConfig)
+    DeviceParameterStore, ParameterStore, StoreConfig)
 
 
 class TestWireCodec:
@@ -156,6 +156,33 @@ class TestGrpcService:
             np.testing.assert_array_equal(x3, x2_expected)
             for c in clients + [c3]:
                 c.close()
+        finally:
+            server.stop(grace=None)
+
+    def test_device_store_behind_service(self):
+        """serve --store-backend device end-to-end in-process: the service
+        pulls HBM-resident params to host for the wire on fetch, decodes
+        pushes into device applies — the remaining backend x service cell
+        (python/native are covered by the two-process CLI test)."""
+        store = DeviceParameterStore(
+            {"w": np.ones(8, np.float32)},
+            StoreConfig(mode="async", total_workers=1, learning_rate=0.1))
+        server, port = serve(store, port=0)
+        try:
+            client = RemoteStore(f"localhost:{port}")
+            wid, _ = client.register_worker("dev0")
+            assert client.push_codec == "none"  # no wire codec on device
+            params, step = client.fetch(wid)
+            np.testing.assert_array_equal(params["w"],
+                                          np.ones(8, np.float32))
+            assert client.push(wid, {"w": np.full(8, 0.5, np.float32)},
+                               fetched_step=step)
+            params2, step2 = client.fetch(wid)
+            assert step2 == step + 1
+            np.testing.assert_allclose(params2["w"], 1.0 - 0.1 * 0.5,
+                                       rtol=1e-6)
+            client.job_finished(wid)
+            client.close()
         finally:
             server.stop(grace=None)
 
