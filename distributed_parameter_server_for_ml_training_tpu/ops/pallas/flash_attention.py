@@ -54,8 +54,9 @@ def _on_tpu() -> bool:
 
 # -- forward ------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
-                scale: float, block_k: int, kv_len: int):
+def _fwd_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
+                scale: float, block_q: int, block_k: int, kv_len: int,
+                causal: bool):
     import jax.experimental.pallas as pl  # noqa: F401 (pl.ds below)
 
     q = q_ref[0]                                   # [BQ, D]
@@ -76,6 +77,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         col = i * block_k + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
         s = jnp.where(col < kv_len, s, _NEG_INF)
+        if causal:
+            # Global positions: pos_ref holds (q_offset, k_offset) —
+            # nonzero when this call is one hop of a sharded ring.
+            row_g = pos_ref[0, 0] + pl.program_id(1) * block_q \
+                + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            s = jnp.where(pos_ref[0, 1] + col <= row_g, s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)                             # [BQ, BK]
         alpha = jnp.exp(m - m_new)
@@ -91,8 +98,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     lse_ref[0] = m + jnp.log(l)
 
 
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, scale: float, block_k: int, kv_len: int):
+def _bwd_dq_kernel(pos_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                   delta_ref, dq_ref,
+                   *, scale: float, block_q: int, block_k: int, kv_len: int,
+                   causal: bool):
     import jax.experimental.pallas as pl  # noqa: F401
 
     q = q_ref[0]
@@ -109,7 +118,12 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             preferred_element_type=jnp.float32) * scale
         col = i * block_k + jax.lax.broadcasted_iota(
             jnp.int32, s.shape, 1)
-        p = jnp.where(col < kv_len, jnp.exp(s - lse), 0.0)  # [BQ, BK]
+        keep = col < kv_len
+        if causal:
+            row_g = pos_ref[0, 0] + pl.program_id(1) * block_q \
+                + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            keep = keep & (pos_ref[0, 1] + col <= row_g)
+        p = jnp.where(keep, jnp.exp(s - lse), 0.0)          # [BQ, BK]
         dp = jax.lax.dot_general(
             do.astype(vb.dtype), vb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -123,9 +137,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *,
-                    scale: float, block_q: int, kv_len: int):
+def _bwd_dkv_kernel(pos_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
+                    delta_ref, dk_ref, dv_ref, *,
+                    scale: float, block_q: int, kv_len: int, causal: bool):
     import jax.experimental.pallas as pl
 
     kb = k_ref[0]                                          # [BK, D]
@@ -144,7 +158,12 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         s = jax.lax.dot_general(
             qb, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale    # [BQ, BK]
-        p = jnp.where(col < kv_len, jnp.exp(s - lse), 0.0)
+        keep = col < kv_len
+        if causal:
+            row_g = pos_ref[0, 0] + j * block_q \
+                + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            keep = keep & (pos_ref[0, 1] + col <= row_g)
+        p = jnp.where(keep, jnp.exp(s - lse), 0.0)
         dv = dv + jax.lax.dot_general(
             p.astype(dob.dtype), dob, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)            # [BK, D]
@@ -165,11 +184,25 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 # -- jnp fallback (identical masked math, dense) ------------------------------
 
-def _dense_fwd(q, k, v, kv_len, scale, out_dtype=None):
+def _position_mask(tq, tk, kv_len, causal, q_offset, k_offset):
+    """[Tq, Tk] keep-mask combining the kv_len bound with (optionally) the
+    causal constraint in GLOBAL positions (offsets are nonzero when the
+    call is one hop of a sharded ring)."""
+    keep = (jnp.arange(tk) < kv_len)[None, :]
+    if causal:
+        rows = q_offset + jnp.arange(tq)
+        cols = k_offset + jnp.arange(tk)
+        keep = keep & (cols[None, :] <= rows[:, None])
+    return keep
+
+
+def _dense_fwd(q, k, v, kv_len, scale, out_dtype=None,
+               causal=False, q_offset=0, k_offset=0):
     s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
-    mask = jnp.arange(s.shape[-1]) < kv_len
-    s = jnp.where(mask[None, None, :], s, _NEG_INF)
+    mask = _position_mask(q.shape[1], k.shape[1], kv_len, causal,
+                          q_offset, k_offset)
+    s = jnp.where(mask[None], s, _NEG_INF)
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
     l = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
@@ -191,26 +224,35 @@ def pick_block(t: int) -> int:
 
 # -- core op on [BH, T_pad, D] with custom VJP --------------------------------
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_core(q, k, v, kv_len, block_q, block_k, use_pallas):
-    o, _ = _flash_fwd_impl(q, k, v, kv_len, block_q, block_k, use_pallas)
+def _pos_scalars(q_offset, k_offset):
+    """(1, 2) int32 SMEM payload carrying the global (q, k) offsets."""
+    return jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                      jnp.asarray(k_offset, jnp.int32)]).reshape(1, 2)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_core(q, k, v, kv_len, block_q, block_k, use_pallas, causal):
+    o, _ = _flash_fwd_impl(q, k, v, kv_len, block_q, block_k, use_pallas,
+                           causal=causal)
     return o
 
 
 def _flash_fwd_impl(q, k, v, kv_len, block_q, block_k, use_pallas,
-                    out_dtype=None):
+                    out_dtype=None, causal=False, q_offset=0, k_offset=0):
     bh, tp, d = q.shape
     scale = 1.0 / np.sqrt(d)
     if not use_pallas:
         # out_dtype reaches the FINAL cast — an intermediate round-trip
         # through q.dtype would quantize the fp32 partials the ring merge
         # depends on.
-        return _dense_fwd(q, k, v, kv_len, scale, out_dtype)
+        return _dense_fwd(q, k, v, kv_len, scale, out_dtype,
+                          causal, q_offset, k_offset)
 
     import jax.experimental.pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     n_q = tp // block_q
+    blk_pos = pl.BlockSpec(memory_space=pltpu.SMEM)
     blk_q = pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0),
                          memory_space=pltpu.VMEM)
     blk_full = pl.BlockSpec((1, tp, d), lambda b, i: (b, 0, 0),
@@ -220,23 +262,26 @@ def _flash_fwd_impl(q, k, v, kv_len, block_q, block_k, use_pallas,
     blk_lse = pl.BlockSpec((1, block_q, 1), lambda b, i: (b, i, 0),
                            memory_space=pltpu.VMEM)
     o, lse = pl.pallas_call(
-        partial(_fwd_kernel, scale=scale, block_k=block_k, kv_len=kv_len),
+        partial(_fwd_kernel, scale=scale, block_q=block_q, block_k=block_k,
+                kv_len=kv_len, causal=causal),
         grid=(bh, n_q),
-        in_specs=[blk_q, blk_full, blk_full],
+        in_specs=[blk_pos, blk_q, blk_full, blk_full],
         out_specs=(blk_q, blk_lse),
         out_shape=(jax.ShapeDtypeStruct(q.shape, out_dtype or q.dtype),
                    jax.ShapeDtypeStruct((bh, tp, 1), jnp.float32)),
-    )(q, k, v)
+    )(_pos_scalars(q_offset, k_offset), q, k, v)
     return o, lse
 
 
-def _flash_core_fwd(q, k, v, kv_len, block_q, block_k, use_pallas):
-    o, lse = _flash_fwd_impl(q, k, v, kv_len, block_q, block_k, use_pallas)
+def _flash_core_fwd(q, k, v, kv_len, block_q, block_k, use_pallas, causal):
+    o, lse = _flash_fwd_impl(q, k, v, kv_len, block_q, block_k, use_pallas,
+                             causal=causal)
     return o, (q, k, v, o, lse)
 
 
 def _flash_bwd_impl(q, k, v, do, lse, delta, kv_len, block_q, block_k,
-                    use_pallas, out_dtype=None):
+                    use_pallas, out_dtype=None,
+                    causal=False, q_offset=0, k_offset=0):
     """Flash backward given EXTERNAL (lse, delta) — shared by the custom
     VJP below and by ring attention's per-hop backward
     (parallel/ring_attention.py), where lse/delta come from the MERGED
@@ -250,8 +295,8 @@ def _flash_bwd_impl(q, k, v, do, lse, delta, kv_len, block_q, block_k,
         qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
         dof = do.astype(jnp.float32)
         s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
-        mask = jnp.arange(tk) < kv_len
-        p = jnp.where(mask[None, None, :], jnp.exp(s - lse), 0.0)
+        mask = _position_mask(tq, tk, kv_len, causal, q_offset, k_offset)
+        p = jnp.where(mask[None], jnp.exp(s - lse), 0.0)
         dv = jnp.einsum("bqk,bqd->bkd", p, dof)
         dp = jnp.einsum("bqd,bkd->bqk", dof, vf)
         ds = p * (dp - delta)
@@ -275,33 +320,38 @@ def _flash_bwd_impl(q, k, v, do, lse, delta, kv_len, block_q, block_k,
     blk_row_qfull = pl.BlockSpec((1, tq, 1), lambda b, i: (b, 0, 0),
                                  memory_space=pltpu.VMEM)
 
+    blk_pos = pl.BlockSpec(memory_space=pltpu.SMEM)
+    pos = _pos_scalars(q_offset, k_offset)
+
     dq = pl.pallas_call(
-        partial(_bwd_dq_kernel, scale=scale, block_k=block_k, kv_len=kv_len),
+        partial(_bwd_dq_kernel, scale=scale, block_q=block_q,
+                block_k=block_k, kv_len=kv_len, causal=causal),
         grid=(bh, tq // block_q),
-        in_specs=[blk_q, blk_kfull, blk_kfull, blk_q, blk_row_q, blk_row_q],
+        in_specs=[blk_pos, blk_q, blk_kfull, blk_kfull, blk_q, blk_row_q,
+                  blk_row_q],
         out_specs=blk_q,
         out_shape=jax.ShapeDtypeStruct(q.shape, dts[0]),
-    )(q, k, v, do, lse, delta)
+    )(pos, q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
         partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
-                kv_len=kv_len),
+                kv_len=kv_len, causal=causal),
         grid=(bh, tk // block_k),
-        in_specs=[blk_qfull, blk_k, blk_k, blk_qfull, blk_row_qfull,
-                  blk_row_qfull],
+        in_specs=[blk_pos, blk_qfull, blk_k, blk_k, blk_qfull,
+                  blk_row_qfull, blk_row_qfull],
         out_specs=(blk_k, blk_k),
         out_shape=(jax.ShapeDtypeStruct(k.shape, dts[1]),
                    jax.ShapeDtypeStruct(v.shape, dts[2])),
-    )(q, k, v, do, lse, delta)
+    )(pos, q, k, v, do, lse, delta)
     return dq, dk, dv
 
 
-def _flash_core_bwd(kv_len, block_q, block_k, use_pallas, res, do):
+def _flash_core_bwd(kv_len, block_q, block_k, use_pallas, causal, res, do):
     q, k, v, o, lse = res
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)            # [BH, T, 1]
     return _flash_bwd_impl(q, k, v, do, lse, delta, kv_len, block_q,
-                           block_k, use_pallas)
+                           block_k, use_pallas, causal=causal)
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
@@ -310,10 +360,11 @@ _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
 # -- public op ----------------------------------------------------------------
 
 def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
+                    causal: bool = False,
                     block_q: int | None = None,
                     block_k: int | None = None,
                     use_pallas: bool | None = None) -> jax.Array:
-    """Fused non-causal attention over ``[B, T, H, D]`` q/k/v.
+    """Fused attention over ``[B, T, H, D]`` q/k/v (causal optional).
 
     Same contract as parallel/ring_attention.dense_attention — plug into
     models/vit.py:SelfAttention via ``attention_fn=flash_attention`` (or
@@ -343,6 +394,6 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
         return jnp.pad(x, ((0, 0), (0, tp - t), (0, 0))) if tp != t else x
 
     o3 = _flash_core(to3(q), to3(k), to3(v), t, block_q, block_k,
-                     bool(use_pallas))
+                     bool(use_pallas), bool(causal))
     o = o3[:, :t].reshape(b, h, t, d)
     return jnp.transpose(o, (0, 2, 1, 3)).astype(q.dtype)

@@ -102,7 +102,8 @@ def _to4(x3, b, h):
     return jnp.transpose(x3.reshape(b, h, t, d), (0, 2, 1, 3))
 
 
-def _hop_fwd(q4, k4, v4, use_pallas: bool):
+def _hop_fwd(q4, k4, v4, use_pallas: bool, causal=False,
+             q_offset=0, k_offset=0):
     """One hop's flash forward on [B, Tq, H, D] q against a [B, Tk, H, D]
     K/V block -> (normalized fp32 partial out [B,Tq,H,D], lse [B*H,Tq,1]).
     Partials stay fp32: the ring accumulators merge N of them, and rounding
@@ -113,11 +114,13 @@ def _hop_fwd(q4, k4, v4, use_pallas: bool):
     tk = k4.shape[1]
     o3, lse3 = _flash_fwd_impl(_to3(q4), _to3(k4), _to3(v4), tk,
                                pick_block(tq), pick_block(tk), use_pallas,
-                               out_dtype=jnp.float32)
+                               out_dtype=jnp.float32, causal=causal,
+                               q_offset=q_offset, k_offset=k_offset)
     return _to4(o3, b, h), lse3
 
 
-def _hop_bwd(q4, k4, v4, do4, lse_tot, delta, use_pallas: bool):
+def _hop_bwd(q4, k4, v4, do4, lse_tot, delta, use_pallas: bool,
+             causal=False, q_offset=0, k_offset=0):
     """One hop's flash backward: fp32 (dq_partial, dk_block, dv_block)
     given the TOTAL logsumexp and delta — the flash backward never
     differentiates through the merge (p_i = exp(s_i - lse_total) directly;
@@ -129,11 +132,13 @@ def _hop_bwd(q4, k4, v4, do4, lse_tot, delta, use_pallas: bool):
     dq3, dk3, dv3 = _flash_bwd_impl(
         _to3(q4), _to3(k4), _to3(v4), _to3(do4), lse_tot, delta,
         kv_len=tk, block_q=pick_block(tq), block_k=pick_block(tk),
-        use_pallas=use_pallas, out_dtype=jnp.float32)
+        use_pallas=use_pallas, out_dtype=jnp.float32, causal=causal,
+        q_offset=q_offset, k_offset=k_offset)
     return _to4(dq3, b, h), _to4(dk3, b, h), _to4(dv3, b, h)
 
 
 def make_ring_flash_attention(mesh: Mesh, axis: str = "seq",
+                              causal: bool = False,
                               use_pallas: bool | None = None) -> Callable:
     """Ring attention whose per-hop block core is the Pallas flash kernel.
 
@@ -154,7 +159,10 @@ def make_ring_flash_attention(mesh: Mesh, axis: str = "seq",
 
     Off TPU (CPU tests) the hops run the identical-math jnp fallback; the
     kernels themselves are validated on-chip by tests/test_flash_attention.
-    Non-causal (the SP/ViT path); T/N must be a multiple of 128.
+    ``causal=True`` masks in GLOBAL positions: each hop passes its shard's
+    q offset and the rotating block's k offset down to the kernels; a hop
+    whose block is entirely in the future degenerates to lse ~ -1e30 and
+    the merge weights it to zero. T/N must be a multiple of 128.
     """
     axis_size = mesh.shape[axis]
     if use_pallas is None:
@@ -173,9 +181,25 @@ def make_ring_flash_attention(mesh: Mesh, axis: str = "seq",
         m = jnp.full((bh, tl, 1), _NEG_INF, jnp.float32)
         l = jnp.zeros((bh, tl, 1), jnp.float32)
         acc = jnp.zeros((b, tl, h, d), jnp.float32)
+        my = jax.lax.axis_index(axis)
         kk, vv = k, v
         for step in range(axis_size):
-            o_i, lse_i = _hop_fwd(q, kk, vv, use_pallas)
+            src = (my - step) % axis_size  # home shard of the resident block
+            if causal:
+                # A block entirely in the future (src > my) contributes
+                # nothing — skip its FLOPs instead of computing a hop the
+                # merge will weight to zero ((N-1)/2 hops per shard).
+                o_i, lse_i = jax.lax.cond(
+                    src <= my,
+                    lambda ops: _hop_fwd(*ops, use_pallas, True,
+                                         my * tl, src * tl),
+                    lambda ops: (jnp.zeros((b, tl, h, d), jnp.float32),
+                                 jnp.full((bh, tl, 1), _NEG_INF,
+                                          jnp.float32)),
+                    (q, kk, vv))
+            else:
+                o_i, lse_i = _hop_fwd(q, kk, vv, use_pallas, False,
+                                      my * tl, src * tl)
             m_new = jnp.maximum(m, lse_i)
             w_prev = jnp.exp(m - m_new)
             w_i = jnp.exp(lse_i - m_new)
@@ -205,12 +229,23 @@ def make_ring_flash_attention(mesh: Mesh, axis: str = "seq",
                         axis=-1)                       # [B, T, H]
         delta = jnp.transpose(delta, (0, 2, 1)).reshape(b * h, tl, 1)
         dq = jnp.zeros_like(q, jnp.float32)
+        my = jax.lax.axis_index(axis)
         kk, vv = k, v
         dkk = jnp.zeros_like(k, jnp.float32)
         dvv = jnp.zeros_like(v, jnp.float32)
         for step in range(axis_size):
-            dq_i, dk_i, dv_i = _hop_bwd(q, kk, vv, do, lse_tot, delta,
-                                        use_pallas)
+            src = (my - step) % axis_size
+            if causal:
+                dq_i, dk_i, dv_i = jax.lax.cond(
+                    src <= my,
+                    lambda ops: _hop_bwd(*ops, use_pallas, True,
+                                         my * tl, src * tl),
+                    lambda ops: (jnp.zeros((b, tl, h, d), jnp.float32),) * 3,
+                    (q, kk, vv, do, lse_tot, delta))
+            else:
+                dq_i, dk_i, dv_i = _hop_bwd(q, kk, vv, do, lse_tot, delta,
+                                            use_pallas, False,
+                                            my * tl, src * tl)
             dq = dq + dq_i
             dkk = dkk + dk_i
             dvv = dvv + dv_i

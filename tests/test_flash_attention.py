@@ -83,20 +83,49 @@ def test_vit_attention_fn_contract():
 
 
 @pytest.mark.skipif(not _on_tpu(), reason="needs a TPU for the Pallas path")
-def test_pallas_path_on_tpu():
+@pytest.mark.parametrize("causal", [False, True])
+def test_pallas_path_on_tpu(causal):
+    """The KERNEL-side masking (incl. the causal global-position branch
+    reading the SMEM offsets) — the CPU tests only cover the fallback."""
     q, k, v = _qkv(2, 256, 2, 64)
-    out = flash_attention(q, k, v, use_pallas=True)
-    ref = dense_attention(q, k, v)
+    tol = 2e-2 if causal else 2e-3  # short causal rows amplify matmul noise
+    out = flash_attention(q, k, v, causal=causal, use_pallas=True)
+    ref = dense_attention(q, k, v, causal=causal)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
-                               atol=2e-3, rtol=2e-3)
+                               atol=tol, rtol=tol)
 
     cot = jax.random.normal(jax.random.PRNGKey(9), q.shape)
     g_p = jax.grad(lambda a, b, c: jnp.sum(
-        flash_attention(a, b, c, use_pallas=True) * cot),
+        flash_attention(a, b, c, causal=causal, use_pallas=True) * cot),
         argnums=(0, 1, 2))(q, k, v)
     g_d = jax.grad(lambda a, b, c: jnp.sum(
-        dense_attention(a, b, c) * cot), argnums=(0, 1, 2))(q, k, v)
+        dense_attention(a, b, c, causal=causal) * cot),
+        argnums=(0, 1, 2))(q, k, v)
     for gp, gd, name in zip(g_p, g_d, "qkv"):
         np.testing.assert_allclose(np.asarray(gp), np.asarray(gd),
-                                   atol=2e-3, rtol=2e-3,
+                                   atol=tol, rtol=tol,
+                                   err_msg=f"d{name} mismatch")
+
+
+@pytest.mark.parametrize("t", [64, 100, 257])
+def test_causal_forward_matches_dense(t):
+    q, k, v = _qkv(2, t, 3, 64, seed=5)
+    out = flash_attention(q, k, v, causal=True, use_pallas=False)
+    ref = dense_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_causal_gradients_match_dense():
+    q, k, v = _qkv(1, 100, 2, 64, seed=7)
+    cot = jax.random.normal(jax.random.PRNGKey(9), q.shape)
+    g_f = jax.grad(lambda a, b, c: jnp.sum(
+        flash_attention(a, b, c, causal=True, use_pallas=False) * cot),
+        argnums=(0, 1, 2))(q, k, v)
+    g_d = jax.grad(lambda a, b, c: jnp.sum(
+        dense_attention(a, b, c, causal=True) * cot),
+        argnums=(0, 1, 2))(q, k, v)
+    for gf, gd, name in zip(g_f, g_d, "qkv"):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gd),
+                                   atol=1e-4, rtol=1e-4,
                                    err_msg=f"d{name} mismatch")
