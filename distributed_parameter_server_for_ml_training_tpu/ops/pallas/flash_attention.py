@@ -35,7 +35,9 @@ per hop on T/N-sized blocks.
 
 from __future__ import annotations
 
-from functools import partial
+import json
+import os
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -47,12 +49,69 @@ _NEG_INF = -1e30
 # keep the MXU fed); short sequences clamp down so padding stays small.
 MAX_BLOCK = 512
 
+# Fallback when no measured crossover has been recorded (matches the
+# round-3 on-chip table: flash fwd+bwd first sustains >= 1.0x dense at
+# T=2048, experiments/results/mfu.json attention_core_bench).
+DEFAULT_CROSSOVER_T = 2048
+_CROSSOVER_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "attn_crossover.json")
+
+# Run the Pallas kernels in interpreter mode (CPU emulation of the exact
+# kernel code, loop bounds and SMEM scalars included). Tests flip this to
+# exercise the kernel-side logic without a chip; never set on TPU.
+INTERPRET = False
+
 
 def _on_tpu() -> bool:
     return jax.default_backend() in ("tpu", "axon")
 
 
+@lru_cache(maxsize=1)
+def flash_crossover() -> int:
+    """Measured dense->flash crossover sequence length.
+
+    Read from ``attn_crossover.json`` next to this module — REGENERATED (not
+    hand-coded) by ``experiments/measure_mfu.py``, which times dense vs
+    Pallas fwd+bwd across sequence lengths on the attached chip and records
+    the smallest T from which flash sustains >= 1.0x dense. Falls back to
+    ``DEFAULT_CROSSOVER_T`` when the file is absent.
+    """
+    try:
+        with open(_CROSSOVER_FILE) as f:
+            return int(json.load(f)["crossover_t"])
+    except (OSError, KeyError, ValueError):
+        return DEFAULT_CROSSOVER_T
+
+
+def flash_preferred(t: int) -> bool:
+    """True when the Pallas flash path is expected to BEAT dense attention
+    at sequence length ``t`` on the attached backend.
+
+    This is the dispatch predicate ``flash_attention`` (``use_pallas=None``)
+    and ``train.model_parallel.SPTrainer`` consult, closing the round-3 gap
+    where flash was auto-selected below its measured crossover and LOST to
+    dense (ViT-B/16 @224px, 197 tokens: 28.4% vs 43.8% MFU)."""
+    return _on_tpu() and t >= flash_crossover()
+
+
 # -- forward ------------------------------------------------------------------
+
+def _k_loop_hi(pos_ref, n_k: int, block_q: int, block_k: int, kv_len: int,
+               causal: bool):
+    """Upper bound (exclusive) of the K-block loop for the current query
+    block: fully-padded K blocks (beyond ``kv_len``, static) are skipped
+    outright, and under causal masking so are blocks entirely in the
+    future of this query block's last GLOBAL row (dynamic — depends on
+    the SMEM (q_offset, k_offset) scalars and the grid position)."""
+    import jax.experimental.pallas as pl
+
+    hi = min(n_k, -(-kv_len // block_k))           # static: skip padding
+    if not causal:
+        return hi
+    row_max = pos_ref[0, 0] + (pl.program_id(1) + 1) * block_q - 1
+    dyn = jnp.floor_divide(row_max - pos_ref[0, 1], block_k) + 1
+    return jnp.clip(dyn, 0, hi)
+
 
 def _fwd_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
                 scale: float, block_q: int, block_k: int, kv_len: int,
@@ -62,6 +121,10 @@ def _fwd_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
     q = q_ref[0]                                   # [BQ, D]
     bq = q.shape[0]
     n_k = k_ref.shape[1] // block_k
+    # program_id is read OUTSIDE the loop body: the interpret-mode lowering
+    # can't substitute it inside fori_loop sub-jaxprs (and hoisting is free
+    # on the TPU path).
+    pid_q = pl.program_id(1)
 
     m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
@@ -80,7 +143,7 @@ def _fwd_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
         if causal:
             # Global positions: pos_ref holds (q_offset, k_offset) —
             # nonzero when this call is one hop of a sharded ring.
-            row_g = pos_ref[0, 0] + pl.program_id(1) * block_q \
+            row_g = pos_ref[0, 0] + pid_q * block_q \
                 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             s = jnp.where(pos_ref[0, 1] + col <= row_g, s, _NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
@@ -92,7 +155,9 @@ def _fwd_kernel(pos_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, *,
             preferred_element_type=jnp.float32)
         return m_new, l_new, acc * alpha + pv
 
-    m, l, acc = jax.lax.fori_loop(0, n_k, body, (m0, l0, acc0))
+    m, l, acc = jax.lax.fori_loop(
+        0, _k_loop_hi(pos_ref, n_k, block_q, block_k, kv_len, causal),
+        body, (m0, l0, acc0))
     l = jnp.maximum(l, 1e-30)
     o_ref[0] = (acc / l).astype(o_ref.dtype)
     lse_ref[0] = m + jnp.log(l)
@@ -109,6 +174,7 @@ def _bwd_dq_kernel(pos_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     lse = lse_ref[0]                                       # [BQ, 1]
     delta = delta_ref[0]
     n_k = k_ref.shape[1] // block_k
+    pid_q = pl.program_id(1)       # hoisted: see _fwd_kernel
 
     def body(i, dq):
         kb = k_ref[0, pl.ds(i * block_k, block_k), :]
@@ -120,7 +186,7 @@ def _bwd_dq_kernel(pos_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             jnp.int32, s.shape, 1)
         keep = col < kv_len
         if causal:
-            row_g = pos_ref[0, 0] + pl.program_id(1) * block_q \
+            row_g = pos_ref[0, 0] + pid_q * block_q \
                 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             keep = keep & (pos_ref[0, 1] + col <= row_g)
         p = jnp.where(keep, jnp.exp(s - lse), 0.0)          # [BQ, BK]
@@ -133,13 +199,15 @@ def _bwd_dq_kernel(pos_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
             preferred_element_type=jnp.float32) * scale
 
     dq = jax.lax.fori_loop(
-        0, n_k, body, jnp.zeros(q.shape[:1] + (q.shape[1],), jnp.float32))
+        0, _k_loop_hi(pos_ref, n_k, block_q, block_k, kv_len, causal),
+        body, jnp.zeros(q.shape[:1] + (q.shape[1],), jnp.float32))
     dq_ref[0] = dq.astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(pos_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
                     delta_ref, dk_ref, dv_ref, *,
-                    scale: float, block_q: int, kv_len: int, causal: bool):
+                    scale: float, block_q: int, kv_len: int, q_len: int,
+                    causal: bool):
     import jax.experimental.pallas as pl
 
     kb = k_ref[0]                                          # [BK, D]
@@ -148,6 +216,16 @@ def _bwd_dkv_kernel(pos_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
     col = pl.program_id(1) * bk + jax.lax.broadcasted_iota(
         jnp.int32, (1, bk), 1)                             # [1, BK] global
     n_q = q_ref.shape[1] // block_q
+    # Padded QUERY blocks (beyond q_len) have zero dO/delta — skip them
+    # (static); under causal masking also skip query blocks entirely
+    # BEFORE this K block's first global column (dynamic).
+    hi_q = min(n_q, -(-q_len // block_q))
+    if causal:
+        col0 = pos_ref[0, 1] + pl.program_id(1) * bk
+        lo_q = jnp.clip(jnp.floor_divide(col0 - pos_ref[0, 0], block_q),
+                        0, hi_q)
+    else:
+        lo_q = 0
 
     def body(j, carry):
         dk, dv = carry
@@ -177,7 +255,7 @@ def _bwd_dkv_kernel(pos_ref, q_ref, k_ref, v_ref, do_ref, lse_ref,
         return dk, dv
 
     zero = jnp.zeros((bk, kb.shape[1]), jnp.float32)
-    dk, dv = jax.lax.fori_loop(0, n_q, body, (zero, zero))
+    dk, dv = jax.lax.fori_loop(lo_q, hi_q, body, (zero, zero))
     dk_ref[0] = dk.astype(dk_ref.dtype)
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
@@ -269,6 +347,7 @@ def _flash_fwd_impl(q, k, v, kv_len, block_q, block_k, use_pallas,
         out_specs=(blk_q, blk_lse),
         out_shape=(jax.ShapeDtypeStruct(q.shape, out_dtype or q.dtype),
                    jax.ShapeDtypeStruct((bh, tp, 1), jnp.float32)),
+        interpret=INTERPRET,
     )(_pos_scalars(q_offset, k_offset), q, k, v)
     return o, lse
 
@@ -281,14 +360,18 @@ def _flash_core_fwd(q, k, v, kv_len, block_q, block_k, use_pallas, causal):
 
 def _flash_bwd_impl(q, k, v, do, lse, delta, kv_len, block_q, block_k,
                     use_pallas, out_dtype=None,
-                    causal=False, q_offset=0, k_offset=0):
+                    causal=False, q_offset=0, k_offset=0, q_len=None):
     """Flash backward given EXTERNAL (lse, delta) — shared by the custom
     VJP below and by ring attention's per-hop backward
     (parallel/ring_attention.py), where lse/delta come from the MERGED
     softmax over the whole ring. ``out_dtype`` overrides the gradient
-    dtype (the ring accumulates partials in fp32)."""
+    dtype (the ring accumulates partials in fp32). ``q_len`` is the
+    UNPADDED query length (padded query rows carry zero dO/delta, so the
+    dK/dV kernel skips those blocks); defaults to the padded length,
+    i.e. no skipping."""
     bh, tq, d = q.shape
     tk = k.shape[1]
+    q_len = tq if q_len is None else q_len
     scale = 1.0 / np.sqrt(d)
     dts = [out_dtype or x.dtype for x in (q, k, v)]
     if not use_pallas:
@@ -331,17 +414,19 @@ def _flash_bwd_impl(q, k, v, do, lse, delta, kv_len, block_q, block_k,
                   blk_row_q],
         out_specs=blk_q,
         out_shape=jax.ShapeDtypeStruct(q.shape, dts[0]),
+        interpret=INTERPRET,
     )(pos, q, k, v, do, lse, delta)
 
     dk, dv = pl.pallas_call(
         partial(_bwd_dkv_kernel, scale=scale, block_q=block_q,
-                kv_len=kv_len, causal=causal),
+                kv_len=kv_len, q_len=q_len, causal=causal),
         grid=(bh, tk // block_k),
         in_specs=[blk_pos, blk_qfull, blk_k, blk_k, blk_qfull,
                   blk_row_qfull, blk_row_qfull],
         out_specs=(blk_k, blk_k),
         out_shape=(jax.ShapeDtypeStruct(k.shape, dts[1]),
                    jax.ShapeDtypeStruct(v.shape, dts[2])),
+        interpret=INTERPRET,
     )(pos, q, k, v, do, lse, delta)
     return dq, dk, dv
 
@@ -350,8 +435,10 @@ def _flash_core_bwd(kv_len, block_q, block_k, use_pallas, causal, res, do):
     q, k, v, o, lse = res
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1, keepdims=True)            # [BH, T, 1]
+    # Self-attention: q and k share the unpadded length, so q_len=kv_len.
     return _flash_bwd_impl(q, k, v, do, lse, delta, kv_len, block_q,
-                           block_k, use_pallas, causal=causal)
+                           block_k, use_pallas, causal=causal,
+                           q_len=kv_len)
 
 
 _flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
@@ -371,10 +458,21 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     partial(...) to pin block sizes). Differentiable (custom VJP, flash
     backward). T is padded to a block multiple internally; default block
     sizes adapt to T (128-tile-rounded, capped at MAX_BLOCK).
+
+    ``use_pallas=None`` (the default) dispatches on the MEASURED
+    dense/flash crossover (``flash_preferred``): below it the dense
+    XLA-fused formulation wins (short sequences are dominated by the
+    padding + fusion-barrier overhead of a custom kernel) and is used
+    even on TPU; explicit True/False overrides.
     """
     b, t, h, d = q.shape
     if use_pallas is None:
-        use_pallas = _on_tpu()
+        use_pallas = flash_preferred(t)
+    for name, blk in (("block_q", block_q), ("block_k", block_k)):
+        if blk is not None and (blk <= 0 or blk % 128):
+            raise ValueError(
+                f"{name}={blk} must be a positive multiple of 128 (TPU "
+                f"tile constraint; defaults via pick_block satisfy it)")
     # Default blocks: the largest 128-multiple <= MAX_BLOCK that DIVIDES the
     # 128-rounded sequence length — a bare min() would pad e.g. T=768 up to
     # 1024 (1.78x the attention FLOPs); 384 divides it exactly.

@@ -445,12 +445,17 @@ class SPTrainer(_EpochTrainer):
                              f"{n_shards} sequence shards")
         self.mesh = make_mesh(n_shards, axis_names=("seq",),
                               devices=devs[:n_shards])
-        # Long-context configs (a 128-MULTIPLE of tokens per shard — the
-        # Pallas tile constraint pick_block enforces) run the fused
-        # ring x flash composition — flash kernels per hop, ppermute
-        # between; other shard sizes use the dense-hop ring.
+        # Long-context configs run the fused ring x flash composition —
+        # flash kernels per hop, ppermute between — but ONLY when the
+        # per-hop block length clears BOTH the Pallas tile constraint
+        # (128-multiple, pick_block) and the MEASURED dense/flash
+        # crossover (flash_preferred): round 3 showed flash LOSING to
+        # the XLA-fused dense core below it (ViT-B/16 @224, 197 tokens:
+        # 28.4% vs 43.8% MFU), so divisibility alone is not a reason to
+        # select the fused kernel.
         per_shard = self.tokens // n_shards
-        if per_shard % 128 == 0:
+        from ..ops.pallas.flash_attention import flash_preferred
+        if per_shard % 128 == 0 and flash_preferred(per_shard):
             from ..parallel.ring_attention import make_ring_flash_attention
             ring = make_ring_flash_attention(self.mesh, axis="seq")
         else:

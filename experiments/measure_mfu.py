@@ -129,9 +129,16 @@ def main() -> int:
         measure("vit_b16_32px", ViT(**vit_b16), 32, 1024, 20, args.trials),
         # Long-sequence ViT-B/16 (224px -> 197 tokens): dense einsum
         # attention vs the Pallas flash kernel, same model otherwise.
+        # "flash_auto" is what a user selecting flash_attention actually
+        # gets — the measured-crossover dispatch (dense below, Pallas
+        # above); "flash_forced" pins the Pallas path to document WHY
+        # dispatch picks dense at 197 tokens.
         measure("vit_b16_224px_dense", ViT(**vit_b16), 224, 64, 10,
                 args.trials),
-        measure("vit_b16_224px_flash",
+        measure("vit_b16_224px_flash_auto",
+                ViT(**vit_b16, attention_fn=flash_attention),
+                224, 64, 10, args.trials),
+        measure("vit_b16_224px_flash_forced",
                 ViT(**vit_b16, attention_fn=partial(flash_attention,
                                                     use_pallas=True)),
                 224, 64, 10, args.trials),
@@ -203,6 +210,42 @@ def main() -> int:
     with open(out, "w") as f:
         json.dump({"train_step_mfu": rows,
                    "attention_core_bench": attn_rows}, f, indent=2)
+
+    # Encode the measured crossover where flash_attention's auto dispatch
+    # reads it (ops/pallas/attn_crossover.json): the smallest tabulated T
+    # from which flash fwd+bwd SUSTAINS >= 1.0x dense. If flash never
+    # sustains a win, dispatch should never pick it — record one past the
+    # largest tabulated length.
+    xover = None
+    for i, r in enumerate(attn_rows):
+        if all(rr["flash_fwd_bwd_speedup"] >= 1.0 for rr in attn_rows[i:]):
+            xover = r["seq_len"]
+            break
+    if xover is None:
+        # Flash never sustained a win: dispatch must NEVER auto-select it
+        # (not even beyond the tabulated range — extrapolating a win from
+        # an all-loss table would recreate the round-3 regression).
+        xover = 2 ** 31
+    from distributed_parameter_server_for_ml_training_tpu.ops.pallas import (
+        flash_attention as fa_mod)
+    try:
+        with open(fa_mod._CROSSOVER_FILE, "w") as f:
+            json.dump({
+                "crossover_t": xover,
+                "source": "experiments/measure_mfu.py attention_core_bench "
+                          "(regenerated by every measure_mfu.py run)",
+                "rule": "smallest tabulated T from which flash fwd+bwd "
+                        "sustains >= 1.0x dense; 2**31 = never wins",
+                "measured_speedups_fwd_bwd": {
+                    str(r["seq_len"]): r["flash_fwd_bwd_speedup"]
+                    for r in attn_rows},
+            }, f, indent=2)
+            f.write("\n")
+        print(f"crossover_t = {xover} -> {fa_mod._CROSSOVER_FILE}",
+              flush=True)
+    except OSError as e:    # read-only install: keep the results, warn
+        print(f"WARNING: could not write {fa_mod._CROSSOVER_FILE}: {e}",
+              file=sys.stderr, flush=True)
 
     print("\n| model / shape | batch | images/s/chip | ms/step | TF/s | MFU |")
     print("|---|---|---|---|---|---|")

@@ -107,6 +107,58 @@ def test_pallas_path_on_tpu(causal):
                                    err_msg=f"d{name} mismatch")
 
 
+def test_explicit_block_override_validated():
+    """ADVICE r3: a non-128-multiple block override must raise a clear
+    ValueError instead of an opaque Mosaic lowering error."""
+    q, k, v = _qkv(1, 128, 1, 64)
+    with pytest.raises(ValueError, match="block_q=100"):
+        flash_attention(q, k, v, block_q=100)
+    with pytest.raises(ValueError, match="block_k=-128"):
+        flash_attention(q, k, v, block_k=-128)
+
+
+def test_crossover_dispatch(monkeypatch):
+    """use_pallas=None dispatches on the MEASURED crossover: dense below,
+    Pallas at/above (and never Pallas off-TPU)."""
+    import distributed_parameter_server_for_ml_training_tpu.ops.pallas.flash_attention as fa
+
+    xover = fa.flash_crossover()
+    assert xover >= 128  # sane measured value
+    monkeypatch.setattr(fa, "_on_tpu", lambda: False)
+    assert not fa.flash_preferred(xover)          # off TPU: never
+    monkeypatch.setattr(fa, "_on_tpu", lambda: True)
+    assert not fa.flash_preferred(xover - 1)
+    assert fa.flash_preferred(xover)
+    assert fa.flash_preferred(4 * xover)
+
+
+@pytest.mark.parametrize("t,causal", [(197, False), (197, True), (300, True)])
+def test_kernels_interpret_mode(t, causal, monkeypatch):
+    """The ACTUAL Pallas kernels (loop bounds, SMEM scalars, padding
+    masks) emulated on CPU via interpret mode — the only CPU-side check
+    that exercises kernel code rather than the jnp fallback. Covers the
+    padded final block (197->256) and the causal dynamic loop bounds."""
+    import distributed_parameter_server_for_ml_training_tpu.ops.pallas.flash_attention as fa
+
+    monkeypatch.setattr(fa, "INTERPRET", True)
+    q, k, v = _qkv(2, t, 2, 64, seed=11)
+    out = flash_attention(q, k, v, causal=causal, use_pallas=True)
+    ref = dense_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=2e-3, rtol=2e-3)
+    cot = jax.random.normal(jax.random.PRNGKey(9), q.shape)
+    g_p = jax.grad(lambda a, b, c: jnp.sum(
+        flash_attention(a, b, c, causal=causal, use_pallas=True) * cot),
+        argnums=(0, 1, 2))(q, k, v)
+    g_d = jax.grad(lambda a, b, c: jnp.sum(
+        dense_attention(a, b, c, causal=causal) * cot),
+        argnums=(0, 1, 2))(q, k, v)
+    for gp, gd, name in zip(g_p, g_d, "qkv"):
+        np.testing.assert_allclose(np.asarray(gp), np.asarray(gd),
+                                   atol=5e-3, rtol=5e-3,
+                                   err_msg=f"d{name} mismatch")
+
+
 @pytest.mark.parametrize("t", [64, 100, 257])
 def test_causal_forward_matches_dense(t):
     q, k, v = _qkv(2, t, 3, 64, seed=5)
