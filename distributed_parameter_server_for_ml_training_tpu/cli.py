@@ -109,8 +109,10 @@ def build_parser() -> argparse.ArgumentParser:
                    help="K-step local SGD interval (worker.py:468)")
     t.add_argument("--k-step-mode", choices=["faithful", "accumulate"],
                    default="faithful")
-    t.add_argument("--compression", choices=["none", "bf16", "fp16"],
-                   default="bf16", help="sync all-reduce precision")
+    t.add_argument("--compression", choices=["none", "bf16", "fp16", "int8"],
+                   default="bf16",
+                   help="sync all-reduce precision (int8 = quantized "
+                        "reduce-scatter ring, ~half bf16's ICI bytes)")
     t.add_argument("--strict-rounds", action="store_true",
                    help="corrected sync-round semantics (vs quirk 3)")
     t.add_argument("--elastic", action="store_true",

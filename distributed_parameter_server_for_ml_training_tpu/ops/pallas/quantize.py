@@ -6,7 +6,7 @@ per-block scale (~75% fewer bytes than fp32), quantized/dequantized on device
 so only int8 + scales cross HBM/ICI/host boundaries. Used by
 
 - the ``compression='int8'`` sync all-reduce mode (parallel/sync_dp.py):
-  quantize -> all_gather int8+scales -> dequantize+mean on each worker
+  int8 payloads on every hop of a reduce-scatter + all-gather ring
   (EQuARX-style quantized collective; PAPERS.md prior art),
 - the async wire path (ops/compression.py int8 tree codec is the host-side
   equivalent for store payloads).
@@ -31,11 +31,27 @@ LANES = 128
 BLOCK_ROWS = 256  # 256x128 fp32 = 128 KiB per block in VMEM
 
 
+def block_rows_for(rows_padded: int) -> int:
+    """Quantization block height for a [rows_padded, 128] view.
+
+    Large inputs tile in BLOCK_ROWS blocks; inputs at or below one block
+    are a SINGLE block of their own (8-row-aligned) height — padding a
+    1/N-sized ring chunk up to 32768 elements would otherwise dominate
+    the wire bytes for small models (parallel/sync_dp.py int8 ring).
+    Both quantize and dequantize derive the layout from this rule, so the
+    pair stays consistent without shipping the block size."""
+    return rows_padded if rows_padded <= BLOCK_ROWS else BLOCK_ROWS
+
+
 def _pad_to_blocks(x: jax.Array) -> tuple[jax.Array, int, int]:
-    """Flatten to [rows, 128] with rows a multiple of BLOCK_ROWS."""
+    """Flatten to [rows, 128]; rows 8-aligned (single block) for small
+    inputs, a BLOCK_ROWS multiple otherwise."""
     n = x.size
     rows = -(-n // LANES)
-    rows_padded = -(-rows // BLOCK_ROWS) * BLOCK_ROWS
+    if rows <= BLOCK_ROWS:
+        rows_padded = -(-rows // 8) * 8
+    else:
+        rows_padded = -(-rows // BLOCK_ROWS) * BLOCK_ROWS
     flat = jnp.zeros((rows_padded * LANES,), jnp.float32)
     flat = flat.at[:n].set(x.reshape(-1).astype(jnp.float32))
     return flat.reshape(rows_padded, LANES), n, rows_padded
@@ -100,7 +116,8 @@ def quantize_int8(x: jax.Array, seed: jax.Array | int = 0, *,
     statically).
     """
     xb, n, rows = _pad_to_blocks(x)
-    n_blocks = rows // BLOCK_ROWS
+    br = block_rows_for(rows)
+    n_blocks = rows // br
     if use_pallas is None:
         use_pallas = _on_tpu()
 
@@ -112,9 +129,9 @@ def quantize_int8(x: jax.Array, seed: jax.Array | int = 0, *,
             jax.ShapeDtypeStruct((rows, LANES), jnp.int8),
             jax.ShapeDtypeStruct((n_blocks, 1), jnp.float32),
         )
-        block_in = pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0),
+        block_in = pl.BlockSpec((br, LANES), lambda i: (i, 0),
                                 memory_space=pltpu.VMEM)
-        block_vals = pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0),
+        block_vals = pl.BlockSpec((br, LANES), lambda i: (i, 0),
                                   memory_space=pltpu.VMEM)
         # whole scales array in SMEM for every step (untiled scalar slots)
         block_scale = pl.BlockSpec((n_blocks, 1), lambda i: (0, 0),
@@ -138,7 +155,7 @@ def quantize_int8(x: jax.Array, seed: jax.Array | int = 0, *,
         return values, scales.reshape(n_blocks)
 
     # jnp fallback: identical deterministic math (stochastic ignored).
-    blocks = xb.reshape(n_blocks, BLOCK_ROWS * LANES)
+    blocks = xb.reshape(n_blocks, br * LANES)
     abs_max = jnp.max(jnp.abs(blocks), axis=1)
     scales = jnp.where(abs_max > 0, abs_max / 127.0, 1.0)
     q = jnp.clip(jnp.rint(blocks / scales[:, None]), -127, 127)
@@ -152,7 +169,8 @@ def dequantize_int8(values: jax.Array, scales: jax.Array,
     (static) array shape."""
     n = int(np.prod(shape, dtype=np.int64)) if shape else 1
     rows = values.shape[0]
-    n_blocks = rows // BLOCK_ROWS
+    br = block_rows_for(rows)
+    n_blocks = rows // br
     if use_pallas is None:
         use_pallas = _on_tpu()
 
@@ -164,17 +182,17 @@ def dequantize_int8(values: jax.Array, scales: jax.Array,
             _dequantize_kernel,
             grid=(n_blocks,),
             in_specs=[
-                pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0),
+                pl.BlockSpec((br, LANES), lambda i: (i, 0),
                              memory_space=pltpu.VMEM),
                 pl.BlockSpec((n_blocks, 1), lambda i: (0, 0),
                              memory_space=pltpu.SMEM),
             ],
-            out_specs=pl.BlockSpec((BLOCK_ROWS, LANES), lambda i: (i, 0),
+            out_specs=pl.BlockSpec((br, LANES), lambda i: (i, 0),
                                    memory_space=pltpu.VMEM),
             out_shape=jax.ShapeDtypeStruct((rows, LANES), jnp.float32),
         )(values, scales.reshape(n_blocks, 1))
     else:
-        blocks = values.reshape(n_blocks, BLOCK_ROWS * LANES)
+        blocks = values.reshape(n_blocks, br * LANES)
         out = (blocks.astype(jnp.float32)
                * scales.reshape(n_blocks, 1)).reshape(rows, LANES)
 

@@ -41,25 +41,69 @@ from ..train.train_state import TrainState
 from .mesh import DATA_AXIS
 
 
-def _int8_allreduce_mean(grads, axis: str):
-    """Quantized gradient averaging: one flattened int8 quantization (Pallas
-    on TPU), an all_gather of int8 values + per-block scales, and a local
-    fused dequantize+mean. Moves ~1/4 of fp32's bytes over ICI."""
+def _int8_ring_allreduce_mean(grads, axis: str, axis_size: int, seed):
+    """Quantized all-reduce as a reduce-scatter ring + all-gather ring with
+    int8 payloads on every hop (EQuARX-style; PAPERS.md prior art).
+
+    The round-3 formulation (quantize once, ``all_gather`` values+scales,
+    local mean) moved N x S int8 bytes per device — O(N) in the mesh size,
+    already tying bf16-pmean traffic at N=4 and ~2x it at N=8. Quantizing
+    *inside* the ring keeps per-device bytes ~N-independent:
+
+    - reduce-scatter phase: N-1 hops; each hop quantizes the running
+      partial sum of ONE 1/N-sized chunk (stochastic rounding, per-hop
+      seed — requantization noise stays unbiased), ``ppermute``s it to the
+      next neighbor, and accumulates the received block into the local
+      contribution for the next chunk. After N-1 hops device d holds the
+      full sum of chunk (d+1) mod N.
+    - all-gather phase: the reduced mean chunk is quantized ONCE and its
+      int8+scales payload rotated N-1 hops; every device (owner included)
+      applies the SAME dequantized values, so replicas stay bit-identical.
+
+    Per-device ICI bytes: 2 (N-1)/N x S x 1B (+ scales, 4B / 32768 elems)
+    vs bf16-pmean's 4 (N-1)/N x S — int8 is ~half bf16 at every N, and
+    strictly below it from N=2 up (the round-3 scheme crossed above bf16
+    at N>=4). Byte model recorded in experiments/results/PERF.md and
+    asserted against compiled HLO by tests/test_quantize.py.
+    """
     from jax.flatten_util import ravel_pytree
 
-    from ..ops.pallas.quantize import LANES, dequantize_int8, quantize_int8
+    from ..ops.pallas.quantize import dequantize_int8, quantize_int8
 
     flat, unravel = ravel_pytree(grads)
-    values, scales = quantize_int8(flat)            # [rows,128], [blocks]
-    v_all = jax.lax.all_gather(values, axis)        # [N, rows, 128]
-    s_all = jax.lax.all_gather(scales, axis)        # [N, blocks]
-    n_workers, rows, _ = v_all.shape
-    padded = rows * LANES
-    deq = dequantize_int8(v_all.reshape(n_workers * rows, LANES),
-                          s_all.reshape(-1),
-                          (n_workers * padded,))
-    mean_flat = deq.reshape(n_workers, padded).mean(axis=0)[:flat.size]
-    return unravel(mean_flat)
+    n = axis_size
+    my = jax.lax.axis_index(axis)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    chunk = -(-flat.size // n)
+    own = jnp.pad(flat, (0, n * chunk - flat.size)).reshape(n, chunk)
+
+    def quant(x, s):
+        # Distinct PRNG stream per hop (and per device/step via ``seed``,
+        # already folded with worker index + step by the caller).
+        hop_seed = jax.random.randint(jax.random.fold_in(seed, s), (),
+                                      0, 2 ** 31 - 1, dtype=jnp.int32)
+        return quantize_int8(x, seed=hop_seed, stochastic=True)
+
+    # -- reduce-scatter ring: partial sums travel int8 ---------------------
+    part = jnp.take(own, my % n, axis=0)
+    for s in range(n - 1):
+        v, sc = quant(part, s)
+        v = jax.lax.ppermute(v, axis, perm)
+        sc = jax.lax.ppermute(sc, axis, perm)
+        recv = dequantize_int8(v, sc, (chunk,))
+        part = jnp.take(own, (my - s - 1) % n, axis=0) + recv
+
+    # -- all-gather ring: the mean chunk quantized once, rotated N-1 hops --
+    v, sc = quant(part / n, n - 1)
+    out = jnp.zeros((n, chunk), jnp.float32)
+    idx = (my + 1) % n
+    out = out.at[idx].set(dequantize_int8(v, sc, (chunk,)))
+    for _ in range(n - 1):
+        v = jax.lax.ppermute(v, axis, perm)
+        sc = jax.lax.ppermute(sc, axis, perm)
+        idx = (idx - 1) % n
+        out = out.at[idx].set(dequantize_int8(v, sc, (chunk,)))
+    return unravel(out.reshape(-1)[:flat.size])
 
 
 def shard_batch(mesh: Mesh, batch, axis: str = DATA_AXIS):
@@ -113,10 +157,15 @@ def make_sync_dp_step(mesh: Mesh, *, axis: str = DATA_AXIS,
         # with compression on the wire (the reference cast fp16,
         # worker.py:264-268):
         #   bf16/fp16 -> reduced-precision pmean (half the ICI bytes)
-        #   int8      -> Pallas block-quantize + all_gather + dequant-mean
-        #                (quarter the bytes; EQuARX-style)
+        #   int8      -> quantized reduce-scatter + all-gather ring
+        #                (~1/2 bf16's bytes, N-independent; EQuARX-style)
         if compression == "int8":
-            grads = _int8_allreduce_mean(grads, axis)
+            # Dedicated PRNG stream: augment_batch consumes split(rng)
+            # (= fold_in(rng, 0/1)), so the ring's hop seeds must branch
+            # off a tag those small indices can never produce.
+            grads = _int8_ring_allreduce_mean(
+                grads, axis, mesh.shape[axis],
+                jax.random.fold_in(rng, 0x7FFFFFFF))
         else:
             grads = compress_for_allreduce(grads, compression)
             grads = jax.lax.pmean(grads, axis)

@@ -1,0 +1,114 @@
+"""Record the sync-DP compression modes' wire-byte model and loss parity.
+
+Round-4 VERDICT item 2 evidence: per-device ICI bytes of the gradient
+all-reduce for compression none / bf16 / int8 across mesh sizes, measured
+from the compiled HLO's collective ops (utils/hlo_bytes.py), plus a short
+sync training run per mode on the calibrated dataset showing loss-curve
+parity. Runs on the virtual CPU mesh (collectives are emitted identically;
+on-chip byte counts follow the same HLO) and writes
+experiments/results/comm_bytes.json + a markdown table for PERF.md.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+RESNET18_PARAMS = 11_220_132    # models/resnet.py, asserted in tests
+
+
+def wire_bytes_table() -> list[dict]:
+    from distributed_parameter_server_for_ml_training_tpu.utils.hlo_bytes import (
+        sync_grad_mean_bytes)
+
+    rows = []
+    for n in (2, 4, 8):
+        stats = sync_grad_mean_bytes(n, RESNET18_PARAMS)
+        row = {"n_devices": n}
+        for name in ("none", "bf16", "int8"):
+            row[f"{name}_mb"] = round(stats[name]["total"] / 1e6, 3)
+        if stats["bf16"].get("widened_on_cpu"):
+            row["bf16_widened_on_cpu"] = True
+        # round-3 formulation for comparison: all_gather of int8 values
+        # (N x S x 1B per device via the (N-1)/N gather factor)
+        row["int8_r3_allgather_mb"] = round(
+            (n - 1) / n * n * RESNET18_PARAMS / 1e6, 3)
+        row["int8_vs_bf16"] = round(row["int8_mb"] / row["bf16_mb"], 3)
+        rows.append(row)
+        print(rows[-1], flush=True)
+    return rows
+
+
+def loss_parity(epochs: int = 4) -> dict:
+    """Short sync runs (4 workers) per compression mode on the calibrated
+    dataset: final losses must sit within a few percent of 'none'."""
+    from distributed_parameter_server_for_ml_training_tpu.data import (
+        make_batches, synthetic_cifar100)
+    from distributed_parameter_server_for_ml_training_tpu.models import ResNet
+    from distributed_parameter_server_for_ml_training_tpu.parallel import (
+        make_mesh, make_sync_dp_step, shard_batch)
+    from distributed_parameter_server_for_ml_training_tpu.train import (
+        create_train_state, server_sgd)
+
+    mesh = make_mesh(4)
+    d = synthetic_cifar100(n_train=2048, n_test=256, num_classes=100,
+                           seed=3)
+    model = ResNet(stage_sizes=(1, 1), num_filters=16, num_classes=100,
+                   axis_name="data")
+    curves = {}
+    for comp in ("none", "bf16", "int8"):
+        step = make_sync_dp_step(mesh, compression=comp, augment=False)
+        st = create_train_state(model, jax.random.PRNGKey(0),
+                                server_sgd(0.1))
+        losses = []
+        for epoch in range(epochs):
+            ep = []
+            for xb, yb in make_batches(d.x_train, d.y_train, 256,
+                                       seed=epoch):
+                sb = shard_batch(mesh, (xb, yb))
+                st, m = step(st, sb[0], sb[1], jax.random.PRNGKey(epoch))
+                ep.append(float(m["loss"]))
+            losses.append(round(float(np.mean(ep)), 4))
+        curves[comp] = losses
+        print(f"loss curve {comp}: {losses}", flush=True)
+    return curves
+
+
+def main() -> int:
+    out = {"wire_bytes_resnet18_grad": wire_bytes_table(),
+           "loss_curves_sync4": loss_parity(),
+           "model": ("per-device ICI bytes: none = 2(N-1)/N*4S, "
+                     "bf16 = 2(N-1)/N*2S, int8 ring = 2(N-1)/N*S "
+                     "(+scales/padding); round-3 int8 all_gather was "
+                     "(N-1)*S - O(N) and above bf16 from N=4")}
+    path = os.path.join(REPO, "experiments", "results", "comm_bytes.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(f"wrote {path}")
+    print("\n| N | none MB | bf16 MB | int8 ring MB | int8 r3 gather MB "
+          "| int8/bf16 |")
+    print("|---|---|---|---|---|---|")
+    for r in out["wire_bytes_resnet18_grad"]:
+        print(f"| {r['n_devices']} | {r['none_mb']} | {r['bf16_mb']} | "
+              f"{r['int8_mb']} | {r['int8_r3_allgather_mb']} | "
+              f"{r['int8_vs_bf16']} |")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

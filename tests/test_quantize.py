@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from distributed_parameter_server_for_ml_training_tpu.ops.pallas.quantize import (
     BLOCK_ROWS, LANES, dequantize_int8, quantize_dequantize_int8,
@@ -22,10 +23,21 @@ class TestQuantizeKernel:
         x = jnp.ones((513,), jnp.float32)  # forces padding
         v, s = quantize_int8(x)
         assert v.dtype == jnp.int8 and v.shape[1] == LANES
-        assert v.shape[0] % BLOCK_ROWS == 0
-        assert s.shape == (v.shape[0] // BLOCK_ROWS,)
+        # small inputs stay one 8-row-aligned block (no 32768-element
+        # padding — that would dominate ring-chunk wire bytes)
+        assert v.shape == (8, LANES)
+        assert s.shape == (1,)
         y = dequantize_int8(v, s, (513,))
         assert y.shape == (513,)
+        np.testing.assert_allclose(np.asarray(y), 1.0, rtol=0.01)
+
+    def test_shapes_large_input_tiles_in_blocks(self):
+        n = 3 * BLOCK_ROWS * LANES + 5
+        x = jnp.ones((n,), jnp.float32)
+        v, s = quantize_int8(x)
+        assert v.shape[0] % BLOCK_ROWS == 0
+        assert s.shape == (v.shape[0] // BLOCK_ROWS,)
+        y = dequantize_int8(v, s, (n,))
         np.testing.assert_allclose(np.asarray(y), 1.0, rtol=0.01)
 
     def test_zeros_safe(self):
@@ -46,6 +58,70 @@ class TestQuantizeKernel:
         y = np.asarray(quantize_dequantize_int8(jnp.asarray(x)))
         # block 1 keeps fine resolution
         np.testing.assert_allclose(y[BLOCK_ROWS * LANES:], 0.01, rtol=0.05)
+
+
+class TestInt8Ring:
+    """The quantized reduce-scatter + all-gather ring
+    (parallel/sync_dp._int8_ring_allreduce_mean)."""
+
+    def _ring_outputs(self, n, values):
+        """Run the ring over an n-device mesh; returns [n, S] per-device
+        results (out_specs stacks them) for replica-consistency checks."""
+        from jax.sharding import PartitionSpec as P
+
+        from distributed_parameter_server_for_ml_training_tpu.parallel import make_mesh
+        from distributed_parameter_server_for_ml_training_tpu.parallel.sync_dp import (
+            _int8_ring_allreduce_mean)
+
+        mesh = make_mesh(n)
+
+        def body(vals, key):
+            # vals: [1, S] this device's gradient contribution
+            out = _int8_ring_allreduce_mean(vals[0], "data", n, key[0])
+            return out[None]
+
+        fn = jax.shard_map(body, mesh=mesh,
+                           in_specs=(P("data"), P("data")),
+                           out_specs=P("data"), check_vma=False)
+        keys = jax.random.split(jax.random.PRNGKey(7), n)
+        return np.asarray(fn(values, keys))
+
+    @pytest.mark.parametrize("n", [4, 8])
+    def test_mean_and_replica_consistency(self, devices, n):
+        rng = np.random.default_rng(0)
+        size = 5000  # not divisible by n: exercises chunk padding
+        vals = jnp.asarray(rng.normal(size=(n, size)), jnp.float32)
+        outs = self._ring_outputs(n, vals)
+        true_mean = np.asarray(vals).mean(axis=0)
+        # every replica must hold BIT-IDENTICAL results (the all-gather
+        # phase ships one quantization of each chunk to everyone)
+        for d in range(1, n):
+            np.testing.assert_array_equal(outs[d], outs[0])
+        # and the mean must be close to exact (N-1 requantizations of
+        # running partials + one of the mean)
+        scale = np.abs(true_mean).max() / 127.0
+        np.testing.assert_allclose(outs[0], true_mean,
+                                   atol=(n + 1) * scale, rtol=0.05)
+
+    @pytest.mark.parametrize("n", [4, 8])
+    def test_wire_bytes_below_bf16(self, devices, n):
+        """VERDICT r3 item 2 'done' bar: int8 strictly below bf16 bytes at
+        N>=4, measured from the compiled HLO's collective ops on an
+        isolated gradient-sized all-reduce (no BN/metric psums mixed in);
+        shared harness with experiments/measure_comm_bytes.py."""
+        from distributed_parameter_server_for_ml_training_tpu.utils.hlo_bytes import (
+            sync_grad_mean_bytes)
+
+        size = 2 ** 20          # 1M-element gradient (4 MB fp32)
+        stats = sync_grad_mean_bytes(n, size)
+
+        # pmean must show the expected 2 (N-1)/N x S bytes (sanity of the
+        # HLO parser itself)
+        expect_none = 2 * (n - 1) / n * size * 4
+        assert abs(stats["none"]["total"] - expect_none) < 0.1 * expect_none
+        assert stats["int8"]["total"] < stats["bf16"]["total"], stats
+        # and the ring should be ~half of bf16, not a marginal win
+        assert stats["int8"]["total"] < 0.7 * stats["bf16"]["total"], stats
 
 
 def test_int8_sync_allreduce_trains(devices, tiny_model):
