@@ -63,7 +63,19 @@ def _stale(so: str) -> bool:
         lib = ctypes.CDLL(so)
     except OSError:
         return True
-    return any(not hasattr(lib, sym) for sym in _REQUIRED_SYMBOLS)
+    try:
+        return any(not hasattr(lib, sym) for sym in _REQUIRED_SYMBOLS)
+    finally:
+        # Release the probe handle: dlopen dedups by pathname, so if make
+        # rebuilds the SAME path, a still-open stale mapping would be what
+        # the post-build CDLL returns (ADVICE r3). dlclose only drops a
+        # refcount; the loader unmaps once no handle remains.
+        try:
+            import _ctypes
+
+            _ctypes.dlclose(lib._handle)
+        except (AttributeError, OSError):
+            pass
 
 
 def load_library() -> ctypes.CDLL | None:

@@ -35,21 +35,22 @@ def block_rows_for(rows_padded: int) -> int:
     """Quantization block height for a [rows_padded, 128] view.
 
     Large inputs tile in BLOCK_ROWS blocks; inputs at or below one block
-    are a SINGLE block of their own (8-row-aligned) height — padding a
-    1/N-sized ring chunk up to 32768 elements would otherwise dominate
-    the wire bytes for small models (parallel/sync_dp.py int8 ring).
-    Both quantize and dequantize derive the layout from this rule, so the
-    pair stays consistent without shipping the block size."""
+    are a SINGLE block of their own (32-row-aligned: the int8 native TPU
+    tile is (32, 128)) — padding a 1/N-sized ring chunk up to 32768
+    elements would otherwise dominate the wire bytes for small models
+    (parallel/sync_dp.py int8 ring). Both quantize and dequantize derive
+    the layout from this rule, so the pair stays consistent without
+    shipping the block size."""
     return rows_padded if rows_padded <= BLOCK_ROWS else BLOCK_ROWS
 
 
 def _pad_to_blocks(x: jax.Array) -> tuple[jax.Array, int, int]:
-    """Flatten to [rows, 128]; rows 8-aligned (single block) for small
+    """Flatten to [rows, 128]; rows 32-aligned (single block) for small
     inputs, a BLOCK_ROWS multiple otherwise."""
     n = x.size
     rows = -(-n // LANES)
     if rows <= BLOCK_ROWS:
-        rows_padded = -(-rows // 8) * 8
+        rows_padded = -(-rows // 32) * 32
     else:
         rows_padded = -(-rows // BLOCK_ROWS) * BLOCK_ROWS
     flat = jnp.zeros((rows_padded * LANES,), jnp.float32)

@@ -369,6 +369,14 @@ class AggregationBase(MembershipMixin):
             "learning_rate": self.config.learning_rate,
             "store_backend": self.store_backend,
         }
+        # Sampled device syncs (ps/device_store.py wait_every): each
+        # recorded update_time measured completion of up to wait_every
+        # queued rounds, so it is NOT comparable 1:1 with the per-update
+        # host-backend timings — emit the sampling interval so readers
+        # (and PERF.md tables) can normalize (ADVICE r3).
+        we = getattr(self, "wait_every", 1)
+        if we and we > 1:
+            out["update_time_wait_every"] = int(we)
         if self.config.mode == "async":
             sv = self.stats.staleness_values
             out.update({

@@ -34,18 +34,26 @@ _SHAPE_RE = re.compile(r"(pred|[suf]\d+|bf16)\[([\d,]*)\]")
 _OP_RE = re.compile(
     r"=\s+(.*?)\s*"
     r"(collective-permute|all-reduce|all-gather|reduce-scatter)"
-    r"(?:-start)?\(")
+    r"(-start)?\(")
 
 
-def _shape_bytes(shape_text: str) -> int:
-    total = 0
+def _shape_bytes(shape_text: str, largest_only: bool = False) -> int:
+    """Bytes of all typed shapes in ``shape_text`` (or just the largest).
+
+    ``largest_only`` handles async ``-start`` forms of collective-permute
+    and all-gather, whose result tuple aliases the operand alongside the
+    result buffer — summing both would double-count the wire bytes.
+    """
+    sizes = []
     for dtype, dims in _SHAPE_RE.findall(shape_text):
         n = 1
         for d in dims.split(","):
             if d:
                 n *= int(d)
-        total += n * _DTYPE_BYTES[dtype]
-    return total
+        sizes.append(n * _DTYPE_BYTES[dtype])
+    if not sizes:
+        return 0
+    return max(sizes) if largest_only else sum(sizes)
 
 
 def collective_wire_bytes(hlo_text: str, n_devices: int) -> dict:
@@ -62,8 +70,11 @@ def collective_wire_bytes(hlo_text: str, n_devices: int) -> dict:
         m = _OP_RE.search(line)
         if not m:
             continue
-        shape_text, op = m.group(1), m.group(2)
-        b = _shape_bytes(shape_text)
+        shape_text, op, is_start = m.group(1), m.group(2), bool(m.group(3))
+        b = _shape_bytes(
+            shape_text,
+            largest_only=is_start and op in ("collective-permute",
+                                             "all-gather"))
         if op == "collective-permute":
             moved = b
         elif op == "all-reduce":
@@ -121,6 +132,8 @@ def sync_grad_mean_bytes(n_devices: int, size: int,
         out[name] = collective_wire_bytes(hlo, n_devices)
     if ("bf16" in out and "none" in out
             and out["bf16"]["total"] > 0.9 * out["none"]["total"]):
-        out["bf16"]["total"] = out["none"]["total"] // 2
-        out["bf16"]["widened_on_cpu"] = True
+        total = out["none"]["total"] // 2
+        out["bf16"] = {"total": total, "by_op": {"all-reduce": total},
+                       "count": out["bf16"]["count"],
+                       "widened_on_cpu": True}
     return out

@@ -23,9 +23,9 @@ class TestQuantizeKernel:
         x = jnp.ones((513,), jnp.float32)  # forces padding
         v, s = quantize_int8(x)
         assert v.dtype == jnp.int8 and v.shape[1] == LANES
-        # small inputs stay one 8-row-aligned block (no 32768-element
-        # padding — that would dominate ring-chunk wire bytes)
-        assert v.shape == (8, LANES)
+        # small inputs stay one 32-row-aligned block (int8 native tile;
+        # no 32768-element padding that would dominate ring-chunk bytes)
+        assert v.shape == (32, LANES)
         assert s.shape == (1,)
         y = dequantize_int8(v, s, (513,))
         assert y.shape == (513,)
