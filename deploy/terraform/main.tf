@@ -58,3 +58,16 @@ resource "google_tpu_v2_vm" "pod" {
     purpose = "dps-tpu-training"
   }
 }
+
+# Discovery outputs: analysis/pod_logs.py reads `terraform output -json`
+# to find the pod to ingest METRICS_JSON logs from — the TPU-native
+# mirror of the reference's log-group discovery
+# (parse_cloudwatch_logs.py:34-60 reads its terraform outputs the same
+# way).
+output "pod_name" {
+  value = google_tpu_v2_vm.pod.name
+}
+
+output "pod_zone" {
+  value = google_tpu_v2_vm.pod.zone
+}

@@ -49,10 +49,16 @@ do_create() {
 
 do_train() {
     # --multihost with no coordinator flags: jax.distributed.initialize()
-    # auto-detects the pod topology on TPU VMs.
+    # auto-detects the pod topology on TPU VMs. Each host tees its output
+    # to ~/dps_train.log so `analysis/pod_logs.py` (or
+    # `dps-tpu experiments ingest-pod`) can collect the METRICS_JSON
+    # lines afterwards — the reference's CloudWatch round trip, by ssh.
+    # pipefail INSIDE the remote shell: without it the ssh exit status is
+    # tee's (0) and a crashed training run would look successful.
     gcloud compute tpus tpu-vm ssh "$NAME" --zone "$ZONE" --worker=all \
-        --command 'dps-tpu train --mode sync --multihost --epochs 20 \
-                   --emit-metrics'
+        --command 'set -o pipefail; \
+                   dps-tpu train --mode sync --multihost --epochs 20 \
+                   --emit-metrics 2>&1 | tee ~/dps_train.log'
 }
 
 do_destroy() {

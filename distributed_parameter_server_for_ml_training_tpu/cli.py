@@ -102,6 +102,12 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--pp-tp-degree", type=int, default=1,
                    help="--mode pp: Megatron-split stage params over a "
                         "'model' mesh axis (dp x tp x pp composition)")
+    t.add_argument("--moe-capacity-factor", type=float, default=2.0,
+                   help="--mode moe: per-expert buffer = factor x the "
+                        "even-routing load (Switch capacity factor)")
+    t.add_argument("--moe-aux-weight", type=float, default=0.01,
+                   help="--mode moe: Switch load-balance aux-loss weight "
+                        "(0 disables balancing)")
     t.add_argument("--staleness-bound", type=int,
                    default=_env("STALENESS_BOUND", 5, int))
     t.add_argument("--sync-steps", type=int,
@@ -171,6 +177,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "live membership rides Register/Fetch replies so "
                         "remote workers reshard at epoch boundaries")
     s.add_argument("--worker-timeout", type=float, default=None)
+    s.add_argument("--push-codec", choices=["default", "fp16", "none"],
+                   default="default",
+                   help="wire codec workers apply before push: 'default' "
+                        "= backend's choice (fp16 for python/native, none "
+                        "for device); explicit values override (the wire "
+                        "experiment matrix toggles this)")
     s.add_argument("--store-backend",
                    choices=["python", "native", "device"],
                    default="python",
@@ -191,6 +203,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="'device' keeps store tensors in accelerator HBM "
                         "(zero host<->device traffic per step)")
     e.add_argument("--no-plots", action="store_true")
+    # Pod-log ingestion (analysis/pod_logs.py): one command turns a
+    # `tpu-pod.sh train` run into a reference-schema experiment JSON —
+    # the reference's CloudWatch ETL loop (parse_cloudwatch_logs.py:34-87)
+    # over ssh + terraform-output discovery.
+    e.add_argument("--ingest-pod", action="store_true",
+                   help="collect METRICS_JSON logs from a TPU pod instead "
+                        "of running the local matrix")
+    e.add_argument("--pod-name", help="pod to ingest (else --tf-dir "
+                                      "discovery)")
+    e.add_argument("--pod-zone")
+    e.add_argument("--tf-dir", default="deploy/terraform",
+                   help="terraform dir for pod_name/pod_zone discovery")
+    e.add_argument("--experiment-name", default="pod_run")
+    e.add_argument("--pod-log-path", default="~/dps_train.log")
     add_common(e)
 
     w = sub.add_parser("worker", help="gRPC remote worker")
@@ -276,6 +302,8 @@ def cmd_train(args) -> int:
             pp_microbatches=args.pp_microbatches,
             dp_degree=args.dp_degree,
             pp_tp_degree=args.pp_tp_degree,
+            moe_capacity_factor=args.moe_capacity_factor,
+            moe_aux_weight=args.moe_aux_weight,
             learning_rate=args.lr, num_epochs=args.epochs,
             batch_size=args.batch_size, augment=not args.no_augment,
             num_classes=num_classes, dtype=args.dtype, seed=args.seed)
@@ -339,7 +367,9 @@ def cmd_serve(args) -> int:
                     learning_rate=args.lr,
                     staleness_bound=args.staleness_bound,
                     elastic=args.elastic,
-                    worker_timeout=args.worker_timeout))
+                    worker_timeout=args.worker_timeout,
+                    push_codec=(None if args.push_codec == "default"
+                                else args.push_codec)))
     server, port = serve(store, port=args.port)
     print(f"parameter server up on :{port} "
           f"(mode={args.mode}, workers={args.workers}, "
@@ -396,6 +426,20 @@ def cmd_worker(args) -> int:
 
 
 def cmd_experiments(args) -> int:
+    if args.ingest_pod:
+        from .analysis.pod_logs import ingest_pod
+
+        out = os.path.join(args.out_dir, f"{args.experiment_name}.json")
+        record = ingest_pod(
+            args.experiment_name, name=args.pod_name, zone=args.pod_zone,
+            tf_dir=args.tf_dir,
+            log_path=args.pod_log_path, out_path=out)
+        n_workers = record["worker_metrics_aggregated"].get("num_workers", 0)
+        print(f"ingested {n_workers} worker record(s) + "
+              f"{'server' if record['server_metrics'] else 'no server'} "
+              f"metrics from pod -> {out}", file=sys.stderr)
+        return 0
+
     from .analysis import run_matrix
 
     dataset = _load_dataset(args)

@@ -10,6 +10,18 @@ Reference parity: registration retries 5x with exponential backoff
 (worker.py:215-229); fp16 push compression happens client-side
 (worker.py:264-268) when the server's codec asks for it; channel options
 match worker.py:203-209.
+
+Beyond the reference: the HOT RPCs (Fetch/Push/JobFinished) carry a
+deadline and bounded retry on transient failures (round-4 VERDICT item 7).
+The reference's worker dies on any mid-epoch RPC blip (worker.py:270-311
+has no retry); this framework has elastic membership and heartbeats, so
+surviving blips completes that story — a worker that retries through a
+flicker keeps its slot, and membership updates keep flowing via the
+piggybacked Fetch replies (reshard happens at the next epoch boundary).
+Retried pushes are at-least-once: if the server applied a push whose reply
+was lost, the retry re-stashes the same worker slot (sync: idempotent
+within a round) or re-applies one gradient (async: same effect as one
+extra stale push, bounded by the staleness gate).
 """
 
 from __future__ import annotations
@@ -20,6 +32,14 @@ import grpc
 import numpy as np
 
 from .service import GRPC_OPTIONS, SERVICE_NAME, pack_msg, unpack_msg
+
+#: Transient codes worth retrying; anything else (e.g. INVALID_ARGUMENT,
+#: UNIMPLEMENTED) indicates a real protocol problem and raises immediately.
+RETRYABLE_CODES = frozenset({
+    grpc.StatusCode.UNAVAILABLE,
+    grpc.StatusCode.DEADLINE_EXCEEDED,
+    grpc.StatusCode.RESOURCE_EXHAUSTED,
+})
 
 
 class _RemoteConfig:
@@ -38,9 +58,15 @@ class RemoteStore:
     """Client-side stand-in for ParameterStore over gRPC."""
 
     def __init__(self, address: str = "localhost:8000",
-                 register_retries: int = 5):
+                 register_retries: int = 5,
+                 rpc_timeout: float = 60.0,
+                 rpc_retries: int = 3,
+                 rpc_backoff: float = 0.5):
         self.address = address
         self.register_retries = register_retries
+        self.rpc_timeout = rpc_timeout
+        self.rpc_retries = rpc_retries
+        self.rpc_backoff = rpc_backoff
         self._channel = grpc.insecure_channel(address, options=GRPC_OPTIONS)
         ident = lambda b: b  # noqa: E731
         self._call = {
@@ -60,6 +86,47 @@ class RemoteStore:
         # Register/Fetch replies). Workers fetch at least once per K-step
         # window, so by an epoch boundary this reflects recent churn.
         self._membership: list[int] = []
+        # Wire accounting (the reference logged pickled payload sizes at
+        # the server; here the client counts the payloads of SUCCESSFUL
+        # RPCs — experiments/run_wire_matrix.py turns these into MB/s).
+        # Lock: the heartbeat thread's fetch races the training thread's
+        # push (gRPC releases the GIL), and lost read-modify-writes would
+        # silently undercount.
+        import threading
+
+        self._wire_lock = threading.Lock()
+        self.wire_bytes_out = 0
+        self.wire_bytes_in = 0
+        self.rpc_counts: dict[str, int] = {}
+
+    def _invoke(self, name: str, request: bytes):
+        """Call RPC ``name`` with a deadline, retrying transient failures
+        (RETRYABLE_CODES) up to ``rpc_retries`` times with exponential
+        backoff. Non-transient codes raise immediately."""
+        delay = self.rpc_backoff
+        for attempt in range(self.rpc_retries + 1):
+            try:
+                reply = self._call[name](request, timeout=self.rpc_timeout)
+                with self._wire_lock:
+                    self.wire_bytes_out += len(request)
+                    self.wire_bytes_in += len(reply)
+                    self.rpc_counts[name] = self.rpc_counts.get(name, 0) + 1
+                return reply
+            except grpc.RpcError as e:
+                code = e.code() if callable(getattr(e, "code", None)) else None
+                if attempt >= self.rpc_retries or code not in RETRYABLE_CODES:
+                    raise
+                time.sleep(delay)
+                delay *= 2
+
+    def wire_stats(self) -> dict:
+        """Cumulative client-side wire accounting (bytes + per-RPC counts
+        of successful calls); PSWorker merges this into its METRICS_JSON
+        row."""
+        with self._wire_lock:
+            return {"wire_bytes_out": self.wire_bytes_out,
+                    "wire_bytes_in": self.wire_bytes_in,
+                    "rpc_counts": dict(self.rpc_counts)}
 
     def _note_membership(self, reply_meta: dict) -> None:
         m = reply_meta.get("active_workers")
@@ -100,7 +167,7 @@ class RemoteStore:
               ) -> tuple[dict[str, np.ndarray], int]:
         from .wire import decode_tensor_dict
         meta = {} if worker_id is None else {"worker_id": worker_id}
-        reply = self._call["FetchParameters"](pack_msg(meta))
+        reply = self._invoke("FetchParameters", pack_msg(meta))
         rmeta, payload = unpack_msg(reply)
         self._note_membership(rmeta)
         return decode_tensor_dict(payload), int(rmeta["global_step"])
@@ -109,14 +176,14 @@ class RemoteStore:
         """Encode and send as-is: the caller (PSWorker._push) applies the
         codec, so compressed bytes hit the wire exactly once."""
         from .wire import encode_tensor_dict
-        reply = self._call["PushGradrients"](pack_msg(
+        reply = self._invoke("PushGradrients", pack_msg(
             {"worker_id": worker_id, "fetched_step": fetched_step},
             encode_tensor_dict(gradients)))
         rmeta, _ = unpack_msg(reply)
         return bool(rmeta["accepted"])
 
     def job_finished(self, worker_id: int) -> None:
-        self._call["JobFinished"](pack_msg({"worker_id": worker_id}))
+        self._invoke("JobFinished", pack_msg({"worker_id": worker_id}))
 
     def close(self) -> None:
         self._channel.close()

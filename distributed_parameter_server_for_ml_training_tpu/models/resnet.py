@@ -117,7 +117,14 @@ class ResNet(nn.Module):
     architecture, server.py:43-76) or an ImageNet stem (7x7 stride 2 + 3x3
     maxpool stride 2) for large-resolution configs: without the 4x stem
     downsampling, 224px inputs keep 224x224 feature maps into stage 0 and a
-    batch-128 train step needs ~37 GB of HBM."""
+    batch-128 train step needs ~37 GB of HBM.
+
+    ``s2d_stem`` (with ``imagenet_stem``) computes the SAME function as the
+    7x7/2 stem via a 2x2 space-to-depth transform + 4x4/1 conv (the MLPerf
+    TPU formulation): a 3-channel stride-2 conv tiles terribly onto the
+    128x128 MXU, while the s2d form contracts 4x4x12=192 inputs per output
+    — ``s2d_stem_kernel`` maps 7x7 weights into the exact-equivalent 4x4
+    layout (asserted by tests/test_models.py)."""
 
     stage_sizes: Sequence[int]
     block_cls: type = BasicBlock
@@ -126,11 +133,24 @@ class ResNet(nn.Module):
     dtype: Dtype = jnp.float32
     axis_name: str | None = None
     imagenet_stem: bool = False
+    s2d_stem: bool = False
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
         x = x.astype(self.dtype)
-        if self.imagenet_stem:
+        if self.imagenet_stem and self.s2d_stem:
+            b, h, w, c = x.shape
+            assert h % 2 == 0 and w % 2 == 0, (h, w)
+            xs = x.reshape(b, h // 2, 2, w // 2, 2, c)
+            xs = xs.transpose(0, 1, 3, 2, 4, 5).reshape(
+                b, h // 2, w // 2, 4 * c)
+            # padding (2,1): output row i needs s2d rows i-2..i+1
+            # (derivation at s2d_stem_kernel).
+            x = nn.Conv(self.num_filters, (4, 4), strides=(1, 1),
+                        padding=((2, 1), (2, 1)), use_bias=False,
+                        dtype=self.dtype, param_dtype=jnp.float32,
+                        name="stem_conv_s2d")(xs)
+        elif self.imagenet_stem:
             x = nn.Conv(self.num_filters, (7, 7), strides=(2, 2),
                         padding=((3, 3), (3, 3)), use_bias=False,
                         dtype=self.dtype, param_dtype=jnp.float32,
@@ -162,23 +182,55 @@ class ResNet(nn.Module):
         return x.astype(jnp.float32)
 
 
+def s2d_stem_kernel(w):
+    """Map 7x7/2 stem weights [7,7,C,F] to the exact-equivalent 4x4/1
+    space-to-depth kernel [4,4,4C,F].
+
+    Derivation: o[i,j] = sum_{di,dj in [-3,3]} w[di+3,dj+3] x[2i+di,2j+dj].
+    In 2x2-s2d coordinates x[2i+di] lives at s2d row r with phase pr where
+    2i+di = 2(i+r-2)+pr, i.e. di = 2r+pr-4 for r in 0..3, pr in {0,1} —
+    so the receptive field is 4 s2d rows (i-2..i+1), stride 1, padding
+    (2,1); entries with di outside [-3,3] (r=0, pr=0) are zero. Channel
+    block order matches the model's reshape: (pr*2+pc)*C + ci.
+    """
+    import numpy as np
+
+    w = np.asarray(w)
+    kh, kw, c, f = w.shape
+    assert (kh, kw) == (7, 7), (kh, kw)
+    out = np.zeros((4, 4, 4 * c, f), w.dtype)
+    for r in range(4):
+        for pr in range(2):
+            di = 2 * r + pr - 1          # = (2r + pr - 4) + 3
+            if not 0 <= di < 7:
+                continue
+            for q in range(4):
+                for pc in range(2):
+                    dj = 2 * q + pc - 1
+                    if not 0 <= dj < 7:
+                        continue
+                    blk = (pr * 2 + pc) * c
+                    out[r, q, blk:blk + c, :] = w[di, dj]
+    return out
+
+
 def ResNet18(num_classes: int = 100, dtype: Dtype = jnp.float32,
              axis_name: str | None = None,
-             imagenet_stem: bool = False) -> ResNet:
+             imagenet_stem: bool = False, s2d_stem: bool = False) -> ResNet:
     return ResNet(stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock,
                   num_classes=num_classes, dtype=dtype, axis_name=axis_name,
-                  imagenet_stem=imagenet_stem)
+                  imagenet_stem=imagenet_stem, s2d_stem=s2d_stem)
 
 
 def ResNet50(num_classes: int = 1000, dtype: Dtype = jnp.float32,
              axis_name: str | None = None,
-             imagenet_stem: bool = False) -> ResNet:
+             imagenet_stem: bool = False, s2d_stem: bool = False) -> ResNet:
     """ResNet-50. The CIFAR stem is the default (matching the reference's
     only architecture); pass ``imagenet_stem=True`` for large-resolution
     inputs — the registry does this automatically for image_size >= 96."""
     return ResNet(stage_sizes=(3, 4, 6, 3), block_cls=Bottleneck,
                   num_classes=num_classes, dtype=dtype, axis_name=axis_name,
-                  imagenet_stem=imagenet_stem)
+                  imagenet_stem=imagenet_stem, s2d_stem=s2d_stem)
 
 
 def count_params(params) -> int:

@@ -76,7 +76,12 @@ class SwitchMoEMlp(nn.Module):
         }
         # Batch-major flatten: contiguous token shards line up with batch
         # shards on the same mesh axis (tokens route ACROSS it).
-        y = self.moe_fn(params, x.reshape(b * t, d).astype(jnp.float32))
+        y, stats = self.moe_fn(params, x.reshape(b * t, d).astype(jnp.float32))
+        # Aux loss + routing observability ride the 'intermediates'
+        # collection (one sown entry per MoE layer); train steps built with
+        # moe_aux_weight > 0 collect them (train/steps.py). A no-op when
+        # the collection isn't mutable (eval).
+        self.sow("intermediates", "moe_stats", stats)
         return y.reshape(b, t, d).astype(x.dtype)
 
 

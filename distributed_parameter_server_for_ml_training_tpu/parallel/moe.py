@@ -42,7 +42,16 @@ def init_moe_params(rng, d_model: int, d_hidden: int, n_experts: int):
 def _moe_body(params, tokens, *, axis_name: str, axis_size: int,
               capacity: int):
     """shard_map body. params: router replicated + my expert's slice [1,...].
-    tokens: [n_local, D]. Returns [n_local, D]."""
+    tokens: [n_local, D]. Returns ``([n_local, D], stats)`` where stats are
+    GLOBAL routing statistics (pmean'd over the expert axis, replicated):
+
+    - ``aux_loss``: the Switch load-balance loss E * sum_e f_e * P_e
+      (f_e = fraction of tokens routed to e, hard counts; P_e = mean router
+      probability). Differentiable through P_e; minimized (=1) at uniform
+      routing — trainers weight it into the total loss.
+    - ``load``: [E] f_e, ``importance``: [E] P_e,
+    - ``drop_frac``: fraction of tokens dropped by the capacity limit.
+    """
     n, d = tokens.shape
     e = axis_size
 
@@ -57,6 +66,18 @@ def _moe_body(params, tokens, *, axis_name: str, axis_size: int,
     pos = jnp.cumsum(onehot, axis=0) * onehot - 1                # [n, E]
     pos = jnp.max(pos, axis=1)                                   # [n]
     keep = pos < capacity
+
+    # -- routing stats + Switch auxiliary load-balance loss ------------------
+    load = jax.lax.pmean(jnp.mean(onehot.astype(jnp.float32), axis=0),
+                         axis_name)                              # [E] f_e
+    importance = jax.lax.pmean(jnp.mean(probs, axis=0), axis_name)  # [E] P_e
+    # f_e is constant w.r.t. params (argmax); gradients flow through P_e —
+    # exactly the Switch Transformer formulation (eq. 4).
+    aux_loss = e * jnp.sum(jax.lax.stop_gradient(load) * importance)
+    drop_frac = jax.lax.pmean(
+        1.0 - jnp.mean(keep.astype(jnp.float32)), axis_name)
+    stats = {"aux_loss": aux_loss, "load": load,
+             "importance": importance, "drop_frac": drop_frac}
 
     # -- dispatch [E, C, D] --------------------------------------------------
     safe_pos = jnp.clip(pos, 0, capacity - 1)
@@ -79,13 +100,15 @@ def _moe_body(params, tokens, *, axis_name: str, axis_size: int,
     # -- combine -------------------------------------------------------------
     gathered = back[expert_idx, safe_pos]                        # [n, D]
     mask = (keep.astype(tokens.dtype) * gate.astype(tokens.dtype))[:, None]
-    return gathered * mask
+    return gathered * mask, stats
 
 
 def make_moe_ffn(mesh: Mesh, capacity: int,
                  axis: str = EXPERT_AXIS) -> Callable:
-    """Build ``fn(params, tokens[B, D]) -> [B, D]`` with tokens sharded on
-    the expert axis and experts one-per-slot. Differentiable."""
+    """Build ``fn(params, tokens[B, D]) -> ([B, D], stats)`` with tokens
+    sharded on the expert axis and experts one-per-slot. Differentiable;
+    ``stats`` (replicated) carries the Switch aux loss + routing
+    observability — see ``_moe_body``."""
     axis_size = mesh.shape[axis]
     body = partial(_moe_body, axis_name=axis, axis_size=axis_size,
                    capacity=capacity)
@@ -94,10 +117,12 @@ def make_moe_ffn(mesh: Mesh, capacity: int,
         "w1": P(axis), "b1": P(axis),
         "w2": P(axis), "b2": P(axis),
     }
+    stats_specs = {"aux_loss": P(), "load": P(), "importance": P(),
+                   "drop_frac": P()}
     sharded = jax.shard_map(
         body, mesh=mesh,
         in_specs=(param_specs, P(axis)),
-        out_specs=P(axis),
+        out_specs=(P(axis), stats_specs),
         check_vma=False,
     )
     return jax.jit(sharded)

@@ -14,8 +14,24 @@ checklist). Design:
   transpose of ppermute is the reverse ppermute), so pipelined *training*
   falls out for free — no hand-written backward schedule.
 
-The input batch is replicated; outputs are returned replicated (each
-microbatch's result is psum-broadcast from the last stage).
+Memory (round-4 VERDICT item 5 — the round-3 scheme replicated the FULL
+[M, mb, ...] input AND output on every stage device and stored every
+activation of the unrolled schedule for the backward):
+
+- ``shard_io=True`` (default): inputs and outputs are SHARDED over the
+  microbatch dim along the stage axis — each device holds M/S
+  microbatches. Stage 0 receives each microbatch from its home shard via
+  a single-pair ``ppermute`` at its tick; the last stage ships each
+  finished microbatch to its home shard the same way (replacing the
+  all-replicating final psum). Per-device IO footprint drops S-fold.
+- ``remat=True`` (default): ``stage_fn`` runs under ``jax.checkpoint``,
+  so the backward recomputes intra-stage activations instead of storing
+  S+M-1 ticks' worth — per-device activation memory is O(tick boundary),
+  not O(schedule).
+
+Measured (experiments/measure_pp_memory.py, ViT-B/16 @224 tokens,
+batch 512, 4 stages x 8 microbatches): see
+experiments/results/pp_memory.json.
 """
 
 from __future__ import annotations
@@ -31,13 +47,19 @@ STAGE_AXIS = "stage"
 
 
 def _pipeline_body(stage_params, x_mb, *, stage_fn: Callable,
-                   axis_name: str, axis_size: int):
+                   axis_name: str, axis_size: int, shard_io: bool):
     """shard_map body. stage_params: this stage's [1, ...] param slice.
-    x_mb: [M, mb, ...] microbatches (replicated). Returns [M, mb, ...]
-    outputs (replicated via ONE psum from the last stage at the end)."""
+
+    ``shard_io=False``: x_mb is the full [M, mb, ...] (replicated); returns
+    replicated [M, mb, ...] via one final psum.
+    ``shard_io=True``: x_mb is this device's [M/S, mb, ...] chunk; returns
+    the device's output chunk (microbatch j lives on shard j // (M/S)).
+    """
     s = jax.lax.axis_index(axis_name)
     n_stages = axis_size
-    m = x_mb.shape[0]
+    last = n_stages - 1
+    chunk = x_mb.shape[0]
+    m = chunk * n_stages if shard_io else chunk
     my_params = jax.tree_util.tree_map(lambda p: p[0], stage_params)
 
     perm_fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
@@ -47,9 +69,23 @@ def _pipeline_body(stage_params, x_mb, *, stage_fn: Callable,
     for t in range(n_stages + m - 1):
         mb_idx = t - s  # which microbatch my stage works on this tick
         active = (mb_idx >= 0) & (mb_idx < m)
-        # Stage 0 reads fresh input; later stages use the carried activation.
-        fresh = x_mb[jnp.clip(mb_idx, 0, m - 1)]
+
+        # Stage 0 reads fresh input; later stages use the carried
+        # activation.
+        if not shard_io:
+            fresh = x_mb[jnp.clip(mb_idx, 0, m - 1)]
+        elif t < m:
+            # Microbatch t enters the pipe: its home shard sends its local
+            # slot to stage 0 (single-pair permute; other devices receive
+            # zeros, and the value is read only where s == 0).
+            home = t // chunk
+            send = x_mb[t % chunk]
+            fresh = (send if home == 0
+                     else jax.lax.ppermute(send, axis_name, [(home, 0)]))
+        else:
+            fresh = jnp.zeros_like(carry)  # pipe is draining
         x_in = jnp.where(s == 0, fresh, carry)
+
         # Bubble ticks SKIP the stage compute: ``active`` is a per-device
         # scalar and stage_fn contains no collectives, so lax.cond lowers to
         # a real branch — (S-1)/(S+M-1) of the ticks do no FLOPs instead of
@@ -58,18 +94,25 @@ def _pipeline_body(stage_params, x_mb, *, stage_fn: Callable,
                          lambda x: stage_fn(my_params, x),
                          lambda x: jnp.zeros_like(x), x_in)
 
-        # Stash the last stage's finished microbatch locally; everyone else
-        # contributes zeros and ONE final psum replicates all outputs (the
-        # per-tick broadcast this replaces cost S+M-2 extra collectives).
         out_idx = t - (n_stages - 1)  # static: which microbatch finished
         if 0 <= out_idx < m:
-            is_last = s == n_stages - 1
-            outputs = outputs.at[out_idx].add(
-                jnp.where(is_last, y, jnp.zeros_like(y)))
+            if shard_io:
+                # Ship the finished microbatch from the last stage to its
+                # home shard (one pair); the home stores it locally.
+                oh = out_idx // chunk
+                y_home = (y if oh == last
+                          else jax.lax.ppermute(y, axis_name, [(last, oh)]))
+                outputs = outputs.at[out_idx % chunk].add(
+                    jnp.where(s == oh, y_home, jnp.zeros_like(y_home)))
+            else:
+                outputs = outputs.at[out_idx].add(
+                    jnp.where(s == last, y, jnp.zeros_like(y)))
 
         # Ship activations one stage forward for the next tick.
         carry = jax.lax.ppermute(y, axis_name, perm_fwd)
 
+    if shard_io:
+        return outputs           # each shard holds its own chunk
     return jax.lax.psum(outputs, axis_name)
 
 
@@ -82,12 +125,22 @@ def stack_stage_params(per_stage_params: list) -> jax.Array:
 def make_pipeline_apply(mesh: Mesh, stage_fn: Callable,
                         num_microbatches: int,
                         axis: str = STAGE_AXIS,
-                        data_axis: str | None = None) -> Callable:
+                        data_axis: str | None = None,
+                        shard_io: bool | None = None,
+                        remat: bool = True) -> Callable:
     """Build ``apply(stacked_params, x) -> y`` running the pipeline.
 
     ``stage_fn(params, x) -> y`` is one stage (shapes preserved). ``x`` is
     the full batch [B, ...]; it is split into ``num_microbatches`` equal
     microbatches internally. Differentiable w.r.t. params and x.
+
+    ``shard_io`` shards the microbatch dim over the stage axis; default
+    (None) = on whenever M divides by the stage count, off otherwise
+    (degenerate M < S pipelines). ``remat`` wraps the stage in
+    ``jax.checkpoint`` — default ON (see module docstring for the memory
+    math). shard_io=False, remat=False reproduces the round-3 replicating
+    schedule (the before/after measurement in
+    experiments/measure_pp_memory.py does).
 
     Composition (round-2 VERDICT item 7): with ``data_axis`` set, each
     microbatch additionally shards along that mesh axis — data parallelism
@@ -98,10 +151,18 @@ def make_pipeline_apply(mesh: Mesh, stage_fn: Callable,
     — dp x tp x pp from one shard_map.
     """
     axis_size = mesh.shape[axis]
-    body = partial(_pipeline_body, stage_fn=stage_fn, axis_name=axis,
-                   axis_size=axis_size)
+    if shard_io is None:
+        shard_io = num_microbatches % axis_size == 0
+    elif shard_io and num_microbatches % axis_size:
+        raise ValueError(
+            f"shard_io needs microbatches ({num_microbatches}) divisible "
+            f"by the stage count ({axis_size})")
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+    body = partial(_pipeline_body, stage_fn=fn, axis_name=axis,
+                   axis_size=axis_size, shard_io=shard_io)
     manual = {axis} | ({data_axis} if data_axis else set())
-    x_spec = P(None, data_axis) if data_axis else P()
+    mb_axis = axis if shard_io else None
+    x_spec = P(mb_axis, data_axis)
     sharded = jax.shard_map(
         body, mesh=mesh,
         # params stacked on the stage axis; further (auto-axis) sharding of

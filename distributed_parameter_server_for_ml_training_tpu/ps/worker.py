@@ -61,11 +61,22 @@ class WorkerResult:
     pushes_accepted: int = 0
     pushes_rejected: int = 0
     heartbeats: int = 0
+    # Client-side wire accounting (RemoteStore.wire_stats); empty for
+    # in-process stores, which cross no wire.
+    wire: dict = field(default_factory=dict)
     error: Exception | None = None
 
     def metrics(self, total_workers: int, learning_rate: float,
                 config: WorkerConfig) -> dict:
-        """METRICS_JSON field parity with worker.py:421-434."""
+        """METRICS_JSON field parity with worker.py:421-434 (+ wire
+        accounting when the store is remote)."""
+        if self.wire:
+            return {**self._base_metrics(total_workers, learning_rate,
+                                         config), **self.wire}
+        return self._base_metrics(total_workers, learning_rate, config)
+
+    def _base_metrics(self, total_workers: int, learning_rate: float,
+                      config: WorkerConfig) -> dict:
         return {
             "worker_id": self.worker_id,
             "total_workers": total_workers,
@@ -117,6 +128,10 @@ class PSWorker(threading.Thread):
             self._done.set()
             if self.result.worker_id >= 0:
                 self.store.job_finished(self.result.worker_id)
+            # After JobFinished so the final RPC is counted too.
+            ws = getattr(self.store, "wire_stats", None)
+            if callable(ws):
+                self.result.wire = ws()
 
     def _heartbeat_loop(self, worker_id: int, interval: float) -> None:
         """Liveness ping: periodic fetch (the reference's intended

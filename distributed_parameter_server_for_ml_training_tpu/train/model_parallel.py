@@ -61,6 +61,10 @@ class ModelParallelConfig:
     # over a 'model' axis — mesh (dp, tp, stages), dp x tp x pp in one step.
     dp_degree: int = 1
     pp_tp_degree: int = 1
+    # MoE (moe mode): per-expert buffer = capacity_factor x the
+    # even-routing load; Switch aux-loss weight (0 disables balancing).
+    moe_capacity_factor: float = 2.0
+    moe_aux_weight: float = 0.01
     learning_rate: float = 0.1
     num_epochs: int = 3
     batch_size: int = 128          # GLOBAL batch
@@ -544,25 +548,31 @@ class MoETrainer(_EpochTrainer):
         patch = shape["patch_size"]
         self.tokens = (h // patch) * (w // patch)
         d = shape["hidden_dim"]
-        # Capacity: 2x the even-routing load per expert shard.
+        # Capacity: capacity_factor x the even-routing load per expert
+        # shard (--moe-capacity-factor; Switch Transformer's knob).
         tokens_per_shard = cfg.batch_size * self.tokens // n_exp
-        capacity = max(8, 2 * tokens_per_shard // n_exp)
+        self.capacity = max(
+            8, int(cfg.moe_capacity_factor * tokens_per_shard / n_exp))
 
         dtype = jnp.bfloat16 if cfg.dtype == "bfloat16" else jnp.float32
         self.model = ViT(patch_size=patch, hidden_dim=d,
                          depth=shape["depth"], num_heads=shape["num_heads"],
                          num_classes=cfg.num_classes, dtype=dtype,
                          pool="gap",
-                         moe_fn=make_moe_ffn(self.mesh, capacity=capacity),
+                         moe_fn=make_moe_ffn(self.mesh,
+                                             capacity=self.capacity),
                          moe_experts=n_exp)
         state = create_train_state(self.model, jax.random.PRNGKey(cfg.seed),
                                    server_sgd(cfg.learning_rate),
                                    input_shape=(1, h, w, 3))
         self.state = state.replace(params=self._place_params(state.params))
-        self._step = jax.jit(make_train_step(augment=cfg.augment),
-                             donate_argnums=0)
+        self._step = jax.jit(
+            make_train_step(augment=cfg.augment,
+                            moe_aux_weight=cfg.moe_aux_weight),
+            donate_argnums=0)
         self._eval_step = jax.jit(make_eval_step())
         self._batch_sharding = NamedSharding(self.mesh, P("expert"))
+        self._moe_step_metrics: list[dict] = []
 
     def _place_params(self, params: dict) -> dict:
         """Expert-stacked SwitchMoEMlp leaves (w1/b1/w2/b2 under a 'moe'
@@ -586,16 +596,39 @@ class MoETrainer(_EpochTrainer):
         return f"moe {self.config.model} {self.config.num_workers} experts"
 
     def _extra_metrics(self) -> dict:
-        return {"n_experts": self.config.num_workers}
+        out = {"n_experts": self.config.num_workers,
+               "expert_capacity": self.capacity,
+               "moe_aux_weight": self.config.moe_aux_weight,
+               "moe_capacity_factor": self.config.moe_capacity_factor}
+        hist = [{k: float(v) for k, v in m.items()}
+                for m in self._moe_step_metrics if m]
+        if hist:
+            # Device scalars accumulated per step; float()ed only here so
+            # the train loop never blocks on the metrics stream.
+            last = hist[-1]
+            out.update({
+                "moe_aux_loss": round(last["moe_aux_loss"], 4),
+                "moe_load_imbalance": round(last["moe_load_imbalance"], 3),
+                "moe_drop_frac": round(last["moe_drop_frac"], 4),
+                "moe_load_imbalance_mean": round(float(np.mean(
+                    [m["moe_load_imbalance"] for m in hist])), 3),
+                "moe_drop_frac_mean": round(float(np.mean(
+                    [m["moe_drop_frac"] for m in hist])), 4),
+            })
+        return out
 
     def _after_restore(self) -> None:
         self.state = self.state.replace(
             params=self._place_params(self.state.params))
 
     def _train_batch(self, xb, yb, rng):
-        return self._step(self.state,
-                          jax.device_put(xb, self._batch_sharding),
-                          jax.device_put(yb, self._batch_sharding), rng)
+        state, m = self._step(self.state,
+                              jax.device_put(xb, self._batch_sharding),
+                              jax.device_put(yb, self._batch_sharding), rng)
+        self._moe_step_metrics.append(
+            {k: m[k] for k in ("moe_aux_loss", "moe_load_imbalance",
+                               "moe_drop_frac") if k in m})
+        return state, m
 
     def evaluate(self) -> float:
         cfg = self.config

@@ -43,11 +43,36 @@ def _forward_loss(state: TrainState, params, images, labels):
     return loss, (outputs, mutated.get("batch_stats", {}))
 
 
-def make_train_step(augment: bool = True) -> Callable:
+def collect_moe_stats(intermediates: dict) -> list[dict]:
+    """All ``moe_stats`` entries sown by SwitchMoEMlp layers
+    (models/vit.py), in module-tree order — one dict per MoE layer."""
+    found: list[dict] = []
+
+    def walk(node):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                if k == "moe_stats":
+                    found.extend(v)   # sow stores a tuple of entries
+                else:
+                    walk(v)
+
+    walk(intermediates)
+    return found
+
+
+def make_train_step(augment: bool = True,
+                    moe_aux_weight: float | None = None) -> Callable:
     """Build ``train_step(state, images_u8, labels, rng) -> (state, metrics)``.
 
     ``images_u8`` is the raw uint8 batch; normalization and augmentation
     happen on device inside the compiled program.
+
+    ``moe_aux_weight is not None`` (MoE models): routing stats sown by
+    each SwitchMoEMlp layer are collected — metrics gain ``moe_aux_loss``,
+    ``moe_load_imbalance`` (max/mean expert load) and ``moe_drop_frac`` —
+    and the Switch load-balance loss (mean across layers) is weighted into
+    the training loss. Weight 0.0 keeps the observability with balancing
+    OFF (the recorded contrast runs use it).
     """
 
     def train_step(state: TrainState, images_u8: jax.Array,
@@ -60,14 +85,46 @@ def make_train_step(augment: bool = True) -> Callable:
             images = augment_batch(rng, images)
         images = standardize(images)
 
-        grad_fn = jax.value_and_grad(
-            lambda p: _forward_loss(state, p, images, labels), has_aux=True)
-        (loss, (logits, new_stats)), grads = grad_fn(state.params)
+        if moe_aux_weight is not None:
+            def loss_fn(p):
+                outputs, mutated = state.apply_fn(
+                    _variables(p, state.batch_stats), images, train=True,
+                    mutable=["batch_stats", "intermediates"],
+                )
+                layers = collect_moe_stats(
+                    mutated.get("intermediates", {}))
+                aux = (jnp.mean(jnp.stack([s["aux_loss"] for s in layers]))
+                       if layers else jnp.float32(0.0))
+                ce = cross_entropy_loss(outputs, labels)
+                loss = ce + moe_aux_weight * aux
+                return loss, (outputs,
+                              mutated.get("batch_stats", {}),
+                              {"ce": ce, "aux": aux, "layers": layers})
+            grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+            (loss, (logits, new_stats, moe)), grads = grad_fn(state.params)
+        else:
+            grad_fn = jax.value_and_grad(
+                lambda p: _forward_loss(state, p, images, labels),
+                has_aux=True)
+            (loss, (logits, new_stats)), grads = grad_fn(state.params)
+            moe = None
 
         state = state.apply_gradients(grads=grads)
         state = state.replace(batch_stats=new_stats)
         accuracy = jnp.mean(jnp.argmax(logits, -1) == labels)
-        return state, {"loss": loss, "accuracy": accuracy}
+        metrics = {"loss": loss, "accuracy": accuracy}
+        if moe is not None and moe["layers"]:
+            load = jnp.stack([s["load"] for s in moe["layers"]])  # [L, E]
+            metrics.update({
+                "loss": moe["ce"],            # comparable across modes
+                "moe_aux_loss": moe["aux"],
+                "moe_load_imbalance": jnp.mean(
+                    jnp.max(load, axis=1) / jnp.maximum(
+                        jnp.mean(load, axis=1), 1e-9)),
+                "moe_drop_frac": jnp.mean(jnp.stack(
+                    [s["drop_frac"] for s in moe["layers"]])),
+            })
+        return state, metrics
 
     return train_step
 

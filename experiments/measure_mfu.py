@@ -145,6 +145,17 @@ def main() -> int:
         measure("resnet50_224px_imagenet",
                 ResNet50(num_classes=1000, dtype=bf16, imagenet_stem=True),
                 224, 256, 10, args.trials, num_classes=1000),
+        # Round-4 MFU push: the space-to-depth stem (4x4/1 conv over
+        # 2x2-s2d input, exact-equivalent function — models/resnet.py
+        # s2d_stem_kernel) replaces the MXU-hostile 3-channel 7x7/2 conv.
+        measure("resnet50_224px_imagenet_s2d",
+                ResNet50(num_classes=1000, dtype=bf16, imagenet_stem=True,
+                         s2d_stem=True),
+                224, 256, 10, args.trials, num_classes=1000),
+        measure("resnet50_224px_imagenet_s2d_b512",
+                ResNet50(num_classes=1000, dtype=bf16, imagenet_stem=True,
+                         s2d_stem=True),
+                224, 512, 10, args.trials, num_classes=1000),
     ]
     # Attention-core microbench: dense einsum vs the Pallas flash kernel,
     # fwd+bwd, across sequence lengths — the regime the fused kernel is

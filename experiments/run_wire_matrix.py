@@ -1,0 +1,171 @@
+"""Over-the-wire distributed experiment matrix: serve + worker OS processes.
+
+Round-4 VERDICT item 4: every recorded experiment so far ran the IN-PROCESS
+trainers; the reference's artifacts come from its real deployed topology —
+separate processes, gradients crossing a network (worker.py:270-311). This
+script runs that topology for THIS framework: one `cli serve` process and N
+`cli worker` processes over localhost gRPC, for a matrix of cells:
+
+    mode=async x workers={2,4} x push-codec={fp16,none}
+                x store-backend={python,native}
+
+and records, per cell, wire-level numbers no in-process run can produce:
+pushes/s at the server, client wire MB (out = gradients, in = fetched
+params), MB/s, the fp16-codec byte effect, and the python-vs-native server
+backend — into experiments/results/wire/<cell>.json (reference schema via
+the shared ETL) + wire_summary.json.
+
+Workers run --platform cpu (the chip can't host N independent processes);
+the numbers measure the WIRE + store path, complementing the on-chip
+in-process records in experiments/results/calibrated/.
+
+Run:  python experiments/run_wire_matrix.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+OUT = os.path.join(REPO, "experiments", "results", "wire")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def run_cell(mode: str, n_workers: int, codec: str, backend: str,
+             epochs: int, n_train: int, batch: int) -> dict:
+    from distributed_parameter_server_for_ml_training_tpu.analysis.parse_logs import (
+        parse_experiment)
+
+    name = f"{mode}_{n_workers}w_{codec}_{backend}"
+    port = _free_port()
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               JAX_COMPILATION_CACHE_DIR=os.path.join(REPO, ".jax_cache"))
+    common = [sys.executable, "-m",
+              "distributed_parameter_server_for_ml_training_tpu.cli"]
+    t0 = time.time()
+    server = subprocess.Popen(
+        common + ["serve", "--mode", mode, "--workers", str(n_workers),
+                  "--port", str(port), "--model", "vit_tiny",
+                  "--num-classes", "100", "--image-size", "32",
+                  "--store-backend", backend, "--push-codec", codec,
+                  "--platform", "cpu", "--emit-metrics"],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT)
+    workers = []
+    try:
+        for w in range(n_workers):
+            workers.append(subprocess.Popen(
+                common + ["worker", "--server", f"localhost:{port}",
+                          "--worker-name", f"wire-w{w}",
+                          "--model", "vit_tiny", "--synthetic",
+                          "--num-train", str(n_train),
+                          "--num-test", "64",
+                          "--epochs", str(epochs),
+                          "--batch-size", str(batch),
+                          "--platform", "cpu", "--dtype", "float32",
+                          "--no-augment", "--emit-metrics"],
+                cwd=REPO, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT))
+        texts = []
+        for w in workers:
+            out, _ = w.communicate(timeout=900)
+            texts.append(out.decode(errors="replace"))
+            assert w.returncode == 0, texts[-1][-2000:]
+        s_out, _ = server.communicate(timeout=120)
+        texts.append(s_out.decode(errors="replace"))
+        assert server.returncode == 0, texts[-1][-2000:]
+    finally:
+        for p in [server] + workers:
+            if p.poll() is None:
+                p.kill()
+    wall = time.time() - t0
+
+    record = parse_experiment("\n".join(texts), name)
+    sm = record["server_metrics"]
+    wm = record["raw_worker_metrics"]
+    total_out = sum(w.get("wire_bytes_out", 0) for w in wm)
+    total_in = sum(w.get("wire_bytes_in", 0) for w in wm)
+    train_time = max((w["total_training_time_seconds"] for w in wm),
+                     default=wall)
+    record["wire"] = {
+        "cell_wall_seconds": round(wall, 2),
+        "pushes_per_second": round(
+            sm.get("gradients_processed", 0)
+            / max(sm.get("total_training_time_seconds", wall), 1e-9), 3),
+        "client_mb_out_gradients": round(total_out / 1e6, 3),
+        "client_mb_in_params": round(total_in / 1e6, 3),
+        "client_mb_per_second": round(
+            (total_out + total_in) / 1e6 / max(train_time, 1e-9), 3),
+        "push_codec": codec,
+        "store_backend": backend,
+    }
+    out_path = os.path.join(OUT, f"{name}.json")
+    with open(out_path, "w") as f:
+        json.dump(record, f, indent=2)
+        f.write("\n")
+    print(f"{name}: {record['wire']}", flush=True)
+    return record
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="2-worker cells only")
+    ap.add_argument("--epochs", type=int, default=2)
+    ap.add_argument("--num-train", type=int, default=512)
+    ap.add_argument("--batch-size", type=int, default=64)
+    args = ap.parse_args()
+
+    os.makedirs(OUT, exist_ok=True)
+    from distributed_parameter_server_for_ml_training_tpu.native import (
+        bindings)
+
+    backends = ["python"]
+    if bindings.native_available():
+        backends.append("native")
+    worker_counts = [2] if args.quick else [2, 4]
+
+    cells = []
+    for n in worker_counts:
+        for codec in ("fp16", "none"):
+            for backend in backends:
+                cells.append(run_cell("async", n, codec, backend,
+                                      args.epochs, args.num_train,
+                                      args.batch_size))
+
+    summary = []
+    for rec in cells:
+        summary.append({"cell": rec["experiment_name"], **rec["wire"],
+                        "final_acc": rec["worker_metrics_aggregated"].get(
+                            "average_final_accuracy")})
+    with open(os.path.join(OUT, "wire_summary.json"), "w") as f:
+        json.dump({"cells": summary,
+                   "topology": "1 serve + N worker OS processes, "
+                               "localhost gRPC, --platform cpu"}, f,
+                  indent=2)
+        f.write("\n")
+    print("\n| cell | pushes/s | MB out | MB in | MB/s |")
+    print("|---|---|---|---|---|")
+    for s in summary:
+        print(f"| {s['cell']} | {s['pushes_per_second']} | "
+              f"{s['client_mb_out_gradients']} | "
+              f"{s['client_mb_in_params']} | "
+              f"{s['client_mb_per_second']} |")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

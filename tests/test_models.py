@@ -76,3 +76,36 @@ def test_resnet50_imagenet_stem_via_registry():
     vs32 = m32.init(jax.random.PRNGKey(0), jnp.ones((1, 32, 32, 3)),
                     train=False)
     assert vs32["params"]["stem_conv"]["kernel"].shape[:2] == (3, 3)
+
+
+def test_s2d_stem_exact_equivalence():
+    """The space-to-depth stem (4x4/1 conv on 2x2-s2d input) computes
+    EXACTLY the 7x7/2 stem's function under the s2d_stem_kernel weight
+    mapping — the MLPerf-style MXU-friendly formulation the 224px MFU
+    push uses (experiments/measure_mfu.py)."""
+    import numpy as np
+
+    from distributed_parameter_server_for_ml_training_tpu.models.resnet import (
+        ResNet50, s2d_stem_kernel)
+
+    std = ResNet50(num_classes=10, imagenet_stem=True)
+    s2d = ResNet50(num_classes=10, imagenet_stem=True, s2d_stem=True)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 64, 64, 3)),
+                    jnp.float32)
+    vs = std.init(jax.random.PRNGKey(0), x, train=False)
+    vs2 = s2d.init(jax.random.PRNGKey(0), x, train=False)
+    assert vs2["params"]["stem_conv_s2d"]["kernel"].shape == (4, 4, 12, 64)
+
+    # Transplant: transform the 7x7 stem weights, copy everything else.
+    p2 = dict(vs2["params"])
+    p2["stem_conv_s2d"] = {"kernel": jnp.asarray(
+        s2d_stem_kernel(vs["params"]["stem_conv"]["kernel"]))}
+    for k in vs["params"]:
+        if k != "stem_conv":
+            p2[k] = vs["params"][k]
+    out_std = std.apply({"params": vs["params"],
+                         "batch_stats": vs["batch_stats"]}, x, train=False)
+    out_s2d = s2d.apply({"params": p2,
+                         "batch_stats": vs["batch_stats"]}, x, train=False)
+    np.testing.assert_allclose(np.asarray(out_std), np.asarray(out_s2d),
+                               atol=1e-4, rtol=1e-4)

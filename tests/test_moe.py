@@ -30,10 +30,11 @@ def test_moe_matches_dense_reference(devices, mesh8, params):
     tokens = jnp.asarray(
         np.random.default_rng(1).normal(size=(64, D)), jnp.float32)
     moe = make_moe_ffn(mesh8, capacity=64)
-    out = moe(params, tokens)
+    out, stats = moe(params, tokens)
     ref = dense_reference(params, tokens)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-4, atol=1e-5)
+    assert float(stats["drop_frac"]) == 0.0
 
 
 def test_capacity_drops_tokens(devices, mesh8, params):
@@ -41,12 +42,16 @@ def test_capacity_drops_tokens(devices, mesh8, params):
     tokens produce exactly zero output (the residual carries them)."""
     tokens = jnp.asarray(
         np.random.default_rng(2).normal(size=(64, D)), jnp.float32)
-    out = np.asarray(make_moe_ffn(mesh8, capacity=1)(params, tokens))
+    out, stats = make_moe_ffn(mesh8, capacity=1)(params, tokens)
+    out = np.asarray(out)
     ref = np.asarray(dense_reference(params, tokens))
     zero_rows = np.all(out == 0.0, axis=1)
     assert zero_rows.any()  # something got dropped at capacity 1
     kept = ~zero_rows
     np.testing.assert_allclose(out[kept], ref[kept], rtol=1e-4, atol=1e-5)
+    # drop_frac must agree with the observed zero rows
+    np.testing.assert_allclose(float(stats["drop_frac"]),
+                               zero_rows.mean(), atol=1e-6)
 
 
 def test_moe_gradients_flow(devices, mesh8, params):
@@ -55,7 +60,7 @@ def test_moe_gradients_flow(devices, mesh8, params):
     moe = make_moe_ffn(mesh8, capacity=32)
 
     def loss(params):
-        return jnp.sum(moe(params, tokens) ** 2)
+        return jnp.sum(moe(params, tokens)[0] ** 2)
 
     grads = jax.grad(loss)(params)
     # experts that received tokens get nonzero grads; router always does
@@ -63,6 +68,52 @@ def test_moe_gradients_flow(devices, mesh8, params):
     assert float(jnp.sum(jnp.abs(grads["w1"]))) > 0
     for leaf in jax.tree_util.tree_leaves(grads):
         assert np.isfinite(np.asarray(leaf)).all()
+
+
+def test_routing_stats_and_aux_loss(devices, mesh8, params):
+    """Stats semantics: load/importance sum to 1, aux_loss >= 1 with
+    equality only at perfectly uniform routing, and the aux loss is
+    differentiable w.r.t. the ROUTER (through P_e; f_e is stop-graded)."""
+    tokens = jnp.asarray(
+        np.random.default_rng(5).normal(size=(128, D)), jnp.float32)
+    moe = make_moe_ffn(mesh8, capacity=128)
+    _, stats = moe(params, tokens)
+    np.testing.assert_allclose(float(jnp.sum(stats["load"])), 1.0,
+                               atol=1e-5)
+    np.testing.assert_allclose(float(jnp.sum(stats["importance"])), 1.0,
+                               atol=1e-5)
+    assert float(stats["aux_loss"]) >= 1.0 - 1e-5
+
+    g = jax.grad(lambda p: moe(p, tokens)[1]["aux_loss"])(params)
+    assert float(jnp.sum(jnp.abs(g["router"]))) > 0
+    # expert FFN weights don't feed the router distribution
+    assert float(jnp.sum(jnp.abs(g["w1"]))) == 0.0
+
+
+def test_aux_loss_balances_routing(devices, mesh8):
+    """Minimizing the aux loss alone must drive a skewed router toward
+    uniform load — the mechanism the MoE trainer relies on."""
+    rng = np.random.default_rng(7)
+    params = init_moe_params(jax.random.PRNGKey(1), D, H, E)
+    # skew: bias the router strongly toward expert 0
+    params["router"] = params["router"].at[:, 0].add(2.0)
+    tokens = jnp.asarray(rng.normal(size=(256, D)), jnp.float32)
+    moe = make_moe_ffn(mesh8, capacity=256)
+
+    def imbalance(p):
+        s = moe(p, tokens)[1]
+        return float(jnp.max(s["load"]) / jnp.mean(s["load"])), s
+
+    before, s0 = imbalance(params)
+    grad_fn = jax.jit(jax.grad(lambda p: moe(p, tokens)[1]["aux_loss"]))
+    p = params
+    for _ in range(120):
+        g = grad_fn(p)
+        p = jax.tree_util.tree_map(lambda a, b: a - 2.0 * b, p, g)
+    after, s1 = imbalance(p)
+    assert before > 3.0          # the skew was real
+    assert after < 1.5, (before, after)
+    assert float(s1["aux_loss"]) < float(s0["aux_loss"])
 
 
 def test_load_distribution_counted(devices, mesh8, params):
