@@ -49,9 +49,10 @@ _NEG_INF = -1e30
 # keep the MXU fed); short sequences clamp down so padding stays small.
 MAX_BLOCK = 512
 
-# Fallback when no measured crossover has been recorded (matches the
-# round-3 on-chip table: flash fwd+bwd first sustains >= 1.0x dense at
-# T=2048, experiments/results/mfu.json attention_core_bench).
+# Fallback when no measured crossover has been recorded (conservative:
+# well above the short-sequence regime where dense decisively wins; the
+# measured file usually records a smaller value — 512 on the round-4
+# chip).
 DEFAULT_CROSSOVER_T = 2048
 _CROSSOVER_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                "attn_crossover.json")
@@ -73,7 +74,8 @@ def flash_crossover() -> int:
     Read from ``attn_crossover.json`` next to this module — REGENERATED (not
     hand-coded) by ``experiments/measure_mfu.py``, which times dense vs
     Pallas fwd+bwd across sequence lengths on the attached chip and records
-    the smallest T from which flash sustains >= 1.0x dense. Falls back to
+    the smallest T from which flash sustains >= 0.95x dense (statistical
+    ties break to flash: same wall clock, O(T) memory). Falls back to
     ``DEFAULT_CROSSOVER_T`` when the file is absent.
     """
     try:
@@ -460,19 +462,38 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
     sizes adapt to T (128-tile-rounded, capped at MAX_BLOCK).
 
     ``use_pallas=None`` (the default) dispatches on the MEASURED
-    dense/flash crossover (``flash_preferred``): below it the dense
-    XLA-fused formulation wins (short sequences are dominated by the
-    padding + fusion-barrier overhead of a custom kernel) and is used
-    even on TPU; explicit True/False overrides.
+    dense/flash crossover (``flash_preferred``): below it the dispatch
+    returns the PLAIN dense formulation under native XLA autodiff —
+    short sequences are dominated by the padding + fusion-barrier
+    overhead of a custom kernel, and even the custom-VJP fallback costs
+    ~7% vs letting XLA fuse the backward itself (measured, ViT-B/16
+    @224: 762 vs 822 img/s). Explicit True/False overrides force the
+    Pallas kernels / the custom-VJP fallback (the CPU tests exercise
+    the latter's kernel-identical math).
     """
     b, t, h, d = q.shape
-    if use_pallas is None:
-        use_pallas = flash_preferred(t)
     for name, blk in (("block_q", block_q), ("block_k", block_k)):
         if blk is not None and (blk <= 0 or blk % 128):
             raise ValueError(
                 f"{name}={blk} must be a positive multiple of 128 (TPU "
                 f"tile constraint; defaults via pick_block satisfy it)")
+    if use_pallas is None:
+        if not flash_preferred(t):
+            # EXACTLY models/vit.py:SelfAttention's built-in einsum core
+            # (input-dtype logits, fp32 softmax) so below the crossover
+            # ``attention_fn=flash_attention`` compiles to the same
+            # program as no attention_fn at all. Upcasting (fp32 logits
+            # or fp32 q/k/v) costs 7-10% of the ViT-B/16 @224 step: the
+            # fp32 cotangents push the backward matmuls off the bf16 MXU
+            # rate (measured 740-753 vs 813-823 img/s).
+            scale = 1.0 / np.sqrt(d)
+            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+            if causal:
+                mask = jnp.tril(jnp.ones((t, t), bool))
+                logits = jnp.where(mask[None, None], logits, _NEG_INF)
+            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+            return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
+        use_pallas = True
     # Default blocks: the largest 128-multiple <= MAX_BLOCK that DIVIDES the
     # 128-rounded sequence length — a bare min() would pad e.g. T=768 up to
     # 1024 (1.78x the attention FLOPs); 384 divides it exactly.

@@ -1,0 +1,146 @@
+"""Per-stage time/FLOPs breakdown of ResNet-50 @224 on the attached chip.
+
+Round-4 VERDICT item 6: the 224px ResNet-50 sits near ~27% MFU while the
+other families reach 44-47%. This measures WHERE the step goes: fwd+bwd
+wall time and XLA-counted FLOPs of model PREFIXES (stem, +stage0, ...,
+full), so per-stage deltas give each stage's achieved TF/s — the
+trace-backed ceiling analysis PERF.md records.
+
+Run:  python experiments/analyze_resnet50.py [--batch 256]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from functools import partial
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                                 os.path.join(REPO, ".jax_cache")))
+
+import flax.linen as nn  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+V5E_BF16_PEAK_TFLOPS = 197.0
+STAGE_SIZES = (3, 4, 6, 3)
+REPS = 10  # chained iterations per dispatch (amortizes the axon tunnel)
+
+
+class Prefix(nn.Module):
+    """ResNet-50 prefix: s2d ImageNet stem + the first ``n_stages``
+    bottleneck stages (reuses models/resnet.py blocks)."""
+
+    n_stages: int
+    dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        from distributed_parameter_server_for_ml_training_tpu.models.resnet import (
+            Bottleneck)
+
+        b, h, w, c = x.shape
+        xs = x.astype(self.dtype).reshape(b, h // 2, 2, w // 2, 2, c)
+        xs = xs.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * c)
+        y = nn.Conv(64, (4, 4), strides=(1, 1), padding=((2, 1), (2, 1)),
+                    use_bias=False, dtype=self.dtype,
+                    param_dtype=jnp.float32, name="stem_conv_s2d")(xs)
+        y = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=self.dtype,
+                         param_dtype=jnp.float32, name="stem_bn")(y)
+        y = nn.relu(y)
+        y = nn.max_pool(y, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for stage in range(self.n_stages):
+            for block in range(STAGE_SIZES[stage]):
+                strides = 2 if stage > 0 and block == 0 else 1
+                y = Bottleneck(64 * 2 ** stage, strides=strides,
+                               dtype=self.dtype)(y, train)
+        return y
+
+
+def measure_prefix(n_stages: int, batch: int, trials: int) -> dict:
+    model = Prefix(n_stages=n_stages)
+    x = jnp.asarray(np.random.default_rng(0).normal(
+        size=(batch, 224, 224, 3)), jnp.float32)
+    vs = model.init(jax.random.PRNGKey(0), x[:1], train=False)
+
+    def loss(params, x):
+        y, _ = model.apply({"params": params,
+                            "batch_stats": vs["batch_stats"]}, x,
+                           train=True, mutable=["batch_stats"])
+        return jnp.sum(y.astype(jnp.float32) ** 2) * 1e-6
+
+    grad = jax.grad(loss)
+
+    def chain(params, x):
+        def body(p, _):
+            g = grad(p, x)
+            return jax.tree_util.tree_map(
+                lambda a, b: a - 1e-6 * b.astype(a.dtype), p, g), ()
+        out, _ = jax.lax.scan(body, params, None, length=REPS)
+        return jax.tree_util.tree_reduce(
+            lambda a, b: a + jnp.sum(jnp.abs(b).astype(jnp.float32)), out,
+            0.0)
+
+    jitted = jax.jit(chain)
+    single = jax.jit(grad).lower(vs["params"], x).compile()
+    flops = float(single.cost_analysis().get("flops", 0.0))
+    _ = float(jitted(vs["params"], x))          # compile + warm
+    best = float("inf")
+    for _t in range(trials):
+        t0 = time.perf_counter()
+        _ = float(jitted(vs["params"], x))
+        best = min(best, time.perf_counter() - t0)
+    ms = best / REPS * 1e3
+    return {"prefix_stages": n_stages, "ms_fwd_bwd": round(ms, 2),
+            "gflops": round(flops / 1e9, 1),
+            "tf_per_s": round(flops / (best / REPS) / 1e12, 1),
+            "mfu_pct": round(100 * flops / (best / REPS) / 1e12
+                             / V5E_BF16_PEAK_TFLOPS, 1)}
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=256)
+    ap.add_argument("--trials", type=int, default=3)
+    args = ap.parse_args()
+
+    rows = []
+    for n in range(5):
+        rows.append(measure_prefix(n, args.batch, args.trials))
+        print(rows[-1], flush=True)
+    # per-stage deltas
+    deltas = []
+    for i in range(1, len(rows)):
+        dms = rows[i]["ms_fwd_bwd"] - rows[i - 1]["ms_fwd_bwd"]
+        dfl = rows[i]["gflops"] - rows[i - 1]["gflops"]
+        deltas.append({
+            "stage": i - 1,
+            "ms": round(dms, 2),
+            "gflops": round(dfl, 1),
+            "tf_per_s": round(dfl / max(dms, 1e-9), 1),  # GF/ms == TF/s
+            "mfu_pct": round(100 * (dfl / max(dms, 1e-9))
+                             / V5E_BF16_PEAK_TFLOPS, 1),
+        })
+        print(deltas[-1], flush=True)
+    out = os.path.join(REPO, "experiments", "results",
+                       "resnet50_stage_breakdown.json")
+    with open(out, "w") as f:
+        json.dump({"batch": args.batch, "reps_per_dispatch": REPS,
+                   "prefixes": rows, "stage_deltas": deltas}, f, indent=2)
+        f.write("\n")
+    print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -172,8 +172,12 @@ def main() -> int:
 
     # Per-dispatch tunnel latency (~60-100 ms) would swamp a single
     # attention call, so each timing chains REPS dependent iterations
-    # inside one lax.scan dispatch and divides.
-    REPS = 10
+    # inside one lax.scan dispatch and divides. MEDIAN of ATTN_TRIALS
+    # (not best-of-3): the axon tunnel's latency excursions flipped the
+    # computed crossover between runs (512/2048/4096) when a single fast
+    # or slow outlier decided a point.
+    REPS = 20
+    ATTN_TRIALS = max(5, args.trials)
     attn_rows = []
     for t in (512, 1024, 2048, 4096):
         ks = jax.random.split(jax.random.PRNGKey(0), 3)
@@ -201,12 +205,13 @@ def main() -> int:
             for tag, chain in (("fwd", jax.jit(fwd_chain)),
                                ("fwd_bwd", jax.jit(grad_chain))):
                 _ = float(chain(q, k, v))  # compile + warm
-                best = float("inf")
-                for _i in range(args.trials):
+                times = []
+                for _i in range(ATTN_TRIALS):
                     t0 = _time.perf_counter()
                     _ = float(chain(q, k, v))
-                    best = min(best, _time.perf_counter() - t0)
-                res[f"{label}_{tag}_ms"] = round(best / REPS * 1e3, 2)
+                    times.append(_time.perf_counter() - t0)
+                med = float(np.median(times))
+                res[f"{label}_{tag}_ms"] = round(med / REPS * 1e3, 2)
         res["flash_fwd_speedup"] = round(
             res["dense_fwd_ms"] / res["flash_fwd_ms"], 2)
         res["flash_fwd_bwd_speedup"] = round(
@@ -224,12 +229,14 @@ def main() -> int:
 
     # Encode the measured crossover where flash_attention's auto dispatch
     # reads it (ops/pallas/attn_crossover.json): the smallest tabulated T
-    # from which flash fwd+bwd SUSTAINS >= 1.0x dense. If flash never
-    # sustains a win, dispatch should never pick it — record one past the
-    # largest tabulated length.
+    # from which flash fwd+bwd SUSTAINS >= 0.95x dense. The 0.95 margin
+    # treats statistical ties as flash wins — at a wall-clock tie the
+    # fused kernel is strictly better on memory (no [T, T] score
+    # materialization), and tunnel noise otherwise flips the boundary
+    # point between runs (observed 512 <-> 1024 on a 0.97-vs-1.07 tie).
     xover = None
     for i, r in enumerate(attn_rows):
-        if all(rr["flash_fwd_bwd_speedup"] >= 1.0 for rr in attn_rows[i:]):
+        if all(rr["flash_fwd_bwd_speedup"] >= 0.95 for rr in attn_rows[i:]):
             xover = r["seq_len"]
             break
     if xover is None:
@@ -246,7 +253,8 @@ def main() -> int:
                 "source": "experiments/measure_mfu.py attention_core_bench "
                           "(regenerated by every measure_mfu.py run)",
                 "rule": "smallest tabulated T from which flash fwd+bwd "
-                        "sustains >= 1.0x dense; 2**31 = never wins",
+                        "sustains >= 0.95x dense (ties break to flash: "
+                        "O(T) memory); 2**31 = never wins",
                 "measured_speedups_fwd_bwd": {
                     str(r["seq_len"]): r["flash_fwd_bwd_speedup"]
                     for r in attn_rows},
