@@ -134,6 +134,11 @@ class ResNet(nn.Module):
     axis_name: str | None = None
     imagenet_stem: bool = False
     s2d_stem: bool = False
+    # Truncate after N stages and return the feature map (no pool/head):
+    # profiling prefixes of the REAL architecture
+    # (experiments/analyze_resnet50.py) without duplicating the
+    # stem/stage schedule. None = the full classifier.
+    max_stages: int | None = None
 
     @nn.compact
     def __call__(self, x: jax.Array, train: bool = True) -> jax.Array:
@@ -167,7 +172,9 @@ class ResNet(nn.Module):
         if self.imagenet_stem:
             x = nn.max_pool(x, (3, 3), strides=(2, 2),
                             padding=((1, 1), (1, 1)))
-        for stage, n_blocks in enumerate(self.stage_sizes):
+        stages = (self.stage_sizes if self.max_stages is None
+                  else self.stage_sizes[:self.max_stages])
+        for stage, n_blocks in enumerate(stages):
             for block in range(n_blocks):
                 strides = 2 if stage > 0 and block == 0 else 1
                 x = self.block_cls(
@@ -176,6 +183,8 @@ class ResNet(nn.Module):
                     dtype=self.dtype,
                     axis_name=self.axis_name,
                 )(x, train)
+        if self.max_stages is not None:
+            return x
         x = jnp.mean(x, axis=(1, 2))
         x = nn.Dense(self.num_classes, dtype=self.dtype,
                      param_dtype=jnp.float32, name="head")(x)

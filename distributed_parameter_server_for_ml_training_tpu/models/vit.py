@@ -23,7 +23,6 @@ from typing import Any, Callable
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 Dtype = Any
 
@@ -114,11 +113,8 @@ class SelfAttention(nn.Module):
         if self.attention_fn is not None:
             out = self.attention_fn(q, k, v).reshape(b, t, d)
         else:
-            scale = 1.0 / np.sqrt(head_dim)
-            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-            probs = probs.astype(self.dtype)
-            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(b, t, d)
+            from ..ops.attention import dense_core
+            out = dense_core(q, k, v).reshape(b, t, d)
         return nn.Dense(d, dtype=self.dtype, param_dtype=jnp.float32,
                         name="out")(out)
 

@@ -1,0 +1,41 @@
+"""The framework's ONE dense attention core (input-dtype, MXU-native).
+
+``dense_core`` is the softmax-attention formulation every dense path
+shares: logits in the INPUT dtype (bf16 matmuls stay on the fast MXU
+path — fp32 upcasts cost a measured 7-10% of a ViT-B/16 @224 step),
+softmax in fp32, probabilities cast back. Users:
+
+- models/vit.py:SelfAttention (the default core when no ``attention_fn``),
+- ops/pallas/flash_attention.flash_attention's below-crossover dispatch
+  (so ``attention_fn=flash_attention`` compiles to the IDENTICAL program
+  below the crossover — asserted bitwise by tests/test_flash_attention),
+- experiments/measure_mfu.py's crossover bench dense arm (the baseline
+  the Pallas kernel must beat is the core the dispatch actually runs,
+  not the fp32-upcast test reference in parallel/ring_attention).
+
+Kept dependency-free (jnp only) so models, ops and experiments can all
+import it without cycles.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_NEG_INF = -1e30
+
+
+def dense_core(q: jax.Array, k: jax.Array, v: jax.Array, *,
+               causal: bool = False) -> jax.Array:
+    """[B, T, H, D] x3 -> [B, T, H, D] softmax attention in the input
+    dtype (fp32 softmax)."""
+    d = q.shape[-1]
+    scale = 1.0 / np.sqrt(d)
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        logits = jnp.where(mask[None, None], logits, _NEG_INF)
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)

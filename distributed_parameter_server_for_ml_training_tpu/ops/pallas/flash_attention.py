@@ -479,20 +479,16 @@ def flash_attention(q: jax.Array, k: jax.Array, v: jax.Array, *,
                 f"tile constraint; defaults via pick_block satisfy it)")
     if use_pallas is None:
         if not flash_preferred(t):
-            # EXACTLY models/vit.py:SelfAttention's built-in einsum core
-            # (input-dtype logits, fp32 softmax) so below the crossover
-            # ``attention_fn=flash_attention`` compiles to the same
-            # program as no attention_fn at all. Upcasting (fp32 logits
+            # THE shared dense core (ops/attention.dense_core) — the same
+            # function models/vit.py:SelfAttention runs with no
+            # attention_fn, so below the crossover
+            # ``attention_fn=flash_attention`` compiles to the identical
+            # program (asserted bitwise by tests). Upcasting (fp32 logits
             # or fp32 q/k/v) costs 7-10% of the ViT-B/16 @224 step: the
             # fp32 cotangents push the backward matmuls off the bf16 MXU
             # rate (measured 740-753 vs 813-823 img/s).
-            scale = 1.0 / np.sqrt(d)
-            logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
-            if causal:
-                mask = jnp.tril(jnp.ones((t, t), bool))
-                logits = jnp.where(mask[None, None], logits, _NEG_INF)
-            probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-            return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(q.dtype), v)
+            from ..attention import dense_core
+            return dense_core(q, k, v, causal=causal)
         use_pallas = True
     # Default blocks: the largest 128-multiple <= MAX_BLOCK that DIVIDES the
     # 128-rounded sequence length — a bare min() would pad e.g. T=768 up to

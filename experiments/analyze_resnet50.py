@@ -16,7 +16,6 @@ import json
 import os
 import sys
 import time
-from functools import partial
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
@@ -27,48 +26,25 @@ jax.config.update("jax_compilation_cache_dir",
                   os.environ.get("JAX_COMPILATION_CACHE_DIR",
                                  os.path.join(REPO, ".jax_cache")))
 
-import flax.linen as nn  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 import numpy as np  # noqa: E402
 
 V5E_BF16_PEAK_TFLOPS = 197.0
-STAGE_SIZES = (3, 4, 6, 3)
 REPS = 10  # chained iterations per dispatch (amortizes the axon tunnel)
-
-
-class Prefix(nn.Module):
-    """ResNet-50 prefix: s2d ImageNet stem + the first ``n_stages``
-    bottleneck stages (reuses models/resnet.py blocks)."""
-
-    n_stages: int
-    dtype = jnp.bfloat16
-
-    @nn.compact
-    def __call__(self, x, train: bool = True):
-        from distributed_parameter_server_for_ml_training_tpu.models.resnet import (
-            Bottleneck)
-
-        b, h, w, c = x.shape
-        xs = x.astype(self.dtype).reshape(b, h // 2, 2, w // 2, 2, c)
-        xs = xs.transpose(0, 1, 3, 2, 4, 5).reshape(b, h // 2, w // 2, 4 * c)
-        y = nn.Conv(64, (4, 4), strides=(1, 1), padding=((2, 1), (2, 1)),
-                    use_bias=False, dtype=self.dtype,
-                    param_dtype=jnp.float32, name="stem_conv_s2d")(xs)
-        y = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                         epsilon=1e-5, dtype=self.dtype,
-                         param_dtype=jnp.float32, name="stem_bn")(y)
-        y = nn.relu(y)
-        y = nn.max_pool(y, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
-        for stage in range(self.n_stages):
-            for block in range(STAGE_SIZES[stage]):
-                strides = 2 if stage > 0 and block == 0 else 1
-                y = Bottleneck(64 * 2 ** stage, strides=strides,
-                               dtype=self.dtype)(y, train)
-        return y
+TRIALS_MIN = 5  # median-of-5 minimum: best-of-N lets tunnel excursions
+                # corrupt prefix deltas (same fix as measure_mfu's bench)
 
 
 def measure_prefix(n_stages: int, batch: int, trials: int) -> dict:
-    model = Prefix(n_stages=n_stages)
+    # The REAL registry architecture truncated in place (max_stages) —
+    # not a re-implementation that could drift from models/resnet.py.
+    from distributed_parameter_server_for_ml_training_tpu.models.resnet import (
+        Bottleneck, ResNet)
+
+    model = ResNet(stage_sizes=(3, 4, 6, 3), block_cls=Bottleneck,
+                   num_classes=1000, dtype=jnp.bfloat16,
+                   imagenet_stem=True, s2d_stem=True,
+                   max_stages=n_stages)
     x = jnp.asarray(np.random.default_rng(0).normal(
         size=(batch, 224, 224, 3)), jnp.float32)
     vs = model.init(jax.random.PRNGKey(0), x[:1], train=False)
@@ -95,16 +71,17 @@ def measure_prefix(n_stages: int, batch: int, trials: int) -> dict:
     single = jax.jit(grad).lower(vs["params"], x).compile()
     flops = float(single.cost_analysis().get("flops", 0.0))
     _ = float(jitted(vs["params"], x))          # compile + warm
-    best = float("inf")
-    for _t in range(trials):
+    times = []
+    for _t in range(max(trials, TRIALS_MIN)):
         t0 = time.perf_counter()
         _ = float(jitted(vs["params"], x))
-        best = min(best, time.perf_counter() - t0)
-    ms = best / REPS * 1e3
+        times.append(time.perf_counter() - t0)
+    med = float(np.median(times))
+    ms = med / REPS * 1e3
     return {"prefix_stages": n_stages, "ms_fwd_bwd": round(ms, 2),
             "gflops": round(flops / 1e9, 1),
-            "tf_per_s": round(flops / (best / REPS) / 1e12, 1),
-            "mfu_pct": round(100 * flops / (best / REPS) / 1e12
+            "tf_per_s": round(flops / (med / REPS) / 1e12, 1),
+            "mfu_pct": round(100 * flops / (med / REPS) / 1e12
                              / V5E_BF16_PEAK_TFLOPS, 1)}
 
 

@@ -167,8 +167,12 @@ def main() -> int:
     import jax.numpy as jnp
     import numpy as np
 
-    from distributed_parameter_server_for_ml_training_tpu.parallel.ring_attention import (
-        dense_attention)
+    # The dense arm must be the core the dispatch ACTUALLY falls back to
+    # (input-dtype logits) — benchmarking against the fp32-upcast test
+    # reference (parallel/ring_attention.dense_attention) overstated the
+    # flash speedups by the 7-10% upcast tax and biased the crossover.
+    from distributed_parameter_server_for_ml_training_tpu.ops.attention import (
+        dense_core)
 
     # Per-dispatch tunnel latency (~60-100 ms) would swamp a single
     # attention call, so each timing chains REPS dependent iterations
@@ -184,7 +188,7 @@ def main() -> int:
         q, k, v = (jax.random.normal(kk, (4, t, 8, 64), jnp.bfloat16)
                    for kk in ks)
         res = {"seq_len": t, "reps_per_dispatch": REPS}
-        for label, fn in (("dense", dense_attention),
+        for label, fn in (("dense", dense_core),
                           ("flash", partial(flash_attention,
                                             use_pallas=True))):
             def fwd_chain(q, k, v, fn=fn):

@@ -117,6 +117,26 @@ def test_explicit_block_override_validated():
         flash_attention(q, k, v, block_k=-128)
 
 
+def test_below_crossover_is_bitwise_default_core():
+    """Below the crossover, attention_fn=flash_attention must produce
+    BIT-IDENTICAL outputs to a ViT with no attention_fn — both route
+    through the one shared dense core (ops/attention.dense_core), so
+    dispatch costs nothing where dense wins."""
+    from distributed_parameter_server_for_ml_training_tpu.models.vit import ViT
+
+    kw = dict(patch_size=4, hidden_dim=64, depth=2, num_heads=2,
+              num_classes=10, dtype=jnp.bfloat16)
+    default_vit = ViT(**kw)
+    auto_vit = ViT(**kw, attention_fn=flash_attention)
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 32, 32, 3))
+    params = default_vit.init(jax.random.PRNGKey(1), x, train=False)
+    out_d = jax.jit(lambda p, x: default_vit.apply(p, x, train=False))(
+        params, x)
+    out_a = jax.jit(lambda p, x: auto_vit.apply(p, x, train=False))(
+        params, x)
+    np.testing.assert_array_equal(np.asarray(out_d), np.asarray(out_a))
+
+
 def test_crossover_dispatch(monkeypatch):
     """use_pallas=None dispatches on the MEASURED crossover: dense below,
     Pallas at/above (and never Pallas off-TPU)."""
