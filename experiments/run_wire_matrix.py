@@ -154,7 +154,13 @@ def main() -> int:
     with open(os.path.join(OUT, "wire_summary.json"), "w") as f:
         json.dump({"cells": summary,
                    "topology": "1 serve + N worker OS processes, "
-                               "localhost gRPC, --platform cpu"}, f,
+                               "localhost gRPC, --platform cpu",
+                   "caveat": "single-core host: all worker processes + "
+                             "serve share one CPU, so pushes/s and MB/s "
+                             "carry compile/dispatch convoy overhead "
+                             "(notably the 4w cells); the MB columns are "
+                             "exact wire-payload byte counts from the "
+                             "client-side counters"}, f,
                   indent=2)
         f.write("\n")
     print("\n| cell | pushes/s | MB out | MB in | MB/s |")
