@@ -43,11 +43,14 @@ REFERENCE_IMAGES_PER_SEC = 50_000 / 1037.8  # M1 Mac CPU epoch time
 
 def main() -> None:
     parser = argparse.ArgumentParser()
-    # Defaults from the round-2 sweep (experiments/results/PERF.md):
-    # throughput is flat in batch size (compute-bound at ~46% MFU) but the
-    # longer window amortizes the tunnel's per-dispatch latency further.
+    # Defaults from the round-2 sweep + round-4 window probe
+    # (experiments/results/PERF.md): throughput is flat in batch size
+    # (compute-bound at ~47% MFU; 4096 measured WORSE at 29.9k) but the
+    # longer window keeps amortizing the tunnel's per-dispatch latency —
+    # 80 steps is reproducibly ~+1% over 40 (32292/32311/32322 vs
+    # 31957/31992 img/s across runs).
     parser.add_argument("--batch-size", type=int, default=3072)
-    parser.add_argument("--scan-steps", type=int, default=40,
+    parser.add_argument("--scan-steps", type=int, default=80,
                         help="train steps per device-side scan window")
     parser.add_argument("--trials", type=int, default=5)
     args = parser.parse_args()
