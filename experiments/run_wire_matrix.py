@@ -102,9 +102,15 @@ def run_cell(mode: str, n_workers: int, codec: str, backend: str,
                      default=wall)
     record["wire"] = {
         "cell_wall_seconds": round(wall, 2),
+        # Over the server's whole lifetime — includes worker process
+        # startup + jit compile, which dominate on this single-core host.
         "pushes_per_second": round(
             sm.get("gradients_processed", 0)
             / max(sm.get("total_training_time_seconds", wall), 1e-9), 3),
+        # Over the slowest worker's ACTIVE training window (sum of its
+        # epoch times) — the wire-rate number comparable across hosts.
+        "pushes_per_second_active": round(
+            sm.get("gradients_processed", 0) / max(train_time, 1e-9), 3),
         "client_mb_out_gradients": round(total_out / 1e6, 3),
         "client_mb_in_params": round(total_in / 1e6, 3),
         "client_mb_per_second": round(
