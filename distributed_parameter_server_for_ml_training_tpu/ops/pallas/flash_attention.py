@@ -68,6 +68,14 @@ def _on_tpu() -> bool:
 
 
 @lru_cache(maxsize=1)
+def _crossover_record() -> dict:
+    try:
+        with open(_CROSSOVER_FILE) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
 def flash_crossover() -> int:
     """Measured dense->flash crossover sequence length.
 
@@ -79,10 +87,39 @@ def flash_crossover() -> int:
     ``DEFAULT_CROSSOVER_T`` when the file is absent.
     """
     try:
-        with open(_CROSSOVER_FILE) as f:
-            return int(json.load(f)["crossover_t"])
-    except (OSError, KeyError, ValueError):
+        return int(_crossover_record()["crossover_t"])
+    except (KeyError, ValueError, TypeError):
         return DEFAULT_CROSSOVER_T
+
+
+# The tie threshold shared by the MEASUREMENT side (experiments/
+# measure_mfu.py derives crossover_t as "sustains >= this x dense") and
+# the DISPATCH side (flash_preferred compares the padding-taxed speedup
+# against it). One constant so the two can't drift.
+FLASH_TIE_THRESHOLD = 0.95
+
+
+def _measured_speedup(tp: int) -> float:
+    """Flash fwd+bwd speedup vs dense at PADDED length ``tp``, piecewise-
+    linearly interpolated over the recorded bench table (clamped to its
+    edge values); 1.0 when no table was recorded (or it is malformed —
+    same conservative fallback class as ``flash_crossover``)."""
+    table = _crossover_record().get("measured_speedups_fwd_bwd") or {}
+    try:
+        pts = sorted((int(k), float(v)) for k, v in table.items())
+    except (ValueError, TypeError):
+        pts = []
+    if not pts:
+        return 1.0
+    if tp <= pts[0][0]:
+        return pts[0][1]
+    if tp >= pts[-1][0]:
+        return pts[-1][1]
+    for (t0, s0), (t1, s1) in zip(pts, pts[1:]):
+        if t0 <= tp <= t1:
+            w = (tp - t0) / (t1 - t0)
+            return s0 + w * (s1 - s0)
+    return 1.0
 
 
 def flash_preferred(t: int) -> bool:
@@ -92,8 +129,21 @@ def flash_preferred(t: int) -> bool:
     This is the dispatch predicate ``flash_attention`` (``use_pallas=None``)
     and ``train.model_parallel.SPTrainer`` consult, closing the round-3 gap
     where flash was auto-selected below its measured crossover and LOST to
-    dense (ViT-B/16 @224px, 197 tokens: 28.4% vs 43.8% MFU)."""
-    return _on_tpu() and t >= flash_crossover()
+    dense (ViT-B/16 @224px, 197 tokens: 28.4% vs 43.8% MFU).
+
+    Non-128-multiple lengths pay a PADDING TAX the crossover table (which
+    is measured at clean multiples) doesn't see: the kernel computes the
+    padded length's FLOPs, so its effective speedup is the table value at
+    the padded length times (t/t_padded)^2. Measured reality check
+    (on-chip): T=576 pads to 640 -> flash 0.89x dense despite
+    576 >= crossover 512. The predicate applies that tax and keeps the
+    same >= 0.95 tie-break threshold.
+    """
+    if not _on_tpu() or t < flash_crossover():
+        return False
+    tp = -(-t // 128) * 128
+    return (_measured_speedup(tp) * (t / tp) ** 2
+            >= FLASH_TIE_THRESHOLD)
 
 
 # -- forward ------------------------------------------------------------------

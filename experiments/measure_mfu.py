@@ -207,6 +207,8 @@ def main() -> int:
     # flash speedups by the 7-10% upcast tax and biased the crossover.
     from distributed_parameter_server_for_ml_training_tpu.ops.attention import (
         dense_core)
+    from distributed_parameter_server_for_ml_training_tpu.ops.pallas.flash_attention import (
+        FLASH_TIE_THRESHOLD)
 
     # Per-dispatch tunnel latency (~60-100 ms) would swamp a single
     # attention call, so each timing chains REPS dependent iterations
@@ -274,7 +276,8 @@ def main() -> int:
     # point between runs (observed 512 <-> 1024 on a 0.97-vs-1.07 tie).
     xover = None
     for i, r in enumerate(attn_rows):
-        if all(rr["flash_fwd_bwd_speedup"] >= 0.95 for rr in attn_rows[i:]):
+        if all(rr["flash_fwd_bwd_speedup"] >= FLASH_TIE_THRESHOLD
+               for rr in attn_rows[i:]):
             xover = r["seq_len"]
             break
     if xover is None:

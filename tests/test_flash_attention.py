@@ -152,6 +152,30 @@ def test_crossover_dispatch(monkeypatch):
     assert fa.flash_preferred(4 * xover)
 
 
+def test_dispatch_padding_tax(monkeypatch):
+    """Non-128-multiple lengths pay (t/t_padded)^2 on the kernel's padded
+    FLOPs; the predicate must reject lengths whose taxed speedup falls
+    under the tie threshold even above the crossover (measured on-chip:
+    T=576 -> flash 0.89x dense)."""
+    import distributed_parameter_server_for_ml_training_tpu.ops.pallas.flash_attention as fa
+
+    monkeypatch.setattr(fa, "_on_tpu", lambda: True)
+    monkeypatch.setattr(fa, "_crossover_record", lambda: {
+        "crossover_t": 512,
+        "measured_speedups_fwd_bwd": {"512": 1.02, "1024": 1.04,
+                                      "2048": 1.30, "4096": 1.72}})
+    assert fa.flash_preferred(512)       # clean multiple at the crossover
+    assert fa.flash_preferred(1024)
+    # 576 pads to 640: ~1.02 * (576/640)^2 = 0.83 < 0.95 -> dense
+    assert not fa.flash_preferred(576)
+    # 1056 pads to 1152: ~1.07 * (1056/1152)^2 = 0.90 < 0.95 -> dense
+    assert not fa.flash_preferred(1056)
+    # 2040 pads to 2048: 1.30 * ~0.99 -> flash
+    assert fa.flash_preferred(2040)
+    # interpolation clamps beyond the table
+    assert fa.flash_preferred(8192)
+
+
 @pytest.mark.parametrize("t,causal", [(197, False), (197, True), (300, True)])
 def test_kernels_interpret_mode(t, causal, monkeypatch):
     """The ACTUAL Pallas kernels (loop bounds, SMEM scalars, padding
