@@ -177,12 +177,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "live membership rides Register/Fetch replies so "
                         "remote workers reshard at epoch boundaries")
     s.add_argument("--worker-timeout", type=float, default=None)
-    s.add_argument("--push-codec", choices=["default", "fp16", "none"],
+    s.add_argument("--push-codec",
+                   choices=["default", "fp16", "int8", "none"],
                    default="default",
                    help="wire codec workers apply before push: 'default' "
                         "= backend's choice (fp16 for python/native, none "
-                        "for device); explicit values override (the wire "
-                        "experiment matrix toggles this)")
+                        "for device); int8 (python backend) halves fp16's "
+                        "bytes again; explicit values override")
     s.add_argument("--store-backend",
                    choices=["python", "native", "device"],
                    default="python",

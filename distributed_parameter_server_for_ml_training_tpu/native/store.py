@@ -45,6 +45,11 @@ class NativeParameterStore(MembershipMixin):
         self._push_codec = (self.config.push_codec
                             if self.config.push_codec is not None
                             else "fp16")  # reference default
+        if self._push_codec not in ("none", "fp16"):
+            raise ValueError(
+                f"NativeParameterStore push decode runs in the C++ core "
+                f"(fp16/fp32 kernels only); push_codec="
+                f"{self._push_codec!r} is Python-store only")
         if self.config.fetch_codec != "none":
             raise ValueError(
                 "NativeParameterStore fetches fp32 from the arena; "

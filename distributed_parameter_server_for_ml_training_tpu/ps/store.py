@@ -53,10 +53,12 @@ class StoreConfig:
     total_workers: int = 4
     learning_rate: float = 0.1  # server.py:84, 413
     staleness_bound: int = DEFAULT_STALENESS_BOUND
-    # 'none' | 'fp16' | None = backend default ('fp16' for the wire-crossing
-    # python/native stores, matching the reference's worker-side cast
-    # (worker.py:264-268); 'none' for the device store, which crosses no
-    # wire). Stores resolve the sentinel at construction.
+    # 'none' | 'fp16' | 'int8' | None = backend default ('fp16' for the
+    # wire-crossing python/native stores, matching the reference's
+    # worker-side cast (worker.py:264-268); 'none' for the device store,
+    # which crosses no wire). 'int8' (per-tensor symmetric quantization,
+    # ~half fp16's bytes) decodes on the Python store only. Stores
+    # resolve the sentinel at construction.
     push_codec: str | None = None
     fetch_codec: str = "none"  # reference fetches fp32 (server.py:222)
     strict_rounds: bool = False  # True = corrected double-push semantics
@@ -400,6 +402,9 @@ class ParameterStore(AggregationBase):
         self._push_codec = (self.config.push_codec
                             if self.config.push_codec is not None
                             else "fp16")  # reference default
+        if self._push_codec not in ("none", "fp16", "int8"):
+            raise ValueError(f"push_codec must be none|fp16|int8, got "
+                             f"{self._push_codec!r}")
         self.parameters: dict[str, np.ndarray] = {
             k: np.array(v, np.float32) for k, v in initial_params.items()
         }
@@ -460,6 +465,9 @@ class ParameterStore(AggregationBase):
         """
         if self._push_codec == "fp16":
             gradients = fp16_decompress(gradients)
+        elif self._push_codec == "int8":
+            from ..ops.compression import int8_wire_decompress
+            gradients = int8_wire_decompress(dict(gradients))
         else:
             gradients = {k: np.asarray(v, np.float32)
                          for k, v in gradients.items()}

@@ -270,11 +270,16 @@ class PSWorker(threading.Thread):
         else:
             flat = flatten_params(jax.device_get(grads_tree))
             # Worker-side compression (worker.py:264-268): the store/service
-            # advertises its codec; the cast happens here, once, before the
-            # wire.
-            if getattr(self.store, "push_codec", "none") == "fp16":
+            # advertises its codec; the encode happens here, once, before
+            # the wire (fp16 = the reference's cast; int8 = per-tensor
+            # symmetric quantization at ~half fp16's bytes).
+            codec = getattr(self.store, "push_codec", "none")
+            if codec == "fp16":
                 from ..ops.compression import fp16_compress
                 flat = fp16_compress(flat)
+            elif codec == "int8":
+                from ..ops.compression import int8_wire_compress
+                flat = int8_wire_compress(flat)
         if self.store.push(worker_id, flat, fetched_step):
             self.result.pushes_accepted += 1
         else:

@@ -7,7 +7,7 @@ script runs that topology for THIS framework: one `cli serve` process and N
 `cli worker` processes over localhost gRPC, for a matrix of cells:
 
     mode=async x workers={2,4} x push-codec={fp16,none}
-                x store-backend={python,native}
+                x store-backend={python,native}  (+ int8 x python)
 
 and records, per cell, wire-level numbers no in-process run can produce:
 pushes/s at the server, client wire MB (out = gradients, in = fetched
@@ -151,6 +151,10 @@ def main() -> int:
                 cells.append(run_cell("async", n, codec, backend,
                                       args.epochs, args.num_train,
                                       args.batch_size))
+        # int8 wire codec decodes on the Python store only.
+        cells.append(run_cell("async", n, "int8", "python",
+                              args.epochs, args.num_train,
+                              args.batch_size))
 
     summary = []
     for rec in cells:
