@@ -18,10 +18,13 @@ has no retry); this framework has elastic membership and heartbeats, so
 surviving blips completes that story — a worker that retries through a
 flicker keeps its slot, and membership updates keep flowing via the
 piggybacked Fetch replies (reshard happens at the next epoch boundary).
-Retried pushes are at-least-once: if the server applied a push whose reply
-was lost, the retry re-stashes the same worker slot (sync: idempotent
-within a round) or re-applies one gradient (async: same effect as one
-extra stale push, bounded by the staleness gate).
+Retried pushes are exactly-once: every push carries a unique ``push_token``
+(the request bytes — token included — are packed once and retried
+verbatim), and the server replays the recorded outcome for a token it has
+already seen instead of re-applying the gradient
+(comms/service.py:push_gradrients). Without the token a reply lost AFTER a
+sync round completed would re-stash that gradient into the next round as a
+stale duplicate (round-4 ADVICE finding).
 """
 
 from __future__ import annotations
@@ -98,6 +101,14 @@ class RemoteStore:
         self.wire_bytes_out = 0
         self.wire_bytes_in = 0
         self.rpc_counts: dict[str, int] = {}
+        # Push-dedupe token source: a per-client nonce + counter makes every
+        # push's token unique across client restarts too (a replacement
+        # worker reusing an elastic slot must not collide with its
+        # predecessor's last token).
+        import uuid
+
+        self._push_nonce = uuid.uuid4().hex[:12]
+        self._push_count = 0
 
     def _invoke(self, name: str, request: bytes):
         """Call RPC ``name`` with a deadline, retrying transient failures
@@ -176,8 +187,10 @@ class RemoteStore:
         """Encode and send as-is: the caller (PSWorker._push) applies the
         codec, so compressed bytes hit the wire exactly once."""
         from .wire import encode_tensor_dict
+        self._push_count += 1
         reply = self._invoke("PushGradrients", pack_msg(
-            {"worker_id": worker_id, "fetched_step": fetched_step},
+            {"worker_id": worker_id, "fetched_step": fetched_step,
+             "push_token": f"{self._push_nonce}:{self._push_count}"},
             encode_tensor_dict(gradients)))
         rmeta, _ = unpack_msg(reply)
         return bool(rmeta["accepted"])

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import json
 import struct
+import threading
 from concurrent import futures
 
 import grpc
@@ -58,6 +59,21 @@ class ParameterService:
 
     def __init__(self, store: ParameterStore):
         self.store = store
+        # Push dedupe: the client retries hot RPCs at-least-once
+        # (client.py:_invoke); without this, a push whose reply was lost
+        # AFTER it completed a sync round would be re-stashed into the
+        # NEXT round as a stale duplicate (round-4 ADVICE). The client
+        # stamps every push with a unique token (identical bytes across
+        # retries); a token matching the worker's most recent push is a
+        # retry of work already applied (or still applying: a
+        # DEADLINE_EXCEEDED retry can overtake its original — the retry
+        # then WAITS on the entry's event so the reply reports the
+        # original's true outcome, not a guess). Most-recent-only
+        # suffices: pushes are synchronous per worker, so a retry always
+        # precedes that worker's next distinct push.
+        # wid -> [token, outcome (None while in flight), done event]
+        self._push_seen: dict[int, list] = {}
+        self._push_seen_lock = threading.Lock()
 
     # -- RPC bodies (request bytes -> reply bytes) --------------------------
 
@@ -91,9 +107,40 @@ class ParameterService:
 
     def push_gradrients(self, request: bytes, ctx) -> bytes:
         meta, payload = unpack_msg(request)
+        wid = int(meta["worker_id"])
+        token = meta.get("push_token")
+        if token is not None:
+            with self._push_seen_lock:
+                prev = self._push_seen.get(wid)
+                if prev is not None and prev[0] == token:
+                    dup = prev
+                else:
+                    dup = None
+                    self._push_seen[wid] = [token, None, threading.Event()]
+            if dup is not None:
+                # Retry of a push already seen. If the original is still
+                # in flight, wait for its outcome — answering early with
+                # a fabricated accepted=True would misreport an async
+                # push the staleness gate later rejects.
+                dup[2].wait(timeout=120.0)
+                accepted = bool(dup[1]) if dup[1] is not None else False
+                return pack_msg({
+                    "received": True, "accepted": accepted,
+                    "duplicate": True,
+                    "global_step": self.store.global_step})
         grads = decode_tensor_dict(payload)
-        accepted = self.store.push(int(meta["worker_id"]), grads,
-                                   int(meta["fetched_step"]))
+        accepted = False
+        try:
+            accepted = self.store.push(wid, grads, int(meta["fetched_step"]))
+        finally:
+            # On an exception the event still fires (outcome False) so a
+            # waiting retry is never stranded until its timeout.
+            if token is not None:
+                with self._push_seen_lock:
+                    entry = self._push_seen.get(wid)
+                    if entry is not None and entry[0] == token:
+                        entry[1] = accepted
+                        entry[2].set()
         return pack_msg({"received": True, "accepted": accepted,
                          "global_step": self.store.global_step})
 

@@ -40,7 +40,11 @@ def block_rows_for(rows_padded: int) -> int:
     elements would otherwise dominate the wire bytes for small models
     (parallel/sync_dp.py int8 ring). Both quantize and dequantize derive
     the layout from this rule, so the pair stays consistent without
-    shipping the block size."""
+    shipping the block size. Empty inputs (rows_padded == 0) get the
+    minimum 32-row block so callers' ``rows // br`` stays well-defined
+    (0 blocks) instead of dividing by zero."""
+    if rows_padded == 0:
+        return 32
     return rows_padded if rows_padded <= BLOCK_ROWS else BLOCK_ROWS
 
 
@@ -116,6 +120,8 @@ def quantize_int8(x: jax.Array, seed: jax.Array | int = 0, *,
     The caller keeps ``x.shape`` to reconstruct (dequantize_int8 takes it
     statically).
     """
+    if x.size == 0:  # empty gradients quantize to empty wire payloads
+        return (jnp.zeros((0, LANES), jnp.int8), jnp.zeros((0,), jnp.float32))
     xb, n, rows = _pad_to_blocks(x)
     br = block_rows_for(rows)
     n_blocks = rows // br
@@ -169,6 +175,8 @@ def dequantize_int8(values: jax.Array, scales: jax.Array,
     """Inverse of :func:`quantize_int8`; ``shape`` is the original
     (static) array shape."""
     n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+    if n == 0:
+        return jnp.zeros(shape, jnp.float32)
     rows = values.shape[0]
     br = block_rows_for(rows)
     n_blocks = rows // br

@@ -37,12 +37,18 @@ _OP_RE = re.compile(
     r"(-start)?\(")
 
 
-def _shape_bytes(shape_text: str, largest_only: bool = False) -> int:
+def _shape_bytes(shape_text: str, pick: str = "sum") -> int:
     """Bytes of all typed shapes in ``shape_text`` (or just the largest).
 
-    ``largest_only`` handles async ``-start`` forms of collective-permute
-    and all-gather, whose result tuple aliases the operand alongside the
-    result buffer — summing both would double-count the wire bytes.
+    ``pick`` handles async ``-start`` forms of collectives, whose result
+    tuple aliases the operand alongside the result buffer — summing both
+    would double-count the wire bytes. All four collective kinds can lower
+    to ``-start``/``-done`` pairs on TPU: for collective-permute /
+    all-gather / all-reduce the RESULT is the largest member
+    (``pick='largest'``); for reduce-scatter the result is 1/N of the
+    operand, so the result is the SMALLEST member (``pick='smallest'``) —
+    the (N-1) ring factor in :func:`collective_wire_bytes` is calibrated
+    for result bytes.
     """
     sizes = []
     for dtype, dims in _SHAPE_RE.findall(shape_text):
@@ -53,7 +59,11 @@ def _shape_bytes(shape_text: str, largest_only: bool = False) -> int:
         sizes.append(n * _DTYPE_BYTES[dtype])
     if not sizes:
         return 0
-    return max(sizes) if largest_only else sum(sizes)
+    if pick == "largest":
+        return max(sizes)
+    if pick == "smallest":
+        return min(sizes)
+    return sum(sizes)
 
 
 def collective_wire_bytes(hlo_text: str, n_devices: int) -> dict:
@@ -71,10 +81,11 @@ def collective_wire_bytes(hlo_text: str, n_devices: int) -> dict:
         if not m:
             continue
         shape_text, op, is_start = m.group(1), m.group(2), bool(m.group(3))
-        b = _shape_bytes(
-            shape_text,
-            largest_only=is_start and op in ("collective-permute",
-                                             "all-gather"))
+        if is_start:
+            pick = "smallest" if op == "reduce-scatter" else "largest"
+        else:
+            pick = "sum"
+        b = _shape_bytes(shape_text, pick=pick)
         if op == "collective-permute":
             moved = b
         elif op == "all-reduce":

@@ -306,6 +306,65 @@ class TestGrpcService:
         finally:
             server.stop(grace=None)
 
+    def test_push_retry_dedupe_sync_round(self):
+        """Round-4 ADVICE: a push retry whose ORIGINAL completed a sync
+        round must NOT be re-stashed into the next round. The client packs
+        the request (push_token included) once and retries verbatim, so
+        replaying the same bytes is exactly the retry case."""
+        from distributed_parameter_server_for_ml_training_tpu.comms.service \
+            import ParameterService
+        from distributed_parameter_server_for_ml_training_tpu.comms.wire \
+            import encode_tensor_dict
+
+        store = ParameterStore({"w": np.ones(4, np.float32)}, StoreConfig(
+            mode="sync", total_workers=1, push_codec="none"))
+        store.register_worker()
+        svc = ParameterService(store)
+        request = pack_msg(
+            {"worker_id": 0, "fetched_step": 0, "push_token": "nonce:1"},
+            encode_tensor_dict({"w": np.full(4, 0.5, np.float32)}))
+
+        meta1, _ = unpack_msg(svc.push_gradrients(request, None))
+        assert meta1["accepted"] and store.global_step == 1
+        w_after_round = store.parameters["w"].copy()
+
+        # The retry: same bytes. Without dedupe this would stash a stale
+        # gradient into round 2 and (total_workers=1) immediately apply it.
+        meta2, _ = unpack_msg(svc.push_gradrients(request, None))
+        assert meta2["accepted"] and meta2.get("duplicate") is True
+        assert store.global_step == 1
+        np.testing.assert_array_equal(store.parameters["w"], w_after_round)
+
+        # A genuinely new push (fresh token) still applies.
+        request3 = pack_msg(
+            {"worker_id": 0, "fetched_step": 1, "push_token": "nonce:2"},
+            encode_tensor_dict({"w": np.full(4, 0.5, np.float32)}))
+        meta3, _ = unpack_msg(svc.push_gradrients(request3, None))
+        assert meta3["accepted"] and not meta3.get("duplicate")
+        assert store.global_step == 2
+
+    def test_push_retry_dedupe_async(self):
+        """Async twin: a duplicate token replays the recorded outcome
+        instead of applying one extra (stale) gradient."""
+        from distributed_parameter_server_for_ml_training_tpu.comms.service \
+            import ParameterService
+        from distributed_parameter_server_for_ml_training_tpu.comms.wire \
+            import encode_tensor_dict
+
+        store = ParameterStore({"w": np.ones(4, np.float32)}, StoreConfig(
+            mode="async", total_workers=2, push_codec="none"))
+        store.register_worker()
+        svc = ParameterService(store)
+        request = pack_msg(
+            {"worker_id": 0, "fetched_step": 0, "push_token": "n:1"},
+            encode_tensor_dict({"w": np.full(4, 0.5, np.float32)}))
+        svc.push_gradrients(request, None)
+        assert store.stats.gradients_processed == 1
+        meta, _ = unpack_msg(svc.push_gradrients(request, None))
+        assert meta.get("duplicate") is True
+        assert store.stats.gradients_processed == 1
+        assert store.global_step == 1
+
     def test_rpc_retry_gives_up_on_non_transient(self):
         """A non-retryable code raises immediately (no masking of real
         protocol errors)."""

@@ -45,6 +45,14 @@ class TestQuantizeKernel:
         y = quantize_dequantize_int8(x)
         np.testing.assert_array_equal(np.asarray(y), 0.0)
 
+    def test_empty_input_roundtrips(self):
+        # Round-4 ADVICE: rows=0 divided by block_rows_for(0)=0.
+        v, s = quantize_int8(jnp.zeros((0,), jnp.float32))
+        assert v.shape == (0, 128) and s.shape == (0,)
+        y = dequantize_int8(v, s, (0,))
+        assert y.shape == (0,)
+        assert quantize_dequantize_int8(jnp.zeros((0, 3))).shape == (0, 3)
+
     def test_preserves_extremes(self):
         x = jnp.asarray([127.0, -127.0, 0.0, 1.0], jnp.float32)
         y = np.asarray(quantize_dequantize_int8(x))
@@ -102,6 +110,35 @@ class TestInt8Ring:
         scale = np.abs(true_mean).max() / 127.0
         np.testing.assert_allclose(outs[0], true_mean,
                                    atol=(n + 1) * scale, rtol=0.05)
+
+    def test_async_start_forms_counted_once(self):
+        """Round-4 ADVICE: every '-start' op returns an (operand, result)
+        tuple; bytes must come from the RESULT buffer only — the largest
+        member for all-reduce/all-gather/permute, the SMALLEST for
+        reduce-scatter (its result is 1/N of the operand)."""
+        from distributed_parameter_server_for_ml_training_tpu.utils.hlo_bytes \
+            import collective_wire_bytes
+
+        n = 4
+        hlo = "\n".join([
+            # sync forms: result shape only
+            "  x = f32[1024] all-reduce(f32[1024] a), replica_groups={}",
+            "  y = f32[256] reduce-scatter(f32[1024] a), dimensions={0}",
+            # async forms: (operand, result) tuples
+            "  ars = (f32[1024], f32[1024]) all-reduce-start(f32[1024] a)",
+            "  rss = (f32[1024], f32[256]) reduce-scatter-start(f32[1024] a)",
+            "  ags = (f32[256], f32[1024]) all-gather-start(f32[256] a)",
+            "  cps = (f32[512], f32[512]) collective-permute-start(f32[512] a)",
+        ])
+        out = collective_wire_bytes(hlo, n)
+        frac = (n - 1) / n
+        # sync all-reduce == async all-reduce (same 1024-elem result)
+        assert out["by_op"]["all-reduce"] == 2 * int(2 * frac * 1024 * 4)
+        assert out["count"]["all-reduce"] == 2
+        # sync rs == async rs: (N-1) x 256-elem result each
+        assert out["by_op"]["reduce-scatter"] == 2 * (n - 1) * 256 * 4
+        assert out["by_op"]["all-gather"] == int(frac * 1024 * 4)
+        assert out["by_op"]["collective-permute"] == 512 * 4
 
     @pytest.mark.parametrize("n", [4, 8])
     def test_wire_bytes_below_bf16(self, devices, n):
