@@ -182,8 +182,15 @@ def build_parser() -> argparse.ArgumentParser:
                    default="default",
                    help="wire codec workers apply before push: 'default' "
                         "= backend's choice (fp16 for python/native, none "
-                        "for device); int8 (python backend) halves fp16's "
-                        "bytes again; explicit values override")
+                        "for device); int8 (python + native backends) "
+                        "halves fp16's bytes again; explicit values "
+                        "override")
+    s.add_argument("--fetch-codec", choices=["none", "bf16", "fp16"],
+                   default="none",
+                   help="wire codec for FETCHED parameters (default none = "
+                        "reference parity: fp32 fetches, its dominant wire "
+                        "term, server.py:222). bf16/fp16 halve params-in "
+                        "bytes; clients decompress after fetch")
     s.add_argument("--store-backend",
                    choices=["python", "native", "device"],
                    default="python",
@@ -370,7 +377,8 @@ def cmd_serve(args) -> int:
                     elastic=args.elastic,
                     worker_timeout=args.worker_timeout,
                     push_codec=(None if args.push_codec == "default"
-                                else args.push_codec)))
+                                else args.push_codec),
+                    fetch_codec=args.fetch_codec))
     server, port = serve(store, port=args.port)
     print(f"parameter server up on :{port} "
           f"(mode={args.mode}, workers={args.workers}, "

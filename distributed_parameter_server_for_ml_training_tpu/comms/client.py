@@ -60,6 +60,11 @@ class _RemoteConfig:
 class RemoteStore:
     """Client-side stand-in for ParameterStore over gRPC."""
 
+    #: fetch() returns fp32 regardless of the server's fetch codec — the
+    #: decompress happens HERE (client side); PSWorker._fetch_params checks
+    #: this to avoid a second full-parameter cast per fetch.
+    decompresses_fetches = True
+
     def __init__(self, address: str = "localhost:8000",
                  register_retries: int = 5,
                  rpc_timeout: float = 60.0,
@@ -181,7 +186,19 @@ class RemoteStore:
         reply = self._invoke("FetchParameters", pack_msg(meta))
         rmeta, payload = unpack_msg(reply)
         self._note_membership(rmeta)
-        return decode_tensor_dict(payload), int(rmeta["global_step"])
+        params = decode_tensor_dict(payload)
+        if self.fetch_codec == "fp16":
+            # serve --fetch-codec: the server halves the params-in wire
+            # term (the reference's dominant cost, server.py:222); restore
+            # fp32 here so callers never see compressed dtypes. Wire
+            # accounting above already counted the COMPRESSED reply.
+            # (PSWorker sees decompresses_fetches and does NOT cast again.)
+            from ..ops.compression import fp16_decompress
+            params = fp16_decompress(params)
+        elif self.fetch_codec == "bf16":
+            from ..ops.compression import bf16_decompress
+            params = bf16_decompress(params)
+        return params, int(rmeta["global_step"])
 
     def push(self, worker_id: int, gradients: dict, fetched_step: int) -> bool:
         """Encode and send as-is: the caller (PSWorker._push) applies the

@@ -122,10 +122,17 @@ class ParameterService:
                 # in flight, wait for its outcome — answering early with
                 # a fabricated accepted=True would misreport an async
                 # push the staleness gate later rejects.
-                dup[2].wait(timeout=120.0)
-                accepted = bool(dup[1]) if dup[1] is not None else False
+                finished = dup[2].wait(timeout=120.0)
+                if not finished and dup[1] is None:
+                    # Original STILL running after the wait: don't invent
+                    # an outcome in either direction — fail retryably so
+                    # the client's next attempt re-checks.
+                    if ctx is not None:
+                        ctx.abort(grpc.StatusCode.UNAVAILABLE,
+                                  "push still in flight; retry")
+                    raise TimeoutError("push still in flight")
                 return pack_msg({
-                    "received": True, "accepted": accepted,
+                    "received": True, "accepted": bool(dup[1]),
                     "duplicate": True,
                     "global_step": self.store.global_step})
         grads = decode_tensor_dict(payload)

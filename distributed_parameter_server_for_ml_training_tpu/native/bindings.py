@@ -47,8 +47,8 @@ _REQUIRED_SYMBOLS = (
     "dps_fp32_to_fp16", "dps_fp16_to_fp32",
     "dps_store_create", "dps_store_destroy", "dps_store_step",
     "dps_store_rejected", "dps_store_fetch", "dps_store_load",
-    "dps_store_push_fp16", "dps_store_push_fp32",
-    "dps_store_stash_fp16", "dps_store_stash_fp32",
+    "dps_store_push_fp16", "dps_store_push_fp32", "dps_store_push_int8",
+    "dps_store_stash_fp16", "dps_store_stash_fp32", "dps_store_stash_int8",
     "dps_store_apply_mean", "dps_store_free_slot",
 )
 
@@ -113,8 +113,14 @@ def load_library() -> ctypes.CDLL | None:
         lib.dps_store_push_fp32.argtypes = [ctypes.c_void_p, f32p, i64, i64]
         lib.dps_store_push_fp32.restype = i64
         i64p = ctypes.POINTER(i64)
+        i8p = ctypes.POINTER(ctypes.c_int8)
+        lib.dps_store_push_int8.argtypes = [
+            ctypes.c_void_p, i8p, f32p, i64p, i64, i64, i64]
+        lib.dps_store_push_int8.restype = i64
         lib.dps_store_stash_fp16.argtypes = [ctypes.c_void_p, i64, u16p]
         lib.dps_store_stash_fp32.argtypes = [ctypes.c_void_p, i64, f32p]
+        lib.dps_store_stash_int8.argtypes = [
+            ctypes.c_void_p, i64, i8p, f32p, i64p, i64]
         lib.dps_store_apply_mean.argtypes = [ctypes.c_void_p, i64p, i64]
         lib.dps_store_apply_mean.restype = i64
         lib.dps_store_free_slot.argtypes = [ctypes.c_void_p, i64]
@@ -136,6 +142,10 @@ def _i64p(a: np.ndarray):
 
 def _u16p(a: np.ndarray):
     return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint16))
+
+
+def _i8p(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_int8))
 
 
 def fp32_to_fp16(src: np.ndarray) -> np.ndarray:

@@ -7,9 +7,11 @@ interchangeably. The arena layout (one flat float buffer + a name->slice
 index) is what lets C++ do the whole push in one multithreaded pass.
 
 Both modes run native bulk passes: async pushes are a fused
-fp16-decode + staleness-weighted SGD (server.py:171-186 semantics in
-ps_core.cpp); sync rounds stash each worker's gradients into a C++ slot
-buffer and complete with one fused mean+apply pass (server.py:264-288 +
+decode + staleness-weighted SGD (server.py:171-186 semantics in
+ps_core.cpp) with fp32/fp16/int8 codecs — the int8 kernel dequantizes
+per-tensor symmetric scales segment-wise in the same single pass; sync
+rounds stash each worker's gradients into a C++ slot buffer (same three
+codecs) and complete with one fused mean+apply pass (server.py:264-288 +
 145-169 + 126-143). Round ORCHESTRATION (locks, counts, elastic targets,
 quirk-3 double-push semantics) stays in Python, mirroring
 :class:`~..ps.store.AggregationBase`.
@@ -28,8 +30,9 @@ from typing import Mapping
 import numpy as np
 
 
+from ..ops.compression import _SCALE_SUFFIX
 from ..ps.store import MembershipMixin, StoreConfig, _Stats
-from .bindings import _f32p, _i64p, _u16p, load_library
+from .bindings import _f32p, _i8p, _i64p, _u16p, load_library
 
 
 class NativeParameterStore(MembershipMixin):
@@ -45,15 +48,13 @@ class NativeParameterStore(MembershipMixin):
         self._push_codec = (self.config.push_codec
                             if self.config.push_codec is not None
                             else "fp16")  # reference default
-        if self._push_codec not in ("none", "fp16"):
+        if self._push_codec not in ("none", "fp16", "int8"):
             raise ValueError(
-                f"NativeParameterStore push decode runs in the C++ core "
-                f"(fp16/fp32 kernels only); push_codec="
-                f"{self._push_codec!r} is Python-store only")
-        if self.config.fetch_codec != "none":
-            raise ValueError(
-                "NativeParameterStore fetches fp32 from the arena; "
-                "fetch_codec compression is Python-store only")
+                f"push_codec must be none|fp16|int8, got "
+                f"{self._push_codec!r}")
+        if self.config.fetch_codec not in ("none", "fp16", "bf16"):
+            raise ValueError(f"fetch_codec must be none|fp16|bf16, got "
+                             f"{self.config.fetch_codec!r}")
         lib = load_library()
         if lib is None:
             raise RuntimeError("native library unavailable; build native/ "
@@ -68,6 +69,13 @@ class NativeParameterStore(MembershipMixin):
             self._index[name] = (offset, arr.shape)
             offset += arr.size
         self._size = offset
+        # Per-tensor segment boundaries in index (= arena) order, for the
+        # int8 kernels' per-tensor scales (ps_core.cpp segment walk).
+        self._names = list(self._index)
+        self._offsets = np.fromiter(
+            (self._index[n][0] for n in self._names), np.int64,
+            count=len(self._names))
+        self._offsets = np.append(self._offsets, np.int64(self._size))
         arena = np.empty(self._size, np.float32)
         for name, arr in initial_params.items():
             off, shape = self._index[name]
@@ -103,7 +111,7 @@ class NativeParameterStore(MembershipMixin):
 
     @property
     def fetch_codec(self) -> str:
-        return "none"  # the arena always fetches fp32
+        return self.config.fetch_codec
 
     @property
     def global_step(self) -> int:
@@ -134,6 +142,14 @@ class NativeParameterStore(MembershipMixin):
         flat, step = self._fetch_flat()
         if worker_id is not None:
             self.last_seen[worker_id] = time.time()
+        codec = self.config.fetch_codec
+        if codec == "fp16":
+            # C++ multithreaded cast over the whole arena, then slice views.
+            from .bindings import fp32_to_fp16
+            flat = fp32_to_fp16(flat)
+        elif codec == "bf16":
+            import ml_dtypes
+            flat = flat.astype(ml_dtypes.bfloat16)
         return self._unpack(flat), step
 
     # -- checkpoint surface (same contract as AggregationBase.snapshot) ------
@@ -161,24 +177,84 @@ class NativeParameterStore(MembershipMixin):
             flat[off:off + n] = g.reshape(-1)
         return flat
 
+    def _pack_int8(self, gradients: Mapping[str, np.ndarray]
+                   ) -> tuple[np.ndarray, np.ndarray] | None:
+        """(int8 arena-ordered values, per-tensor fp32 scales) from an
+        int8-wire payload ({name: int8, name::int8scale: fp32[1]},
+        ops/compression.py). Returns None for an uncompressed payload
+        (in-process pushes may skip the wire codec; like the Python
+        store's decompressor, fp32 passes through — via the fp32 kernel).
+        """
+        if not any(isinstance(v, np.ndarray) and v.dtype == np.int8
+                   for v in gradients.values()):
+            return None
+        flat = np.empty(self._size, np.int8)
+        scales = np.empty(len(self._names), np.float32)
+        for t, name in enumerate(self._names):
+            g = np.ascontiguousarray(gradients[name])
+            if g.dtype != np.int8:
+                raise ValueError(f"mixed int8 payload: {name} is {g.dtype}")
+            scale = gradients.get(name + _SCALE_SUFFIX)
+            if scale is None:
+                raise ValueError(f"int8 wire entry {name!r} missing its "
+                                 f"{_SCALE_SUFFIX} companion")
+            off, seg_end = int(self._offsets[t]), int(self._offsets[t + 1])
+            if g.size != seg_end - off:
+                # Must reject BEFORE the kernel: a short tensor would leave
+                # np.empty garbage in its segment and a long one would
+                # bleed into the next (the Python store's shape check,
+                # ps/store.py, is this guard's twin).
+                raise ValueError(
+                    f"push size mismatch for {name}: got {g.size} elements,"
+                    f" server segment holds {seg_end - off} (model/dataset "
+                    f"mismatch?)")
+            flat[off:seg_end] = g.reshape(-1)
+            scales[t] = np.float32(np.asarray(scale).reshape(-1)[0])
+        return flat, scales
+
+    def _pack_push(self, gradients: Mapping[str, np.ndarray]) -> tuple:
+        """Compact a push payload into arena order: ('int8', values, scales)
+        or ('fp16'|'fp32', flat). Raises ValueError/KeyError on malformed
+        payloads (wrong sizes, missing tensors/scales) — callers reject."""
+        if self._push_codec == "int8":
+            packed = self._pack_int8(gradients)
+            if packed is not None:
+                return ("int8",) + packed
+        if self._push_codec == "fp16":
+            return ("fp16", self._pack(gradients, np.float16))
+        return ("fp32", self._pack(gradients, np.float32))
+
     def push(self, worker_id: int, gradients: Mapping[str, np.ndarray],
              fetched_step: int) -> bool:
         self.last_seen[worker_id] = time.time()
+        try:
+            # Pack OUTSIDE any lock (pure host compaction) — and reject
+            # malformed payloads up front, like the Python store's shape
+            # check: the C++ kernels must never see a mis-sized buffer.
+            packed = self._pack_push(gradients)
+        except (ValueError, KeyError) as e:
+            self.stats.gradients_rejected += 1
+            print(f"rejecting push from worker {worker_id}: {e}")
+            return False
         if self.config.mode == "sync":
-            self._push_sync(worker_id, gradients)
+            self._push_sync(worker_id, packed)
             return True
         t0 = time.time()
         bound = int(self.config.staleness_bound)
         before = self.global_step
-        if self._push_codec == "fp16":
-            flat = self._pack(gradients, np.float16)
+        if packed[0] == "int8":
+            _, flat, scales = packed
+            new_step = int(self._lib.dps_store_push_int8(
+                self._handle, _i8p(flat), _f32p(scales),
+                _i64p(self._offsets), len(self._names),
+                int(fetched_step), bound))
+        elif packed[0] == "fp16":
             new_step = int(self._lib.dps_store_push_fp16(
-                self._handle, _u16p(flat.view(np.uint16)),
+                self._handle, _u16p(packed[1].view(np.uint16)),
                 int(fetched_step), bound))
         else:
-            flat = self._pack(gradients, np.float32)
             new_step = int(self._lib.dps_store_push_fp32(
-                self._handle, _f32p(flat), int(fetched_step), bound))
+                self._handle, _f32p(packed[1]), int(fetched_step), bound))
         if new_step < 0:
             self.stats.gradients_rejected += 1
             return False
@@ -191,10 +267,11 @@ class NativeParameterStore(MembershipMixin):
     # -- sync rounds (orchestration mirrors AggregationBase; _round_target
     #    and the elastic hooks' call sites are inherited) --------------------
 
-    def _push_sync(self, worker_id: int,
-                   gradients: Mapping[str, np.ndarray]) -> None:
+    def _push_sync(self, worker_id: int, packed: tuple) -> None:
         """server.py:264-288 semantics: stash (C++ decode into the worker's
         slot), count, and complete the round with one fused mean+apply.
+        ``packed`` comes from :meth:`_pack_push` (payload already validated
+        and arena-ordered, no shared state touched yet).
 
         The WHOLE stash happens under ``_sync_lock`` — exactly like the
         Python store, whose pushes hold the lock for the full stash —
@@ -210,14 +287,17 @@ class NativeParameterStore(MembershipMixin):
                     slot = self._next_slot
                     self._next_slot += 1
                 self._slot_of[worker_id] = slot
-            if self._push_codec == "fp16":
-                flat = self._pack(gradients, np.float16)
-                self._lib.dps_store_stash_fp16(self._handle, slot,
-                                               _u16p(flat.view(np.uint16)))
+            if packed[0] == "int8":
+                _, flat, scales = packed
+                self._lib.dps_store_stash_int8(
+                    self._handle, slot, _i8p(flat), _f32p(scales),
+                    _i64p(self._offsets), len(self._names))
+            elif packed[0] == "fp16":
+                self._lib.dps_store_stash_fp16(
+                    self._handle, slot, _u16p(packed[1].view(np.uint16)))
             else:
-                flat = self._pack(gradients, np.float32)
                 self._lib.dps_store_stash_fp32(self._handle, slot,
-                                               _f32p(flat))
+                                               _f32p(packed[1]))
             if self.config.strict_rounds:
                 self._pending[worker_id] = slot
                 self._gradients_received = len(self._pending)

@@ -101,6 +101,12 @@ class DeviceParameterStore(AggregationBase):
                 f"uncompressed fp32 (no wire); gradients skip the fp16 "
                 f"quantization the python/native backends apply",
                 stacklevel=2)
+        if self.config.fetch_codec != "none":
+            import warnings
+            warnings.warn(
+                f"DeviceParameterStore ignores fetch_codec="
+                f"{self.config.fetch_codec!r}: fetches hand back device "
+                f"arrays directly (no wire to compress)", stacklevel=2)
         self.parameters: dict[str, jax.Array] = {
             k: jnp.asarray(v, jnp.float32) for k, v in initial_params.items()
         }

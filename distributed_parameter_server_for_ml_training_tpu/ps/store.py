@@ -57,10 +57,15 @@ class StoreConfig:
     # wire-crossing python/native stores, matching the reference's
     # worker-side cast (worker.py:264-268); 'none' for the device store,
     # which crosses no wire). 'int8' (per-tensor symmetric quantization,
-    # ~half fp16's bytes) decodes on the Python store only. Stores
-    # resolve the sentinel at construction.
+    # ~half fp16's bytes) decodes on the python store (host numpy) and the
+    # native store (fused C++ dequant+apply). Stores resolve the sentinel
+    # at construction.
     push_codec: str | None = None
-    fetch_codec: str = "none"  # reference fetches fp32 (server.py:222)
+    # Fetch-side wire codec. 'none' (default) = reference parity: fetches
+    # are fp32, reproducing its dominant server cost (the ~45 MB re-pickle
+    # per fetch, server.py:222). 'bf16'/'fp16' opt in to halving the
+    # params-in wire term; workers/clients decompress after fetch.
+    fetch_codec: str = "none"
     strict_rounds: bool = False  # True = corrected double-push semantics
     # Membership expiry. The reference tracks last_seen but NEVER expires
     # workers (server.py:219, 251) — restarted workers pollute membership
@@ -85,6 +90,9 @@ class StoreConfig:
             raise ValueError(
                 f"total_workers must be 1..{MAX_WORKERS} (server.py:424-426),"
                 f" got {self.total_workers}")
+        if self.fetch_codec not in ("none", "fp16", "bf16"):
+            raise ValueError(f"fetch_codec must be none|fp16|bf16, got "
+                             f"{self.fetch_codec!r}")
 
 
 @dataclass
@@ -450,6 +458,9 @@ class ParameterStore(AggregationBase):
             self.last_seen[worker_id] = time.time()
         if self.config.fetch_codec == "fp16":
             payload = fp16_compress(payload)
+        elif self.config.fetch_codec == "bf16":
+            from ..ops.compression import bf16_compress
+            payload = bf16_compress(payload)
         return payload, step
 
     def push(self, worker_id: int, gradients: Mapping[str, np.ndarray],

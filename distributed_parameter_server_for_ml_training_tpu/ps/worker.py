@@ -249,7 +249,11 @@ class PSWorker(threading.Thread):
     def _fetch_params(self, worker_id: int):
         """One FetchParameters round trip -> (params pytree, fetched step)."""
         flat, fetched_step = self.store.fetch(worker_id)
-        if getattr(self.store, "fetch_codec", "none") == "fp16":
+        if (getattr(self.store, "fetch_codec", "none") in ("fp16", "bf16")
+                and not getattr(self.store, "decompresses_fetches", False)):
+            # In-process compressed fetch (RemoteStore already decompressed
+            # client-side — casting again would copy the full parameter
+            # set a second time per fetch for nothing).
             from ..ops.compression import fp16_decompress
             flat = fp16_decompress(flat)
         return unflatten_params(flat), fetched_step

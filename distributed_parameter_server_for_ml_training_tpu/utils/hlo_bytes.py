@@ -48,22 +48,23 @@ def _shape_bytes(shape_text: str, pick: str = "sum") -> int:
     (``pick='largest'``); for reduce-scatter the result is 1/N of the
     operand, so the result is the SMALLEST member (``pick='smallest'``) —
     the (N-1) ring factor in :func:`collective_wire_bytes` is calibrated
-    for result bytes.
+    for result bytes. Scalar tuple members (``u32[]`` context handles some
+    start forms carry) are excluded from the pick so 'smallest' lands on
+    the result, not a 4-byte handle. Scope: single-tensor collectives (the
+    forms this codebase emits); a variadic start would undercount.
     """
-    sizes = []
+    sizes, scalars = [], []
     for dtype, dims in _SHAPE_RE.findall(shape_text):
         n = 1
         for d in dims.split(","):
             if d:
                 n *= int(d)
-        sizes.append(n * _DTYPE_BYTES[dtype])
+        (scalars if dims == "" else sizes).append(n * _DTYPE_BYTES[dtype])
+    if pick == "sum":
+        return sum(sizes) + sum(scalars)
     if not sizes:
         return 0
-    if pick == "largest":
-        return max(sizes)
-    if pick == "smallest":
-        return min(sizes)
-    return sum(sizes)
+    return max(sizes) if pick == "largest" else min(sizes)
 
 
 def collective_wire_bytes(hlo_text: str, n_devices: int) -> dict:

@@ -306,6 +306,41 @@ class TestGrpcService:
         finally:
             server.stop(grace=None)
 
+    def test_fetch_codec_bf16_halves_params_in(self):
+        """serve --fetch-codec bf16 (round-4 VERDICT weak 3): the params-in
+        wire term halves; the client decompresses so callers see fp32."""
+        import ml_dtypes
+
+        params = {"w": np.random.default_rng(3).normal(
+            size=(1000,)).astype(np.float32)}
+        results = {}
+        for codec in ("none", "bf16"):
+            store = ParameterStore(params, StoreConfig(
+                mode="async", total_workers=1, push_codec="none",
+                fetch_codec=codec))
+            server, port = serve(store, port=0)
+            try:
+                client = RemoteStore(f"localhost:{port}")
+                client.register_worker()
+                base_in = client.wire_stats()["wire_bytes_in"]
+                fetched, step = client.fetch(0)
+                results[codec] = dict(
+                    fetched=fetched,
+                    fetch_bytes=client.wire_stats()["wire_bytes_in"]
+                    - base_in)
+                client.close()
+            finally:
+                server.stop(grace=None)
+        # client always sees fp32...
+        assert results["bf16"]["fetched"]["w"].dtype == np.float32
+        # ...at bf16 precision vs the exact fp32 fetch
+        np.testing.assert_array_equal(
+            results["bf16"]["fetched"]["w"],
+            params["w"].astype(ml_dtypes.bfloat16).astype(np.float32))
+        # and the wire moved ~half the bytes (modulo headers)
+        assert results["bf16"]["fetch_bytes"] < 0.6 * \
+            results["none"]["fetch_bytes"], results
+
     def test_push_retry_dedupe_sync_round(self):
         """Round-4 ADVICE: a push retry whose ORIGINAL completed a sync
         round must NOT be re-stashed into the next round. The client packs

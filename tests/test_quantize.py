@@ -126,7 +126,9 @@ class TestInt8Ring:
             "  y = f32[256] reduce-scatter(f32[1024] a), dimensions={0}",
             # async forms: (operand, result) tuples
             "  ars = (f32[1024], f32[1024]) all-reduce-start(f32[1024] a)",
-            "  rss = (f32[1024], f32[256]) reduce-scatter-start(f32[1024] a)",
+            # context scalar (u32[]) must not be picked as the "result"
+            "  rss = (f32[1024], f32[256], u32[]) "
+            "reduce-scatter-start(f32[1024] a)",
             "  ags = (f32[256], f32[1024]) all-gather-start(f32[256] a)",
             "  cps = (f32[512], f32[512]) collective-permute-start(f32[512] a)",
         ])
