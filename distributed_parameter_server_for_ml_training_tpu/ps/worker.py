@@ -55,6 +55,7 @@ class WorkerConfig:
 @dataclass
 class WorkerResult:
     worker_id: int = -1
+    worker_name: str = ""
     epoch_times: list = field(default_factory=list)
     test_accuracies: list = field(default_factory=list)
     local_steps_completed: int = 0
@@ -79,6 +80,7 @@ class WorkerResult:
                       config: WorkerConfig) -> dict:
         return {
             "worker_id": self.worker_id,
+            "worker_name": self.worker_name,
             "total_workers": total_workers,
             "total_training_time_seconds": round(sum(self.epoch_times), 2),
             "average_epoch_time_seconds": (
@@ -173,6 +175,7 @@ class PSWorker(threading.Thread):
         cfg = self.config
         worker_id, total_workers = self.store.register_worker(self.worker_name)
         self.result.worker_id = worker_id
+        self.result.worker_name = self.worker_name
         if cfg.heartbeat_interval > 0:
             threading.Thread(
                 target=self._heartbeat_loop,
@@ -245,6 +248,15 @@ class PSWorker(threading.Thread):
             if cfg.eval_each_epoch:
                 self.result.test_accuracies.append(
                     self.evaluate(params, batch_stats))
+            # Per-epoch progress line (the reference workers logged epochs
+            # to CloudWatch, worker.py:329-335); run_wire_matrix's elastic
+            # cell also keys its mid-run kill off this marker.
+            acc = (f", test_acc={self.result.test_accuracies[-1]:.4f}"
+                   if self.result.test_accuracies else "")
+            print(f"EPOCH_DONE worker={self.worker_name} id={worker_id} "
+                  f"epoch={epoch + 1}/{cfg.num_epochs} "
+                  f"time={self.result.epoch_times[-1]:.1f}s{acc}",
+                  flush=True)
 
     def _fetch_params(self, worker_id: int):
         """One FetchParameters round trip -> (params pytree, fetched step)."""
