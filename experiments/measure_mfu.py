@@ -34,7 +34,12 @@ V5E_BF16_PEAK_TFLOPS = 197.0  # per-chip bf16 peak (public v5e spec)
 
 
 def measure(name: str, model, image_size: int, batch: int, steps: int,
-            trials: int = 3, num_classes: int = 100) -> dict:
+            trials: int = 3, num_classes: int = 100,
+            flops_rec: dict | None = None) -> dict:
+    """``flops_rec``: reuse another row's per-step FLOPs instead of XLA
+    cost_analysis — Pallas kernels are opaque custom calls the analysis
+    cannot count, so a flash row borrows its DENSE twin's count (same
+    logical model, so model-FLOPs/s stays apples-to-apples)."""
     import jax.numpy as jnp
     import numpy as np
 
@@ -65,9 +70,13 @@ def measure(name: str, model, image_size: int, batch: int, steps: int,
     # FLOPs come from a SINGLE-step compile: XLA's cost analysis counts a
     # lax.scan body once, not steps-times, so the windowed executable
     # under-reports by the window length.
-    single = jax.jit(train_step).lower(
-        state, images[0], labels[0], key).compile()
-    step_flops = float(single.cost_analysis().get("flops", 0.0))
+    if flops_rec is not None:
+        step_flops = (flops_rec["window_tflops"] * 1e12
+                      / flops_rec["steps_per_window"])
+    else:
+        single = jax.jit(train_step).lower(
+            state, images[0], labels[0], key).compile()
+        step_flops = float(single.cost_analysis().get("flops", 0.0))
     window_flops = step_flops * steps
 
     state, loss = jitted(state, images, labels, key)
@@ -104,6 +113,10 @@ def main() -> int:
     ap.add_argument("--trials", type=int, default=3)
     ap.add_argument("--attn-only", action="store_true",
                     help="skip the train-step MFU rows (keep mfu.json's)")
+    ap.add_argument("--long-context", action="store_true",
+                    help="measure ONLY the 1024px (4097-token) dense-vs-"
+                         "flash train-step rows; keep every other recorded "
+                         "row and the attention microbench as-is")
     args = ap.parse_args()
 
     import jax.numpy as jnp
@@ -123,6 +136,36 @@ def main() -> int:
     if os.path.exists(out):
         with open(out) as f:
             prior = json.load(f)
+    if args.long_context:
+        # Round-4 VERDICT item 7: an END-TO-END train step in the regime
+        # the flash kernel is FOR — 1024px -> 64^2 patches + CLS = 4097
+        # tokens, where the microbench measured a 1.7x bwd kernel win.
+        # The dense row materializes [B, H, T, T] logits (the O(T^2) HBM
+        # cost flash exists to avoid), so the batch is what dense FITS;
+        # flash's MFU uses the dense row's FLOP count (Pallas calls are
+        # opaque to cost_analysis; same logical model either way).
+        rows = [r for r in prior.get("train_step_mfu", [])
+                if not r["name"].startswith("vit_b16_1024px")]
+        dense_lc = measure("vit_b16_1024px_dense", ViT(**vit_b16),
+                           1024, 4, 4, args.trials)
+        flash_lc = measure("vit_b16_1024px_flash_auto",
+                           ViT(**vit_b16, attention_fn=flash_attention),
+                           1024, 4, 4, args.trials, flops_rec=dense_lc)
+        flash_lc["flops_from"] = "vit_b16_1024px_dense"
+        flash_lc["note"] = ("T=4097 >> measured crossover: the dispatch "
+                            "selects the Pallas kernel; same model, same "
+                            "batch, same data as the dense row")
+        flash_lc["end_to_end_speedup_vs_dense"] = round(
+            dense_lc["ms_per_step"] / flash_lc["ms_per_step"], 2)
+        rows += [dense_lc, flash_lc]
+        with open(out, "w") as f:
+            json.dump({"train_step_mfu": rows,
+                       "attention_core_bench": prior.get(
+                           "attention_core_bench", [])}, f, indent=2)
+            f.write("\n")
+        print(f"wrote {out} (long-context rows only)", flush=True)
+        return 0
+
     rows = prior.get("train_step_mfu", []) if args.attn_only else [
         measure("resnet18_32px", ResNet18(num_classes=100, dtype=bf16),
                 32, 3072, 40, args.trials),
