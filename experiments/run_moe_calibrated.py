@@ -134,6 +134,10 @@ def main() -> int:
                     help="aux-weight=0 contrast run length "
                          "(default: same as --epochs — full-run contrast)")
     ap.add_argument("--skip-dense", action="store_true")
+    ap.add_argument("--out", default="moe_8experts.json",
+                    help="output filename under experiments/results/"
+                         "calibrated/ (a longer-budget rerun must not "
+                         "overwrite the default record)")
     ap.add_argument("--train-size", type=int, default=8192,
                     help="subset of the calibrated dataset (CPU-mesh host)")
     args = ap.parse_args()
@@ -143,7 +147,7 @@ def main() -> int:
 
     ds = compositional_cifar100(n_train=args.train_size, n_test=2048)
     record = {
-        "experiment_name": "moe_8experts",
+        "experiment_name": args.out.rsplit(".", 1)[0],
         "dataset": {"generator": "compositional_cifar100",
                     "synthetic": True, "n_train": args.train_size,
                     "n_test": 2048},
@@ -154,13 +158,16 @@ def main() -> int:
                    "learning_rate": 0.1, "capacity_factor": 2.0},
     }
     out = os.path.join(REPO, "experiments", "results", "calibrated",
-                       "moe_8experts.json")
+                       os.path.basename(args.out))
 
     def save():
         with open(out, "w") as f:
             json.dump(record, f, indent=2, default=float)
             f.write("\n")
 
+    # Validate the output path BEFORE the first ~40-minute cell: a bad
+    # --out must fail in seconds, not after the training finishes.
+    save()
     # Save after EVERY cell: a crash in a later cell must not lose a
     # 40-minute run (it did once).
     record["balanced_aux_0.01"] = run(0.01, args.epochs, ds)
