@@ -192,18 +192,19 @@ def main() -> int:
                 record["dense_reference"]["wall_seconds"],
         }
         save()
-    record["contrast_aux_0"] = run(
-        0.0,
-        args.contrast_epochs if args.contrast_epochs is not None
-        else args.epochs, ds)
-    save()
+    n_contrast = (args.contrast_epochs if args.contrast_epochs is not None
+                  else args.epochs)
+    if n_contrast > 0:
+        record["contrast_aux_0"] = run(0.0, n_contrast, ds)
+        save()
     print(f"wrote {out}")
     print("balanced per-epoch routing:",
           record["balanced_aux_0.01"]["per_epoch_routing"])
     if "moe_vs_dense" in record:
         print("moe vs dense:", record["moe_vs_dense"])
-    print("contrast (aux off) routing:",
-          record["contrast_aux_0"]["per_epoch_routing"])
+    if "contrast_aux_0" in record:
+        print("contrast (aux off) routing:",
+              record["contrast_aux_0"]["per_epoch_routing"])
     return 0
 
 
