@@ -45,6 +45,7 @@ def _build() -> bool:
 # Every symbol the bindings below resolve; _stale() probes these directly.
 _REQUIRED_SYMBOLS = (
     "dps_fp32_to_fp16", "dps_fp16_to_fp32",
+    "dps_fp32_to_bf16", "dps_bf16_to_fp32",
     "dps_store_create", "dps_store_destroy", "dps_store_step",
     "dps_store_rejected", "dps_store_fetch", "dps_store_load",
     "dps_store_push_fp16", "dps_store_push_fp32", "dps_store_push_int8",
@@ -98,6 +99,8 @@ def load_library() -> ctypes.CDLL | None:
 
         lib.dps_fp32_to_fp16.argtypes = [f32p, u16p, i64]
         lib.dps_fp16_to_fp32.argtypes = [u16p, f32p, i64]
+        lib.dps_fp32_to_bf16.argtypes = [f32p, u16p, i64]
+        lib.dps_bf16_to_fp32.argtypes = [u16p, f32p, i64]
         lib.dps_store_create.argtypes = [i64, f32p, ctypes.c_float]
         lib.dps_store_create.restype = ctypes.c_void_p
         lib.dps_store_destroy.argtypes = [ctypes.c_void_p]
@@ -170,5 +173,35 @@ def fp16_to_fp32(src: np.ndarray) -> np.ndarray:
         return src.astype(np.float32)
     out = np.empty(src.shape, np.float32)
     lib.dps_fp16_to_fp32(_u16p(src.view(np.uint16).reshape(-1)),
+                         _f32p(out.reshape(-1)), src.size)
+    return out
+
+
+def fp32_to_bf16(src: np.ndarray) -> np.ndarray:
+    """Multithreaded fp32->bfloat16 cast (RNE, bit-for-bit ml_dtypes) for
+    the fetch-side codec; ml_dtypes fallback when the library is absent."""
+    import ml_dtypes
+
+    lib = load_library()
+    src = np.ascontiguousarray(src, np.float32)
+    if lib is None:
+        return src.astype(ml_dtypes.bfloat16)
+    out = np.empty(src.shape, np.uint16)
+    lib.dps_fp32_to_bf16(_f32p(src.reshape(-1)), _u16p(out.reshape(-1)),
+                         src.size)
+    return out.view(ml_dtypes.bfloat16)
+
+
+def bf16_to_fp32(src: np.ndarray) -> np.ndarray:
+    import ml_dtypes
+
+    lib = load_library()
+    src = np.ascontiguousarray(src)
+    if src.dtype != ml_dtypes.bfloat16:
+        raise TypeError(src.dtype)
+    if lib is None:
+        return src.astype(np.float32)
+    out = np.empty(src.shape, np.float32)
+    lib.dps_bf16_to_fp32(_u16p(src.view(np.uint16).reshape(-1)),
                          _f32p(out.reshape(-1)), src.size)
     return out

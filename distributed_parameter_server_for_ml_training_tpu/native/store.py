@@ -148,8 +148,8 @@ class NativeParameterStore(MembershipMixin):
             from .bindings import fp32_to_fp16
             flat = fp32_to_fp16(flat)
         elif codec == "bf16":
-            import ml_dtypes
-            flat = flat.astype(ml_dtypes.bfloat16)
+            from .bindings import fp32_to_bf16
+            flat = fp32_to_bf16(flat)
         return self._unpack(flat), step
 
     # -- checkpoint surface (same contract as AggregationBase.snapshot) ------

@@ -142,6 +142,38 @@ void dps_fp16_to_fp32(const uint16_t* src, float* dst, int64_t n) {
   });
 }
 
+// bfloat16 = top 16 bits of fp32, round-to-nearest-even on the dropped
+// half. The FETCH-side codec (serve --fetch-codec bf16): full fp32
+// exponent range at half the wire bytes, matching ml_dtypes' cast
+// bit-for-bit (tested) so python- and native-backend fetches are
+// indistinguishable on the wire.
+void dps_fp32_to_bf16(const float* src, uint16_t* dst, int64_t n) {
+  parallel_for(n, 1 << 16, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      uint32_t bits;
+      std::memcpy(&bits, &src[i], 4);
+      if ((bits & 0x7F800000u) == 0x7F800000u && (bits & 0x007FFFFFu)) {
+        // NaN: truncating could zero the kept mantissa bits and decay to
+        // inf — force a quiet bit instead.
+        dst[i] = (uint16_t)((bits >> 16) | 0x0040u);
+      } else {
+        // RNE: add 0x7FFF + lsb-of-result; inf (mantissa 0) is unchanged
+        // because the add cannot carry past bit 16.
+        dst[i] = (uint16_t)((bits + (0x7FFFu + ((bits >> 16) & 1u))) >> 16);
+      }
+    }
+  });
+}
+
+void dps_bf16_to_fp32(const uint16_t* src, float* dst, int64_t n) {
+  parallel_for(n, 1 << 16, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      uint32_t bits = (uint32_t)src[i] << 16;
+      std::memcpy(&dst[i], &bits, 4);
+    }
+  });
+}
+
 // ---- store lifecycle -------------------------------------------------------
 
 void* dps_store_create(int64_t n, const float* init, float lr) {

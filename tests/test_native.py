@@ -54,6 +54,35 @@ def grads(seed):
     }
 
 
+class TestNativeBf16Codec:
+    def test_matches_ml_dtypes_bit_for_bit(self):
+        import ml_dtypes
+
+        from distributed_parameter_server_for_ml_training_tpu.native.bindings \
+            import bf16_to_fp32, fp32_to_bf16
+
+        rng = np.random.default_rng(0)
+        x = np.concatenate([
+            rng.normal(scale=s, size=(4096,)).astype(np.float32)
+            for s in (1e-30, 1e-3, 1.0, 1e30)])
+        x = np.concatenate([x, np.asarray(
+            [0.0, -0.0, np.inf, -np.inf, np.nan,
+             np.float32(3.0).item()], np.float32)])
+        ours = fp32_to_bf16(x)
+        ref = x.astype(ml_dtypes.bfloat16)
+        # full bit equality everywhere but NaN payloads (sign included:
+        # -inf must not decay to +inf)
+        not_nan = ~np.isnan(x)
+        np.testing.assert_array_equal(ours.view(np.uint16)[not_nan],
+                                      ref.view(np.uint16)[not_nan])
+        finite = np.isfinite(x)
+        # decode is exact (bf16 values are fp32-representable)
+        back = bf16_to_fp32(ours)
+        np.testing.assert_array_equal(back[finite],
+                                      ref.astype(np.float32)[finite])
+        assert np.isnan(back[np.isnan(x)]).all()
+
+
 class TestNativeStore:
     def test_matches_python_store_exactly(self):
         """Same push sequence -> bit-identical parameters (the C++ fused
