@@ -2,6 +2,8 @@
 
 Prints ONE JSON line:
     {"metric": ..., "value": N, "unit": "images/sec/chip", "vs_baseline": N}
+or, on ANY failure, a diagnostic JSON line instead of a bare traceback:
+    {"ok": false, "stage": ..., "error": ..., "attempts": ...}
 
 Baseline: the reference's single-machine trainer did one CIFAR-100 epoch
 (50,000 images) in 1037.8 s on an M1 Mac CPU (BASELINE.md; reference
@@ -15,6 +17,16 @@ axon tunnel's per-dispatch latency is large and variable; completion is
 confirmed by fetching the final loss scalar (block_until_ready on donated
 buffers can return early under the tunnel). Several windows are timed and the
 best is reported.
+
+Failure hardening (round-5 VERDICT missing #1): BENCH_r05.json was rc=1
+because ``jax.devices()`` hit one transient ``Unable to initialize backend``
+and nothing retried or recorded anything — the round shipped with NO
+official perf number although the chip worked minutes later.
+:func:`acquire_backend` now retries init with exponential backoff (~3 min
+budget), and every failure path emits the ``{"ok": false, ...}`` line above,
+so a flake can cost a number's freshness but never the record itself.
+``DPS_BENCH_FAIL_INJECT=N`` makes the first N init attempts fail (tests
+prove both the retry and the diagnostic artifact).
 """
 
 from __future__ import annotations
@@ -24,6 +36,7 @@ import json
 import os
 import sys
 import time
+import traceback
 
 # XLA compiles on the host CPU (1 core in this environment); the persistent
 # cache turns the ~30 s first-compile into a disk hit on re-runs. Set via
@@ -40,8 +53,178 @@ jax.config.update(
 
 REFERENCE_IMAGES_PER_SEC = 50_000 / 1037.8  # M1 Mac CPU epoch time
 
+#: attempts = retries + 1; sum(3 * 2^k, k<5) = 93 s of sleep + init time
+#: keeps the whole acquisition under a ~3-minute budget.
+INIT_RETRIES = 5
+INIT_BACKOFF_S = 3.0
 
-def main() -> None:
+_fail_inject_remaining: int | None = None
+
+
+def _fail_injection_due() -> bool:
+    """Test hook: env DPS_BENCH_FAIL_INJECT=N fails the first N init
+    attempts (process-wide), letting tests prove retry AND diagnostic
+    behavior without a real backend flake."""
+    global _fail_inject_remaining
+    if _fail_inject_remaining is None:
+        _fail_inject_remaining = int(
+            os.environ.get("DPS_BENCH_FAIL_INJECT", "0"))
+    if _fail_inject_remaining > 0:
+        _fail_inject_remaining -= 1
+        return True
+    return False
+
+
+def acquire_backend(retries: int = INIT_RETRIES,
+                    backoff: float = INIT_BACKOFF_S,
+                    sleep=time.sleep) -> list:
+    """``jax.devices()`` with bounded retry + exponential backoff.
+
+    Transient backend-init failures (the tunnel answering UNAVAILABLE
+    during an attach) look identical to permanent ones on the first call;
+    the reference for "transient" is BENCH_r05: init failed once, the same
+    chip ran fine later the same round. Returns the device list, or raises
+    the LAST error after exhausting retries (attempt count attached as
+    ``.bench_attempts`` for the diagnostic record).
+    """
+    delay = backoff
+    last_err: Exception | None = None
+    for attempt in range(1, retries + 2):
+        try:
+            if _fail_injection_due():
+                raise RuntimeError("injected backend init failure "
+                                   "(DPS_BENCH_FAIL_INJECT)")
+            devices = jax.devices()
+            if attempt > 1:
+                print(f"backend init succeeded on attempt {attempt}",
+                      file=sys.stderr)
+            return devices
+        except Exception as e:  # jax raises RuntimeError subtypes here
+            last_err = e
+            if attempt > retries:
+                break
+            print(f"backend init attempt {attempt} failed ({e}); "
+                  f"retrying in {delay:.0f}s", file=sys.stderr)
+            sleep(delay)
+            delay *= 2
+    last_err.bench_attempts = retries + 1
+    raise last_err
+
+
+def emit_diagnostic(stage: str, err: Exception) -> None:
+    """The always-written failure artifact: one parseable JSON line on
+    stdout (where the success line would have gone), so the driver's
+    captured BENCH_r*.json is never empty/garbage on failure."""
+    print(json.dumps({
+        "ok": False,
+        "stage": stage,
+        "error": f"{type(err).__name__}: {err}",
+        "attempts": getattr(err, "bench_attempts", 1),
+        "traceback_tail": traceback.format_exc().strip()
+        .splitlines()[-3:],
+    }))
+
+
+def run_bench(args) -> dict:
+    stage = "backend_init"
+    try:
+        devices = acquire_backend(
+            retries=getattr(args, "init_retries", INIT_RETRIES),
+            backoff=getattr(args, "init_backoff", INIT_BACKOFF_S))
+
+        stage = "build"
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from distributed_parameter_server_for_ml_training_tpu.models import (
+            ResNet18)
+        from distributed_parameter_server_for_ml_training_tpu.parallel import (
+            make_mesh, make_sync_dp_step)
+        from distributed_parameter_server_for_ml_training_tpu.train import (
+            create_train_state, make_train_step, server_sgd)
+
+        n_chips = len(devices)
+        print(f"benchmarking on {devices} "
+              f"(batch {args.batch_size} x {args.scan_steps} steps/window)",
+              file=sys.stderr)
+
+        if n_chips > 1:
+            # Multi-chip: the real sync-DP step over a mesh of ALL chips, so
+            # the per-chip number divides work that genuinely ran on every
+            # chip.
+            mesh = make_mesh(n_chips)
+            model = ResNet18(num_classes=100, dtype=jnp.bfloat16,
+                             axis_name="data")
+            train_step = make_sync_dp_step(mesh, compression="bf16",
+                                           augment=True)
+            batch_sharding = NamedSharding(mesh, P(None, "data"))
+        else:
+            mesh = None
+            model = ResNet18(num_classes=100, dtype=jnp.bfloat16)
+            train_step = make_train_step(augment=True)
+            batch_sharding = None
+
+        state = create_train_state(model, jax.random.PRNGKey(0),
+                                   server_sgd(0.1))
+
+        def window(state, images, labels, key):
+            """scan-steps training steps fully on device (prefetched
+            batches)."""
+            def body(carry, batch):
+                st, k = carry
+                xb, yb = batch
+                st, metrics = train_step(st, xb, yb, k)
+                return (st, k), metrics["loss"]
+
+            (state, _), losses = jax.lax.scan(
+                body, (state, key), (images, labels))
+            return state, losses[-1]
+
+        window = jax.jit(window, donate_argnums=0)
+
+        rng = np.random.default_rng(0)
+        images = jnp.asarray(rng.integers(
+            0, 255, (args.scan_steps, args.batch_size, 32, 32, 3),
+            dtype=np.uint8))
+        labels = jnp.asarray(np.tile(
+            np.arange(args.batch_size) % 100,
+            (args.scan_steps, 1)).astype(np.int32))
+        if batch_sharding is not None:
+            images = jax.device_put(images, batch_sharding)
+            labels = jax.device_put(labels, batch_sharding)
+        key = jax.random.PRNGKey(1)
+
+        # Warmup: compile + one full window.
+        stage = "warmup_compile"
+        state, loss = window(state, images, labels, key)
+        _ = float(loss)
+
+        stage = "timed_trials"
+        best_dt = float("inf")
+        for trial in range(args.trials):
+            t0 = time.perf_counter()
+            state, loss = window(state, images, labels, key)
+            final_loss = float(loss)  # forces completion of the whole chain
+            dt = time.perf_counter() - t0
+            print(f"trial {trial}: {dt*1e3:.1f} ms, loss {final_loss:.4f}",
+                  file=sys.stderr)
+            best_dt = min(best_dt, dt)
+
+        images_per_sec = args.scan_steps * args.batch_size / best_dt
+        per_chip = images_per_sec / n_chips
+        return {
+            "metric": "cifar100_resnet18_train_images_per_sec_per_chip",
+            "value": round(per_chip, 1),
+            "unit": "images/sec/chip",
+            "vs_baseline": round(per_chip / REFERENCE_IMAGES_PER_SEC, 2),
+        }
+    except Exception as e:
+        e.bench_stage = stage
+        raise
+
+
+def main() -> int:
     parser = argparse.ArgumentParser()
     # Defaults from the round-2 sweep + round-4 window probe
     # (experiments/results/PERF.md): throughput is flat in batch size
@@ -53,88 +236,22 @@ def main() -> None:
     parser.add_argument("--scan-steps", type=int, default=80,
                         help="train steps per device-side scan window")
     parser.add_argument("--trials", type=int, default=5)
+    parser.add_argument("--init-retries", type=int, default=INIT_RETRIES,
+                        help="backend-init retries before the diagnostic "
+                             "record is written")
+    parser.add_argument("--init-backoff", type=float,
+                        default=INIT_BACKOFF_S,
+                        help="first retry delay (doubles per attempt)")
     args = parser.parse_args()
 
-    import jax.numpy as jnp
-    import numpy as np
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    from distributed_parameter_server_for_ml_training_tpu.models import ResNet18
-    from distributed_parameter_server_for_ml_training_tpu.parallel import (
-        make_mesh, make_sync_dp_step)
-    from distributed_parameter_server_for_ml_training_tpu.train import (
-        create_train_state, make_train_step, server_sgd)
-
-    n_chips = len(jax.devices())
-    print(f"benchmarking on {jax.devices()} "
-          f"(batch {args.batch_size} x {args.scan_steps} steps/window)",
-          file=sys.stderr)
-
-    if n_chips > 1:
-        # Multi-chip: the real sync-DP step over a mesh of ALL chips, so the
-        # per-chip number divides work that genuinely ran on every chip.
-        mesh = make_mesh(n_chips)
-        model = ResNet18(num_classes=100, dtype=jnp.bfloat16,
-                         axis_name="data")
-        train_step = make_sync_dp_step(mesh, compression="bf16", augment=True)
-        batch_sharding = NamedSharding(mesh, P(None, "data"))
-    else:
-        mesh = None
-        model = ResNet18(num_classes=100, dtype=jnp.bfloat16)
-        train_step = make_train_step(augment=True)
-        batch_sharding = None
-
-    state = create_train_state(model, jax.random.PRNGKey(0), server_sgd(0.1))
-
-    def window(state, images, labels, key):
-        """scan-steps training steps fully on device (prefetched batches)."""
-        def body(carry, batch):
-            st, k = carry
-            xb, yb = batch
-            st, metrics = train_step(st, xb, yb, k)
-            return (st, k), metrics["loss"]
-
-        (state, _), losses = jax.lax.scan(
-            body, (state, key), (images, labels))
-        return state, losses[-1]
-
-    window = jax.jit(window, donate_argnums=0)
-
-    rng = np.random.default_rng(0)
-    images = jnp.asarray(rng.integers(
-        0, 255, (args.scan_steps, args.batch_size, 32, 32, 3),
-        dtype=np.uint8))
-    labels = jnp.asarray(np.tile(
-        np.arange(args.batch_size) % 100,
-        (args.scan_steps, 1)).astype(np.int32))
-    if batch_sharding is not None:
-        images = jax.device_put(images, batch_sharding)
-        labels = jax.device_put(labels, batch_sharding)
-    key = jax.random.PRNGKey(1)
-
-    # Warmup: compile + one full window.
-    state, loss = window(state, images, labels, key)
-    _ = float(loss)
-
-    best_dt = float("inf")
-    for trial in range(args.trials):
-        t0 = time.perf_counter()
-        state, loss = window(state, images, labels, key)
-        final_loss = float(loss)  # forces completion of the whole chain
-        dt = time.perf_counter() - t0
-        print(f"trial {trial}: {dt*1e3:.1f} ms, loss {final_loss:.4f}",
-              file=sys.stderr)
-        best_dt = min(best_dt, dt)
-
-    images_per_sec = args.scan_steps * args.batch_size / best_dt
-    per_chip = images_per_sec / n_chips
-    print(json.dumps({
-        "metric": "cifar100_resnet18_train_images_per_sec_per_chip",
-        "value": round(per_chip, 1),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(per_chip / REFERENCE_IMAGES_PER_SEC, 2),
-    }))
+    try:
+        result = run_bench(args)
+    except Exception as e:
+        emit_diagnostic(getattr(e, "bench_stage", "unknown"), e)
+        return 1
+    print(json.dumps(result))
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    raise SystemExit(main())

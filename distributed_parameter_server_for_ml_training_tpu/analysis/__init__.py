@@ -1,8 +1,17 @@
 """Experiment/analysis layer (reference L5: scripts/)."""
 
-from .parse_logs import aggregate_worker_metrics, parse_experiment
+from .parse_logs import (
+    aggregate_worker_metrics,
+    build_telemetry_timeseries,
+    parse_experiment,
+    parse_snapshot_series,
+    staleness_series,
+    worker_throughput_series,
+)
 from .runner import run_cell, run_matrix
 from .visualize import ExperimentVisualizer
 
-__all__ = ["aggregate_worker_metrics", "parse_experiment",
+__all__ = ["aggregate_worker_metrics", "build_telemetry_timeseries",
+           "parse_experiment", "parse_snapshot_series", "staleness_series",
+           "worker_throughput_series",
            "ExperimentVisualizer", "run_cell", "run_matrix"]

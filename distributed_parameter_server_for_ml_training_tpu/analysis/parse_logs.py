@@ -32,6 +32,15 @@ def _is_worker(m: dict) -> bool:
     return "worker_id" in m
 
 
+def _is_snapshot(m: dict) -> bool:
+    """Live-telemetry snapshot lines (telemetry/snapshot.py) share the
+    METRICS_JSON wire convention but are a different record kind: they
+    carry ``"kind": "snapshot"`` and must not enter the final-stats
+    aggregation (the reference schema has exactly one exit record per
+    process)."""
+    return m.get("kind") == "snapshot"
+
+
 def aggregate_worker_metrics(workers: list[dict]) -> dict:
     """parse_cloudwatch_logs.py:125-177 semantics."""
     if not workers:
@@ -94,7 +103,7 @@ def aggregate_worker_metrics(workers: list[dict]) -> dict:
 def parse_experiment(logs: str | Iterable[str],
                      experiment_name: str = "experiment") -> dict:
     """Full log text (possibly many processes' stdout) -> experiment record."""
-    metrics = parse_metrics_lines(logs)
+    metrics = [m for m in parse_metrics_lines(logs) if not _is_snapshot(m)]
     server = next((m for m in metrics
                    if not _is_worker(m) and "mode" in m), None)
     workers = [m for m in metrics if _is_worker(m)]
@@ -104,6 +113,153 @@ def parse_experiment(logs: str | Iterable[str],
         "worker_metrics_aggregated": aggregate_worker_metrics(workers),
         "raw_worker_metrics": workers,
     }
+
+
+# ---------------------------------------------------------------------------
+# Live-telemetry snapshot streams (telemetry/snapshot.py) -> time-series.
+#
+# Snapshots are CUMULATIVE registry dumps on a fixed interval; rates are
+# derived here from consecutive-snapshot deltas. A run's interleaved stdout
+# (many processes tee into one log) demultiplexes on (role, pid).
+# ---------------------------------------------------------------------------
+
+def _parse_metric_key(key: str) -> tuple[str, dict]:
+    """``'name{k=v,k2=v2}'`` -> ('name', {'k': 'v', 'k2': 'v2'})."""
+    if "{" not in key:
+        return key, {}
+    name, _, rest = key.partition("{")
+    labels = dict(part.split("=", 1) for part in rest.rstrip("}").split(",")
+                  if "=" in part)
+    return name, labels
+
+
+def parse_snapshot_series(logs: str | Iterable[str]) -> dict[str, list[dict]]:
+    """All snapshot payloads, grouped by emitting process (``role:pid``),
+    each group sorted by ``seq``."""
+    out: dict[str, list[dict]] = {}
+    for m in parse_metrics_lines(logs):
+        if not _is_snapshot(m):
+            continue
+        key = f"{m.get('role', 'process')}:{m.get('pid', 0)}"
+        out.setdefault(key, []).append(m)
+    for snaps in out.values():
+        snaps.sort(key=lambda s: s.get("seq", 0))
+    return out
+
+
+def _counter_series(snaps: list[dict]) -> tuple[dict, dict]:
+    """Per-counter cumulative values and interval rates across snapshots.
+
+    Rates align with ``t[1:]`` (a rate needs two samples); the first
+    snapshot's cumulative value is still visible in ``values``.
+    """
+    names = sorted({k for s in snaps for k in s.get("counters", {})})
+    values = {n: [float(s.get("counters", {}).get(n, 0.0)) for s in snaps]
+              for n in names}
+    ts = [float(s.get("ts", 0.0)) for s in snaps]
+    rates = {}
+    for n in names:
+        r = []
+        for i in range(1, len(snaps)):
+            dt = ts[i] - ts[i - 1]
+            dv = values[n][i] - values[n][i - 1]
+            r.append(round(dv / dt, 6) if dt > 0 else 0.0)
+        rates[n] = r
+    return values, rates
+
+
+def build_telemetry_timeseries(logs: str | Iterable[str]) -> dict:
+    """Snapshot stream -> per-process time-series record.
+
+    Output shape (JSON-ready; consumed by
+    :meth:`.visualize.ExperimentVisualizer.plot_telemetry` and the recorded
+    demo artifacts under ``experiments/results/telemetry/``)::
+
+        {"procs": {"worker:1234": {
+            "role": "worker", "pid": 1234,
+            "t": [...relative seconds...],
+            "counters": {key: [cumulative...]},
+            "rates":    {key: [per-second, aligned to t[1:]]},
+            "gauges":   {key: [...]},
+            "histograms_final": {key: {le, counts, sum, count}}}}}
+    """
+    series = parse_snapshot_series(logs)
+    procs = {}
+    for proc_key, snaps in series.items():
+        if not snaps:
+            continue
+        t0 = float(snaps[0].get("ts", 0.0)) \
+            - float(snaps[0].get("uptime_seconds", 0.0))
+        values, rates = _counter_series(snaps)
+        gauge_names = sorted({k for s in snaps for k in s.get("gauges", {})})
+        procs[proc_key] = {
+            "role": snaps[0].get("role", "process"),
+            "pid": snaps[0].get("pid", 0),
+            "t": [round(float(s.get("ts", 0.0)) - t0, 3) for s in snaps],
+            "counters": values,
+            "rates": rates,
+            "gauges": {n: [s.get("gauges", {}).get(n) for s in snaps]
+                       for n in gauge_names},
+            "histograms_final": dict(snaps[-1].get("histograms", {})),
+        }
+    return {"procs": procs}
+
+
+def worker_throughput_series(ts_record: dict) -> dict[str, dict]:
+    """Per-worker training throughput from a built time-series record.
+
+    Pulls every ``dps_worker_steps_total{worker=N}`` (PS workers) and
+    ``dps_trainer_steps_total{mode=...}`` (SPMD trainer) counter; keys are
+    ``worker-N`` / ``trainer-<mode>``, values carry the rate series aligned
+    to ``t[1:]``.
+    """
+    out: dict[str, dict] = {}
+    for proc_key, proc in ts_record.get("procs", {}).items():
+        for key, rate in proc.get("rates", {}).items():
+            name, labels = _parse_metric_key(key)
+            if name == "dps_worker_steps_total":
+                label = f"worker-{labels.get('worker', '?')}"
+            elif name == "dps_trainer_steps_total":
+                label = f"trainer-{labels.get('mode', '?')}"
+            else:
+                continue
+            out[f"{label} ({proc_key})" if len(
+                ts_record["procs"]) > 1 else label] = {
+                "t": proc["t"][1:],
+                "steps_per_second": rate,
+                "cumulative_steps": proc["counters"][key],
+            }
+    return out
+
+
+def staleness_series(ts_record: dict) -> dict:
+    """Aggregate async-staleness evidence from a time-series record:
+    the final histogram (summed across backends/processes) plus the
+    per-snapshot observation-count series (arrival intensity over time).
+    """
+    le = None
+    counts = None
+    total_series: dict[str, dict] = {}
+    for proc_key, proc in ts_record.get("procs", {}).items():
+        for key, hist in proc.get("histograms_final", {}).items():
+            name, _ = _parse_metric_key(key)
+            if name != "dps_store_staleness_versions":
+                continue
+            if le is None:
+                le = list(hist["le"])
+                counts = [0] * len(hist["counts"])
+            for i, c in enumerate(hist["counts"]):
+                counts[i] += c
+    for proc_key, proc in ts_record.get("procs", {}).items():
+        for key in proc.get("rates", {}):
+            name, labels = _parse_metric_key(key)
+            if name == "dps_store_pushes_total":
+                total_series[f"{labels.get('outcome', '?')} ({proc_key})"] = {
+                    "t": proc["t"][1:],
+                    "pushes_per_second": proc["rates"][key],
+                }
+    return {"le": le or [], "counts": counts or [],
+            "push_rates": total_series}
 
 
 def parse_log_files(paths: list[str], experiment_name: str,

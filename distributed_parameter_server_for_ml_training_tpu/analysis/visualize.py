@@ -178,6 +178,75 @@ class ExperimentVisualizer:
         fig.savefig(out_path, dpi=120)
         plt.close(fig)
 
+    # -- live-telemetry time-series (snapshot streams) -----------------------
+
+    @staticmethod
+    def plot_telemetry(ts_record: dict, out_path: str) -> None:
+        """4-panel view of a run's snapshot stream
+        (``analysis.build_telemetry_timeseries`` output): per-worker
+        training throughput, wire bytes/s, the async staleness histogram,
+        and store global-step progress. The live complement to the
+        exit-line figures above — regenerable from any run's logs with
+        ``--telemetry`` enabled (docs/OBSERVABILITY.md)."""
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        from .parse_logs import (_parse_metric_key, staleness_series,
+                                 worker_throughput_series)
+
+        fig, axes = plt.subplots(2, 2, figsize=(13, 9))
+
+        ax = axes[0, 0]
+        for label, s in sorted(worker_throughput_series(ts_record).items()):
+            ax.plot(s["t"], s["steps_per_second"], "o-", ms=3, label=label)
+        ax.set_title("Training throughput (steps/s)")
+        ax.set_xlabel("run time (s)")
+        ax.legend(fontsize=7)
+
+        ax = axes[0, 1]
+        for proc_key, proc in sorted(ts_record.get("procs", {}).items()):
+            for key, rate in sorted(proc.get("rates", {}).items()):
+                name, labels = _parse_metric_key(key)
+                if name not in ("dps_rpc_client_bytes_total",
+                                "dps_worker_push_bytes_total"):
+                    continue
+                tag = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+                label = f"{name.split('_bytes')[0]}[{tag}]"
+                if len(ts_record["procs"]) > 1:
+                    label += f" ({proc_key})"  # disambiguate across procs
+                ax.plot(proc["t"][1:], [r / 1e6 for r in rate], "-",
+                        label=label)
+        ax.set_title("Bytes on wire (MB/s)")
+        ax.set_xlabel("run time (s)")
+        ax.legend(fontsize=6)
+
+        ax = axes[1, 0]
+        st = staleness_series(ts_record)
+        if st["le"]:
+            edges = [str(int(e)) for e in st["le"]] + ["inf"]
+            ax.bar(range(len(st["counts"])), st["counts"])
+            ax.set_xticks(range(len(edges)))
+            ax.set_xticklabels(edges, fontsize=7)
+            ax.set_xlabel("staleness (versions behind, bucket <= edge)")
+        ax.set_title("Async staleness distribution")
+
+        ax = axes[1, 1]
+        for proc_key, proc in sorted(ts_record.get("procs", {}).items()):
+            for key, vals in sorted(proc.get("gauges", {}).items()):
+                name, labels = _parse_metric_key(key)
+                if name != "dps_store_global_step":
+                    continue
+                ax.plot(proc["t"], vals, "s-", ms=3,
+                        label=f"{labels.get('backend', '?')} ({proc_key})")
+        ax.set_title("Store global step")
+        ax.set_xlabel("run time (s)")
+        ax.legend(fontsize=7)
+
+        fig.tight_layout()
+        fig.savefig(out_path, dpi=120)
+        plt.close(fig)
+
     def summary_table(self) -> str:
         """Console summary (visualize_results.py:278-296)."""
         lines = [f"{'experiment':<28}{'mode':<8}{'workers':>8}"

@@ -45,8 +45,24 @@ def build_parser() -> argparse.ArgumentParser:
                             "'cpu' is needed when another process holds the "
                             "TPU, e.g. multi-process serve/worker runs)")
 
+    def add_telemetry(q):
+        q.add_argument("--telemetry", action="store_true",
+                       default=bool(_env("DPS_TELEMETRY", 0, int)),
+                       help="emit periodic METRICS_JSON "
+                            "'kind=snapshot' lines (live counters/gauges/"
+                            "histograms; same regex convention as the exit "
+                            "line, docs/OBSERVABILITY.md)")
+        q.add_argument("--telemetry-interval", type=float,
+                       default=_env("DPS_TELEMETRY_INTERVAL", 5.0, float),
+                       help="seconds between snapshot lines")
+        q.add_argument("--metrics-port", type=int,
+                       default=_env("DPS_METRICS_PORT", None, int),
+                       help="serve Prometheus /metrics + /healthz on this "
+                            "port (0 = pick a free port; omit = disabled)")
+
     def add_common(q):
         add_platform(q)
+        add_telemetry(q)
         q.add_argument("--lr", type=float,
                        default=_env("LEARNING_RATE", 0.1, float),
                        help="server SGD learning rate (server.py:413)")
@@ -199,6 +215,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "path the native core was built for), or "
                         "HBM-resident")
     add_platform(s)
+    add_telemetry(s)
 
     e = sub.add_parser("experiments",
                        help="run the sync/async x workers matrix "
@@ -245,6 +262,37 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+from contextlib import contextmanager
+
+
+@contextmanager
+def _telemetry_session(args, role: str):
+    """Start/stop the opt-in telemetry surfaces around a command body:
+    the periodic snapshot emitter (``--telemetry``) and the Prometheus
+    endpoint (``--metrics-port``). The emitter's final flush runs even on
+    failure — a crashed run still leaves its last complete totals in the
+    log (the round-5 bench lesson: never die with nothing written)."""
+    emitter = http_server = None
+    port = getattr(args, "metrics_port", None)
+    if port is not None:
+        from .telemetry import start_metrics_server
+        http_server, bound = start_metrics_server(port=port)
+        print(f"telemetry: serving /metrics on :{bound}", file=sys.stderr,
+              flush=True)
+    if getattr(args, "telemetry", False):
+        from .telemetry import SnapshotEmitter
+        emitter = SnapshotEmitter(
+            interval=getattr(args, "telemetry_interval", 5.0),
+            role=role).start()
+    try:
+        yield
+    finally:
+        if emitter is not None:
+            emitter.stop(final=True)
+        if http_server is not None:
+            http_server.shutdown()
+
+
 def _load_dataset(args):
     from .data import load_cifar100, synthetic_cifar100
     from .data.cifar import synthetic_imagenet
@@ -268,6 +316,11 @@ def _load_dataset(args):
 
 
 def cmd_train(args) -> int:
+    with _telemetry_session(args, "trainer"):
+        return _cmd_train(args)
+
+
+def _cmd_train(args) -> int:
     if getattr(args, "multihost", False):
         if args.mode != "sync":
             raise SystemExit("--multihost applies to --mode sync (async "
@@ -351,6 +404,11 @@ def cmd_train(args) -> int:
 
 
 def cmd_serve(args) -> int:
+    with _telemetry_session(args, "server"):
+        return _cmd_serve(args)
+
+
+def _cmd_serve(args) -> int:
     import time
 
     import jax
@@ -402,6 +460,11 @@ def cmd_serve(args) -> int:
 
 
 def cmd_worker(args) -> int:
+    with _telemetry_session(args, "worker"):
+        return _cmd_worker(args)
+
+
+def _cmd_worker(args) -> int:
     from .comms.client import RemoteStore
     from .models import get_model
     from .ps.worker import PSWorker, WorkerConfig
@@ -435,6 +498,11 @@ def cmd_worker(args) -> int:
 
 
 def cmd_experiments(args) -> int:
+    with _telemetry_session(args, "experiments"):
+        return _cmd_experiments(args)
+
+
+def _cmd_experiments(args) -> int:
     if args.ingest_pod:
         from .analysis.pod_logs import ingest_pod
 

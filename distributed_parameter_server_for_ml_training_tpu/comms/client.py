@@ -34,6 +34,8 @@ import time
 import grpc
 import numpy as np
 
+from ..telemetry import now as _tnow
+
 from .service import GRPC_OPTIONS, SERVICE_NAME, pack_msg, unpack_msg
 
 #: Transient codes worth retrying; anything else (e.g. INVALID_ARGUMENT,
@@ -114,24 +116,58 @@ class RemoteStore:
 
         self._push_nonce = uuid.uuid4().hex[:12]
         self._push_count = 0
+        # Live telemetry (telemetry/): per-RPC latency spans + wire byte
+        # counters into the process registry, alongside the run-local wire
+        # accounting above (wire_stats feeds METRICS_JSON exit rows; the
+        # registry feeds the live snapshot stream / Prometheus endpoint).
+        from ..telemetry import get_registry
+        reg = self._telemetry = get_registry()
+        self._tm_rpc: dict[str, tuple] = {}
+        for name in ["RegisterWorker", "PushGradrients", "FetchParameters",
+                     "JobFinished"]:
+            self._tm_rpc[name] = (
+                reg.histogram("dps_rpc_client_seconds", rpc=name),
+                reg.counter("dps_rpc_client_bytes_total", rpc=name,
+                            direction="out"),
+                reg.counter("dps_rpc_client_bytes_total", rpc=name,
+                            direction="in"),
+                reg.counter("dps_rpc_client_calls_total", rpc=name,
+                            outcome="ok"),
+                reg.counter("dps_rpc_client_calls_total", rpc=name,
+                            outcome="retry"),
+                reg.counter("dps_rpc_client_calls_total", rpc=name,
+                            outcome="error"),
+            )
 
     def _invoke(self, name: str, request: bytes):
         """Call RPC ``name`` with a deadline, retrying transient failures
         (RETRYABLE_CODES) up to ``rpc_retries`` times with exponential
         backoff. Non-transient codes raise immediately."""
+        hist, b_out, b_in, c_ok, c_retry, c_err = self._tm_rpc[name]
         delay = self.rpc_backoff
         for attempt in range(self.rpc_retries + 1):
+            t0 = _tnow()
             try:
                 reply = self._call[name](request, timeout=self.rpc_timeout)
+                hist.observe(_tnow() - t0)
                 with self._wire_lock:
                     self.wire_bytes_out += len(request)
                     self.wire_bytes_in += len(reply)
                     self.rpc_counts[name] = self.rpc_counts.get(name, 0) + 1
+                b_out.inc(len(request))
+                b_in.inc(len(reply))
+                c_ok.inc()
                 return reply
             except grpc.RpcError as e:
+                # Failed attempts record their latency too — a deadline
+                # expiry spent real wall time, and dropping it would bias
+                # the distribution toward the happy path.
+                hist.observe(_tnow() - t0)
                 code = e.code() if callable(getattr(e, "code", None)) else None
                 if attempt >= self.rpc_retries or code not in RETRYABLE_CODES:
+                    c_err.inc()
                     raise
+                c_retry.inc()
                 time.sleep(delay)
                 delay *= 2
 
@@ -157,12 +193,20 @@ class RemoteStore:
 
     def register_worker(self, worker_name: str = "") -> tuple[int, int]:
         """Retry x5 with exponential backoff (worker.py:215-229)."""
+        hist, b_out, b_in, c_ok, c_retry, c_err = \
+            self._tm_rpc["RegisterWorker"]
         delay = 1.0
         last_err = None
         for attempt in range(self.register_retries):
+            t0 = _tnow()
             try:
-                reply, _ = unpack_msg(self._call["RegisterWorker"](
-                    pack_msg({"worker_name": worker_name})))
+                request = pack_msg({"worker_name": worker_name})
+                raw = self._call["RegisterWorker"](request)
+                hist.observe(_tnow() - t0)
+                b_out.inc(len(request))
+                b_in.inc(len(raw))
+                c_ok.inc()
+                reply, _ = unpack_msg(raw)
                 self.push_codec = reply.get("push_codec", "none")
                 self.fetch_codec = reply.get("fetch_codec", "none")
                 self.config.elastic = bool(reply.get("elastic", False))
@@ -172,6 +216,13 @@ class RemoteStore:
                 self._note_membership(reply)
                 return int(reply["worker_id"]), int(reply["total_workers"])
             except grpc.RpcError as e:
+                hist.observe(_tnow() - t0)
+                # The LAST failed attempt is an error (the caller sees
+                # ConnectionError), not a retry — dashboards alert on it.
+                if attempt == self.register_retries - 1:
+                    c_err.inc()
+                else:
+                    c_retry.inc()
                 last_err = e
                 time.sleep(delay)
                 delay *= 2

@@ -74,6 +74,22 @@ class ParameterService:
         # wid -> [token, outcome (None while in flight), done event]
         self._push_seen: dict[int, list] = {}
         self._push_seen_lock = threading.Lock()
+        # Handler-side telemetry: per-RPC span + request/reply byte
+        # counters (telemetry/). Client-side spans (comms/client.py)
+        # include the wire + queueing; the delta between the two
+        # distributions in one snapshot stream IS the network cost.
+        from ..telemetry import get_registry
+        reg = get_registry()
+        self._tm_rpc = {
+            name: (reg.histogram("dps_rpc_handler_seconds", rpc=name),
+                   reg.counter("dps_rpc_handler_bytes_total", rpc=name,
+                               direction="in"),
+                   reg.counter("dps_rpc_handler_bytes_total", rpc=name,
+                               direction="out"),
+                   reg.counter("dps_rpc_handler_calls_total", rpc=name))
+            for name in ["RegisterWorker", "PushGradrients",
+                         "FetchParameters", "JobFinished"]
+        }
 
     # -- RPC bodies (request bytes -> reply bytes) --------------------------
 
@@ -165,6 +181,26 @@ class ParameterService:
 
     # -- wiring --------------------------------------------------------------
 
+    def _instrumented(self, name: str, fn):
+        """Wrap an RPC body with its span + byte counters. The span covers
+        the full handler (decode + store work + encode); durations record
+        even when the body raises/aborts — error handling time is real."""
+        from ..telemetry import now
+        hist, b_in, b_out, calls = self._tm_rpc[name]
+
+        def wrapped(request: bytes, ctx) -> bytes:
+            t0 = now()
+            b_in.inc(len(request))
+            calls.inc()
+            try:
+                reply = fn(request, ctx)
+            finally:
+                hist.observe(now() - t0)
+            b_out.inc(len(reply))
+            return reply
+
+        return wrapped
+
     def handlers(self) -> grpc.GenericRpcHandler:
         ident = lambda b: b  # noqa: E731 — bytes pass through untouched
         method_map = {
@@ -175,7 +211,8 @@ class ParameterService:
         }
         return grpc.method_handlers_generic_handler(SERVICE_NAME, {
             name: grpc.unary_unary_rpc_method_handler(
-                fn, request_deserializer=ident, response_serializer=ident)
+                self._instrumented(name, fn),
+                request_deserializer=ident, response_serializer=ident)
             for name, fn in method_map.items()
         })
 

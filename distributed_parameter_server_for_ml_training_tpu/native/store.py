@@ -31,11 +31,12 @@ import numpy as np
 
 
 from ..ops.compression import _SCALE_SUFFIX
-from ..ps.store import MembershipMixin, StoreConfig, _Stats
+from ..ps.store import MembershipMixin, StoreConfig, TelemetryMixin, _Stats
+from ..telemetry import now as _tnow
 from .bindings import _f32p, _i8p, _i64p, _u16p, load_library
 
 
-class NativeParameterStore(MembershipMixin):
+class NativeParameterStore(TelemetryMixin, MembershipMixin):
     """ParameterStore drop-in with the C++ core under the hot path."""
 
     store_backend = "native"
@@ -102,6 +103,7 @@ class NativeParameterStore(MembershipMixin):
         self._next_slot = 0
         self._pending: dict[int, int] = {}      # worker_id -> slot
         self._gradients_received = 0
+        self._init_telemetry()
 
     # -- properties mirroring ParameterStore ---------------------------------
 
@@ -139,6 +141,7 @@ class NativeParameterStore(MembershipMixin):
 
     def fetch(self, worker_id: int | None = None
               ) -> tuple[dict[str, np.ndarray], int]:
+        t0 = _tnow()
         flat, step = self._fetch_flat()
         if worker_id is not None:
             self.last_seen[worker_id] = time.time()
@@ -150,7 +153,10 @@ class NativeParameterStore(MembershipMixin):
         elif codec == "bf16":
             from .bindings import fp32_to_bf16
             flat = fp32_to_bf16(flat)
-        return self._unpack(flat), step
+        out = self._unpack(flat), step
+        self._tm_fetch_s.observe(_tnow() - t0)
+        self._tm_fetches.inc()
+        return out
 
     # -- checkpoint surface (same contract as AggregationBase.snapshot) ------
 
@@ -226,6 +232,15 @@ class NativeParameterStore(MembershipMixin):
 
     def push(self, worker_id: int, gradients: Mapping[str, np.ndarray],
              fetched_step: int) -> bool:
+        t_push = _tnow()
+        try:
+            return self._push_timed(worker_id, gradients, fetched_step)
+        finally:
+            self._tm_push_s.observe(_tnow() - t_push)
+
+    def _push_timed(self, worker_id: int,
+                    gradients: Mapping[str, np.ndarray],
+                    fetched_step: int) -> bool:
         self.last_seen[worker_id] = time.time()
         try:
             # Pack OUTSIDE any lock (pure host compaction) — and reject
@@ -234,6 +249,7 @@ class NativeParameterStore(MembershipMixin):
             packed = self._pack_push(gradients)
         except (ValueError, KeyError) as e:
             self.stats.gradients_rejected += 1
+            self._tm_push_rej.inc()
             print(f"rejecting push from worker {worker_id}: {e}")
             return False
         if self.config.mode == "sync":
@@ -242,6 +258,7 @@ class NativeParameterStore(MembershipMixin):
         t0 = time.time()
         bound = int(self.config.staleness_bound)
         before = self.global_step
+        self._tm_staleness.observe(before - int(fetched_step))
         if packed[0] == "int8":
             _, flat, scales = packed
             new_step = int(self._lib.dps_store_push_int8(
@@ -257,11 +274,16 @@ class NativeParameterStore(MembershipMixin):
                 self._handle, _f32p(packed[1]), int(fetched_step), bound))
         if new_step < 0:
             self.stats.gradients_rejected += 1
+            self._tm_push_rej.inc()
             return False
         self.stats.gradients_processed += 1
         self.stats.total_parameter_updates += 1
         self.stats.staleness_values.append(before - int(fetched_step))
-        self.stats.update_times.append(time.time() - t0)
+        dt = time.time() - t0
+        self.stats.update_times.append(dt)
+        self._tm_apply_s.observe(dt)
+        self._tm_push_ok.inc()
+        self._tm_step.set(new_step)
         return True
 
     # -- sync rounds (orchestration mirrors AggregationBase; _round_target
@@ -308,6 +330,7 @@ class NativeParameterStore(MembershipMixin):
                 self._gradients_received += 1
             self._maybe_complete_round_locked()
             self.stats.gradients_processed += 1
+        self._tm_push_ok.inc()
 
     def _maybe_complete_round_locked(self) -> None:
         if self._gradients_received >= self._round_target() and self._pending:
@@ -317,7 +340,11 @@ class NativeParameterStore(MembershipMixin):
                 self._lib.dps_store_apply_mean(
                     self._handle, _i64p(slots), len(slots))
                 self.stats.total_parameter_updates += 1
-                self.stats.update_times.append(time.time() - t0)
+                dt = time.time() - t0
+                self.stats.update_times.append(dt)
+                self._tm_apply_s.observe(dt)
+                self._tm_rounds.inc()
+                self._tm_step.set(self.global_step)
             finally:
                 # Workers that departed/expired while this round was still
                 # pending had their slot release deferred (their stash was a

@@ -22,6 +22,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
+from .mesh import shard_map
+
 _NEG_INF = -1e30
 
 
@@ -81,7 +83,7 @@ def make_ring_attention(mesh: Mesh, axis: str = "data",
     body = partial(ring_attention_local, axis_name=axis,
                    axis_size=axis_size, causal=causal)
     spec = P(None, axis)  # shard the T dimension
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+    fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     return jax.jit(fn)
 
@@ -264,7 +266,7 @@ def make_ring_flash_attention(mesh: Mesh, axis: str = "seq",
     local_ring.defvjp(fwd_rule, bwd_rule)
 
     spec = P(None, axis)
-    fn = jax.shard_map(local_ring, mesh=mesh, in_specs=(spec, spec, spec),
+    fn = shard_map(local_ring, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     return jax.jit(fn)
 

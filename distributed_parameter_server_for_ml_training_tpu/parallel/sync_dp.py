@@ -38,7 +38,7 @@ from ..data.cifar import augment_batch, standardize, to_float
 from ..ops.compression import compress_for_allreduce, decompress_from_allreduce
 from ..train.steps import cross_entropy_loss
 from ..train.train_state import TrainState
-from .mesh import DATA_AXIS
+from .mesh import DATA_AXIS, shard_map
 
 
 def _int8_ring_allreduce_mean(grads, axis: str, axis_size: int, seed):
@@ -191,7 +191,7 @@ def make_sync_dp_step(mesh: Mesh, *, axis: str = DATA_AXIS,
 
     metric_specs = {"loss": P(), "accuracy": P(),
                     "worker_loss": P(axis), "worker_accuracy": P(axis)}
-    sharded = jax.shard_map(
+    sharded = shard_map(
         worker_step,
         mesh=mesh,
         in_specs=(P(), P(axis), P(axis), P()),

@@ -39,6 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .store import AggregationBase, StoreConfig, _Stats
+from ..telemetry import now as _tnow
 
 
 @jax.jit
@@ -127,6 +128,7 @@ class DeviceParameterStore(AggregationBase):
 
         self.stats = _Stats()
         self._finished_event = threading.Event()
+        self._init_telemetry()
 
     # -- hot path ------------------------------------------------------------
 
@@ -135,11 +137,18 @@ class DeviceParameterStore(AggregationBase):
         """Consistent (params, step) snapshot — references, not copies
         (immutability makes the reference's copy-under-lock, server.py:222,
         free here)."""
+        t0 = _tnow()
         with self._param_lock:
             payload = dict(self.parameters)
             step = self.global_step
         if worker_id is not None:
             self.last_seen[worker_id] = time.time()
+        # NOTE: the span measures the dict-copy handoff (~us) — fetch here
+        # moves zero bytes by design, so this histogram is the proof, not
+        # the cost (compare against the python/native backends' ms-scale
+        # fetch distributions in the same snapshot stream).
+        self._tm_fetch_s.observe(_tnow() - t0)
+        self._tm_fetches.inc()
         return payload, step
 
     def push(self, worker_id: int, gradients: Mapping[str, jax.Array],
@@ -150,18 +159,23 @@ class DeviceParameterStore(AggregationBase):
         ps.proto:12): sync always accepts, async rejects past the staleness
         bound.
         """
+        t0 = _tnow()
         self.last_seen[worker_id] = time.time()
         for name, g in gradients.items():
             p = self.parameters.get(name)
             if p is not None and p.shape != g.shape:
                 self.stats.gradients_rejected += 1
+                self._tm_push_rej.inc()
                 print(f"rejecting push from worker {worker_id}: {name} "
                       f"shape {g.shape} != server {p.shape}")
                 return False
-        if self.config.mode == "sync":
-            self._push_sync(worker_id, dict(gradients))
-            return True
-        return self._push_async(worker_id, dict(gradients), fetched_step)
+        try:
+            if self.config.mode == "sync":
+                self._push_sync(worker_id, dict(gradients))
+                return True
+            return self._push_async(worker_id, dict(gradients), fetched_step)
+        finally:
+            self._tm_push_s.observe(_tnow() - t0)
 
     # -- aggregation kernels (orchestration in AggregationBase) --------------
 

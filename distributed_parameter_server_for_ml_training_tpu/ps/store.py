@@ -37,6 +37,7 @@ from typing import Mapping
 import numpy as np
 
 from ..ops.compression import fp16_compress, fp16_decompress
+from ..telemetry import now as _tnow
 from .semantics import (
     DEFAULT_STALENESS_BOUND,
     mean_gradients,
@@ -195,7 +196,43 @@ class MembershipMixin:
         return stale
 
 
-class AggregationBase(MembershipMixin):
+class TelemetryMixin:
+    """Store-side live instruments (telemetry/), shared by all three
+    backends (python, device, native). Instruments are created ONCE at
+    store construction and held as attributes — the registry dict is never
+    touched on the hot path (telemetry/registry.py constraint 1). A
+    process's stores of the same backend share instruments (identical
+    name+labels), so counters aggregate across them; the step gauge then
+    reports the most recent writer, which is what a live dashboard wants.
+    """
+
+    def _init_telemetry(self) -> None:
+        from ..telemetry import STALENESS_BUCKETS, get_registry
+        reg = get_registry()
+        b = self.store_backend
+        self._tm_push_s = reg.histogram("dps_store_push_seconds", backend=b)
+        self._tm_fetch_s = reg.histogram("dps_store_fetch_seconds",
+                                         backend=b)
+        self._tm_apply_s = reg.histogram("dps_store_apply_seconds",
+                                         backend=b)
+        self._tm_push_ok = reg.counter("dps_store_pushes_total", backend=b,
+                                       outcome="accepted")
+        self._tm_push_rej = reg.counter("dps_store_pushes_total", backend=b,
+                                        outcome="rejected")
+        self._tm_fetches = reg.counter("dps_store_fetches_total", backend=b)
+        # Observed for EVERY arriving async push (accepted or not): the
+        # arrival distribution is the signal adaptive-staleness policies
+        # need (PAPERS.md: ACE-Sync); stats.staleness_values keeps the
+        # reference's accepted-only semantics for the exit line.
+        self._tm_staleness = reg.histogram("dps_store_staleness_versions",
+                                           buckets=STALENESS_BUCKETS,
+                                           backend=b)
+        self._tm_step = reg.gauge("dps_store_global_step", backend=b)
+        self._tm_rounds = reg.counter("dps_store_sync_rounds_total",
+                                      backend=b)
+
+
+class AggregationBase(TelemetryMixin, MembershipMixin):
     """Sync-round / async-apply orchestration shared by every in-process
     store backend (host numpy, device HBM). Subclasses supply the three
     kernels — ``_mean(grad_dicts)``, ``_apply(grads, lr, weight)`` (must
@@ -245,6 +282,7 @@ class AggregationBase(MembershipMixin):
                 self._gradients_received += 1
             finish = self._maybe_complete_round_locked()
             self.stats.gradients_processed += 1
+        self._tm_push_ok.inc()
         if finish is not None:
             finish()
 
@@ -269,13 +307,18 @@ class AggregationBase(MembershipMixin):
                 # the server is wedged permanently.
                 self._pending.clear()
                 self._gradients_received = 0
+            self._tm_rounds.inc()
+            self._tm_step.set(self.global_step)
 
             def finish() -> None:
                 # _after_apply may decline to sync (sampled waits on the
                 # device store) — only record a timing that measured real
-                # completion, not async dispatch.
+                # completion, not async dispatch. The telemetry histogram
+                # mirrors the same honesty rule.
                 if self._after_apply() is not False:
-                    self.stats.update_times.append(time.time() - t0)
+                    dt = time.time() - t0
+                    self.stats.update_times.append(dt)
+                    self._tm_apply_s.observe(dt)
 
             return finish
         return None
@@ -311,20 +354,26 @@ class AggregationBase(MembershipMixin):
         """server.py:290-304 + 171-186: bounded staleness with down-weighted
         immediate apply."""
         staleness = self.global_step - fetched_step
+        self._tm_staleness.observe(staleness)
         if staleness > self.config.staleness_bound:
             self.stats.gradients_rejected += 1
+            self._tm_push_rej.inc()
             return False
         weight = staleness_weight(staleness)
         t0 = time.time()
         with self._param_lock:
             self._apply(grads, self.config.learning_rate, weight)
             self.global_step += 1
+        self._tm_step.set(self.global_step)
         measured = self._after_apply() is not False
         self.stats.gradients_processed += 1
         self.stats.total_parameter_updates += 1
         self.stats.staleness_values.append(staleness)
+        self._tm_push_ok.inc()
         if measured:
-            self.stats.update_times.append(time.time() - t0)
+            dt = time.time() - t0
+            self.stats.update_times.append(dt)
+            self._tm_apply_s.observe(dt)
         return True
 
     # -- checkpoint surface --------------------------------------------------
@@ -431,6 +480,7 @@ class ParameterStore(AggregationBase):
 
         self.stats = _Stats()
         self._finished_event = threading.Event()
+        self._init_telemetry()
 
     @property
     def push_codec(self) -> str:
@@ -451,6 +501,7 @@ class ParameterStore(AggregationBase):
         """Copy of the canonical params + current global step
         (server.py:213-237). Codec per config (reference: fp32, uncompressed).
         """
+        t0 = _tnow()
         with self._param_lock:
             payload = {k: v.copy() for k, v in self.parameters.items()}
             step = self.global_step
@@ -461,6 +512,8 @@ class ParameterStore(AggregationBase):
         elif self.config.fetch_codec == "bf16":
             from ..ops.compression import bf16_compress
             payload = bf16_compress(payload)
+        self._tm_fetch_s.observe(_tnow() - t0)
+        self._tm_fetches.inc()
         return payload, step
 
     def push(self, worker_id: int, gradients: Mapping[str, np.ndarray],
@@ -474,6 +527,15 @@ class ParameterStore(AggregationBase):
         Returns True iff the gradients were accepted (sync mode always
         accepts, matching PushReply(received=True), server.py:286-288).
         """
+        t0 = _tnow()
+        try:
+            return self._push_timed(worker_id, gradients, fetched_step)
+        finally:
+            self._tm_push_s.observe(_tnow() - t0)
+
+    def _push_timed(self, worker_id: int,
+                    gradients: Mapping[str, np.ndarray],
+                    fetched_step: int) -> bool:
         if self._push_codec == "fp16":
             gradients = fp16_decompress(gradients)
         elif self._push_codec == "int8":
@@ -492,6 +554,7 @@ class ParameterStore(AggregationBase):
             p = self.parameters.get(name)
             if p is not None and p.shape != g.shape:
                 self.stats.gradients_rejected += 1
+                self._tm_push_rej.inc()
                 print(f"rejecting push from worker {worker_id}: {name} "
                       f"shape {g.shape} != server {p.shape} (model/dataset "
                       f"mismatch?)")

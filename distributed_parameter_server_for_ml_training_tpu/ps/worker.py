@@ -24,6 +24,7 @@ import jax
 import numpy as np
 
 from ..data.cifar import Dataset, make_batches, shard_range
+from ..telemetry import now as _tnow
 from ..train.steps import make_eval_step, make_grad_step
 from ..utils.pytree import flatten_params, unflatten_params
 from .store import ParameterStore
@@ -171,11 +172,36 @@ class PSWorker(threading.Thread):
         lo, hi = shard_range(n, rank, total)
         return self.dataset.x_train[lo:hi], self.dataset.y_train[lo:hi]
 
+    def _init_telemetry(self, worker_id: int) -> None:
+        """Per-worker live instruments (telemetry/), labeled by worker id
+        so a multi-worker process's snapshot stream separates into
+        per-worker time-series. Created once, after registration (the id
+        IS the label)."""
+        from ..telemetry import get_registry
+        reg = get_registry()
+        w = str(worker_id)
+        self._tm_step_s = reg.histogram("dps_worker_step_seconds", worker=w)
+        self._tm_steps = reg.counter("dps_worker_steps_total", worker=w)
+        self._tm_epochs = reg.counter("dps_worker_epochs_total", worker=w)
+        self._tm_acc = reg.gauge("dps_worker_test_accuracy", worker=w)
+        # Payload bytes around the push codec: 'precodec' counts the fp32
+        # gradient payload, 'wire' what actually leaves after compression
+        # — the live per-worker form of the reference's one-off size log
+        # (worker.py:292), and the per-update byte accounting compression
+        # studies need (PAPERS.md).
+        self._tm_push_pre = reg.counter("dps_worker_push_bytes_total",
+                                        stage="precodec", worker=w)
+        self._tm_push_wire = reg.counter("dps_worker_push_bytes_total",
+                                         stage="wire", worker=w)
+        self._tm_fetch_post = reg.counter("dps_worker_fetch_bytes_total",
+                                          stage="postcodec", worker=w)
+
     def _run(self) -> None:
         cfg = self.config
         worker_id, total_workers = self.store.register_worker(self.worker_name)
         self.result.worker_id = worker_id
         self.result.worker_name = self.worker_name
+        self._init_telemetry(worker_id)
         if cfg.heartbeat_interval > 0:
             threading.Thread(
                 target=self._heartbeat_loop,
@@ -218,9 +244,16 @@ class PSWorker(threading.Thread):
                 if boundary and batch_idx > 0:
                     params, fetched_step = self._fetch_params(worker_id)
 
+                t_step = _tnow()
                 grads, batch_stats, loss, acc = self._grad_step(
                     params, batch_stats, xb, yb, rng,
                     self.result.local_steps_completed)
+                # Span = dispatch-to-return of the compiled step. Under jax
+                # async dispatch that can undercount device time on
+                # non-boundary batches; boundary steps (push/fetch) force
+                # completion, so the per-window totals stay honest.
+                self._tm_step_s.observe(_tnow() - t_step)
+                self._tm_steps.inc()
                 self.result.local_steps_completed += 1
 
                 if cfg.k_step_mode == "accumulate" and k > 1:
@@ -245,9 +278,11 @@ class PSWorker(threading.Thread):
                 accum, accum_n = None, 0
 
             self.result.epoch_times.append(time.time() - t_epoch)
+            self._tm_epochs.inc()
             if cfg.eval_each_epoch:
                 self.result.test_accuracies.append(
                     self.evaluate(params, batch_stats))
+                self._tm_acc.set(self.result.test_accuracies[-1])
             # Per-epoch progress line (the reference workers logged epochs
             # to CloudWatch, worker.py:329-335); run_wire_matrix's elastic
             # cell also keys its mid-run kill off this marker.
@@ -268,6 +303,11 @@ class PSWorker(threading.Thread):
             # set a second time per fetch for nothing).
             from ..ops.compression import fp16_decompress
             flat = fp16_decompress(flat)
+        if not getattr(self.store, "keeps_device_arrays", False):
+            # Decoded (fp32) payload bytes; the on-the-wire size lives in
+            # the RPC-layer counters (device stores move zero bytes — skip).
+            self._tm_fetch_post.inc(
+                sum(int(v.nbytes) for v in flat.values()))
         return unflatten_params(flat), fetched_step
 
     def _push_mean(self, worker_id, accum_tree, n: int,
@@ -285,6 +325,7 @@ class PSWorker(threading.Thread):
             flat = flatten_params(grads_tree, as_numpy=False)
         else:
             flat = flatten_params(jax.device_get(grads_tree))
+            pre_bytes = sum(int(v.nbytes) for v in flat.values())
             # Worker-side compression (worker.py:264-268): the store/service
             # advertises its codec; the encode happens here, once, before
             # the wire (fp16 = the reference's cast; int8 = per-tensor
@@ -296,6 +337,9 @@ class PSWorker(threading.Thread):
             elif codec == "int8":
                 from ..ops.compression import int8_wire_compress
                 flat = int8_wire_compress(flat)
+            self._tm_push_pre.inc(pre_bytes)
+            self._tm_push_wire.inc(
+                sum(int(v.nbytes) for v in flat.values()))
         if self.store.push(worker_id, flat, fetched_step):
             self.result.pushes_accepted += 1
         else:

@@ -1,0 +1,48 @@
+"""Live telemetry: registry + spans + snapshot stream + Prometheus endpoint.
+
+The layer SURVEY.md §5.5 couldn't have: the reference emitted one
+``METRICS_JSON`` line per process *at exit* and nothing before it. Here the
+hot paths (train step, push/fetch RPC client and handler, store aggregation
+in all three backends) record into a process-global
+:class:`~.registry.MetricsRegistry`, and two read surfaces expose it live:
+
+- :class:`~.snapshot.SnapshotEmitter` — periodic ``METRICS_JSON``
+  ``"kind": "snapshot"`` lines, same regex convention as the exit line, so
+  the existing ETL (`analysis/parse_logs.py`, CloudWatch-style scraping,
+  pod-log ssh collection) gains time-series without changes;
+- :func:`~.prometheus.start_metrics_server` — ``GET /metrics`` text
+  exposition + ``/healthz`` from the serving process.
+
+Metric names, bucket schemes, and the snapshot line format are documented
+in docs/OBSERVABILITY.md.
+"""
+
+from .registry import (
+    BYTES_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    LATENCY_BUCKETS_S,
+    MetricsRegistry,
+    STALENESS_BUCKETS,
+    get_registry,
+)
+from .snapshot import SnapshotEmitter
+from .spans import now, span
+from .prometheus import render_prometheus, start_metrics_server
+
+__all__ = [
+    "BYTES_BUCKETS",
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "LATENCY_BUCKETS_S",
+    "MetricsRegistry",
+    "STALENESS_BUCKETS",
+    "SnapshotEmitter",
+    "get_registry",
+    "now",
+    "render_prometheus",
+    "span",
+    "start_metrics_server",
+]

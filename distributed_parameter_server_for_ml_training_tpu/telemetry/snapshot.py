@@ -1,0 +1,104 @@
+"""Periodic registry flush as incremental ``METRICS_JSON`` snapshot lines.
+
+The reference's ETL regex-scrapes ``METRICS_JSON: {...}`` from process logs
+(parse_cloudwatch_logs.py:100); this emitter rides the SAME convention —
+``utils.metrics.emit_metrics_json`` prints the line — so every existing
+collection pipeline (CloudWatch filter, ``analysis/parse_logs.py``, pod-log
+ssh ingestion) picks up live time-series for free. Snapshot payloads are
+distinguished by ``"kind": "snapshot"``; the final-stats exit line has no
+``kind`` field, and :func:`..analysis.parse_logs.parse_experiment` filters
+snapshots out of the final aggregation so the reference schema is unchanged.
+
+Snapshot line shape::
+
+    METRICS_JSON: {"kind": "snapshot", "seq": 3, "ts": 1724...,
+                   "uptime_seconds": 15.2, "role": "server", "pid": 1234,
+                   "counters": {...}, "gauges": {...}, "histograms": {...}}
+
+Values are CUMULATIVE (counters monotonic since process start, histograms
+full bucket counts); consumers derive rates from consecutive-snapshot
+deltas (``analysis/parse_logs.py:build_telemetry_timeseries``). Cumulative
+beats per-interval deltas on a lossy transport: a dropped line costs one
+sample, not a permanently skewed running total.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import IO
+
+from ..utils.metrics import emit_metrics_json
+from .registry import MetricsRegistry, get_registry
+
+
+class SnapshotEmitter:
+    """Daemon thread flushing a registry every ``interval`` seconds.
+
+    ``proc`` labels (role, worker name, ...) are merged into every line so a
+    multi-process run's interleaved stdout remains attributable. ``stop()``
+    always emits one final snapshot — a run shorter than one interval still
+    leaves a complete record (the failure mode that cost round 5 its perf
+    number was exactly "process died, nothing written").
+    """
+
+    def __init__(self, registry: MetricsRegistry | None = None,
+                 interval: float = 5.0, role: str = "process",
+                 proc: dict | None = None, stream: IO | None = None,
+                 clock=time.time):
+        if interval <= 0:
+            raise ValueError(f"interval must be > 0, got {interval}")
+        self.registry = registry or get_registry()
+        self.interval = float(interval)
+        self.proc = {"role": role, "pid": os.getpid(), **(proc or {})}
+        self.stream = stream
+        self.clock = clock
+        self.seq = 0
+        self._t0 = clock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._emit_lock = threading.Lock()  # tick vs final-flush race
+
+    def emit_once(self) -> dict:
+        """Emit one snapshot line; returns the payload (tests, callers)."""
+        with self._emit_lock:
+            self.seq += 1
+            payload = {
+                "kind": "snapshot",
+                "seq": self.seq,
+                "ts": round(self.clock(), 3),
+                "uptime_seconds": round(self.clock() - self._t0, 3),
+                **self.proc,
+                **self.registry.snapshot(),
+            }
+            emit_metrics_json(payload, self.stream)
+            return payload
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval):
+            self.emit_once()
+
+    def start(self) -> "SnapshotEmitter":
+        if self._thread is not None:
+            raise RuntimeError("emitter already started")
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="telemetry-snapshot")
+        self._thread.start()
+        return self
+
+    def stop(self, final: bool = True) -> None:
+        """Stop the loop; ``final=True`` (default) flushes a last snapshot
+        so the stream always ends with the process's complete totals."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=max(2.0, self.interval))
+            self._thread = None
+        if final:
+            self.emit_once()
+
+    def __enter__(self) -> "SnapshotEmitter":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop(final=True)

@@ -138,6 +138,18 @@ class SyncTrainer:
                     print(f"resumed from step {self.global_steps} "
                           f"(epoch {start_epoch + 1})")
 
+        # Live telemetry (telemetry/): the sync trainer IS the whole
+        # server+workers deployment here, so one set of mode-labeled
+        # instruments gives the snapshot stream its throughput series.
+        from ..telemetry import get_registry, now as _tnow
+        reg = get_registry()
+        tm_step_s = reg.histogram("dps_trainer_step_seconds", mode="sync")
+        tm_steps = reg.counter("dps_trainer_steps_total", mode="sync")
+        tm_images = reg.counter("dps_trainer_images_total", mode="sync")
+        tm_epoch = reg.gauge("dps_trainer_epoch", mode="sync")
+        tm_acc = reg.gauge("dps_trainer_test_accuracy", mode="sync")
+        tm_gstep = reg.gauge("dps_store_global_step", backend="spmd")
+
         t_start = time.time()
         per_worker_epochs = []   # per epoch: {"loss": [N], "accuracy": [N]}
         for epoch in range(start_epoch, cfg.num_epochs):
@@ -148,14 +160,22 @@ class SyncTrainer:
                                        self.dataset.y_train, global_batch,
                                        seed=cfg.seed * 997 + epoch):
                 bi, bl = self._shard((xb, yb))
+                t_step = _tnow()
                 self.state, m = self._step(self.state, bi, bl, rng)
                 losses.append(m["loss"])
+                # Span = dispatch-to-return; appending m["loss"] keeps a
+                # handle the epoch print later forces, and the per-epoch
+                # wall time (t0 delta) bounds any async-dispatch slack.
+                tm_step_s.observe(_tnow() - t_step)
+                tm_steps.inc()
+                tm_images.inc(len(xb))
                 if not self.multihost:
                     # Multihost: the [N] vectors span processes and can't
                     # be fetched locally; per-worker rows stay derived.
                     wl.append(m["worker_loss"])
                     wa.append(m["worker_accuracy"])
                 self.global_steps += 1
+                tm_gstep.set(self.global_steps)
             if wl:
                 per_worker_epochs.append({
                     "loss": np.mean(np.asarray(wl, np.float32), axis=0),
@@ -170,6 +190,9 @@ class SyncTrainer:
                 acc = self.evaluate()
             self.epoch_times.append(time.time() - t0)
             self.test_accuracies.append(acc)
+            tm_epoch.set(epoch + 1)
+            if acc == acc:  # skip non-evaluating multihost ranks' NaN
+                tm_acc.set(acc)
             if jax.process_index() == 0:
                 print(f"[sync x{cfg.num_workers}] epoch {epoch + 1}: "
                       f"loss {float(np.mean([float(l) for l in losses])):.4f} "

@@ -400,8 +400,23 @@ def _write_summary() -> None:
             k: v for k, v in rec["wire"].items() if k != "repeats"},
             "final_acc": rec.get("worker_metrics_aggregated", {}).get(
                 "average_final_accuracy")})
-    with open(os.path.join(OUT, "wire_summary.json"), "w") as f:
-        json.dump({"cells": summary,
+    # Preserve non-cell keys written by other tools (e.g. the measured
+    # 16-worker host_limits record from experiments/probe_wire_scale.py) —
+    # a matrix re-run must refresh cells, not erase evidence.
+    extra = {}
+    summary_path = os.path.join(OUT, "wire_summary.json")
+    if os.path.exists(summary_path):
+        try:
+            with open(summary_path) as f:
+                extra = {k: v for k, v in json.load(f).items()
+                         if k not in ("cells", "topology", "methodology",
+                                      "caveat")}
+        except (OSError, json.JSONDecodeError) as e:
+            # A corrupt summary must not kill a finished matrix run — the
+            # rewrite below repairs it (only foreign keys are lost).
+            print(f"warning: unreadable {summary_path} ({e}); rewriting")
+    with open(summary_path, "w") as f:
+        json.dump({**extra, "cells": summary,
                    "topology": "1 serve + N worker OS processes, "
                                "localhost gRPC, --platform cpu",
                    "methodology": "each core cell repeated; columns are "
