@@ -181,7 +181,12 @@ def build_telemetry_timeseries(logs: str | Iterable[str]) -> dict:
             "counters": {key: [cumulative...]},
             "rates":    {key: [per-second, aligned to t[1:]]},
             "gauges":   {key: [...]},
-            "histograms_final": {key: {le, counts, sum, count}}}}}
+            "histograms_final": {key: {le, counts, sum, count}},
+            "pipeline": {  # only when comms-pipeline metrics were recorded
+                "not_modified_ratio": [...aligned to t...],
+                "queue_depth": {"worker-N": [...]},
+                "overlap_saved_seconds_total": float,
+                "overlap_windows": int}}}}
     """
     series = parse_snapshot_series(logs)
     procs = {}
@@ -192,7 +197,7 @@ def build_telemetry_timeseries(logs: str | Iterable[str]) -> dict:
             - float(snaps[0].get("uptime_seconds", 0.0))
         values, rates = _counter_series(snaps)
         gauge_names = sorted({k for s in snaps for k in s.get("gauges", {})})
-        procs[proc_key] = {
+        proc = {
             "role": snaps[0].get("role", "process"),
             "pid": snaps[0].get("pid", 0),
             "t": [round(float(s.get("ts", 0.0)) - t0, 3) for s in snaps],
@@ -202,7 +207,56 @@ def build_telemetry_timeseries(logs: str | Iterable[str]) -> dict:
                        for n in gauge_names},
             "histograms_final": dict(snaps[-1].get("histograms", {})),
         }
+        pipeline = _pipeline_series(proc)
+        if pipeline:
+            proc["pipeline"] = pipeline
+        procs[proc_key] = proc
     return {"procs": procs}
+
+
+def _pipeline_series(proc: dict) -> dict:
+    """Comms-pipeline evidence from one process's series (docs/
+    WIRE_PROTOCOL.md metrics): the delta-fetch not-modified ratio over
+    time, per-worker pipeline queue-depth series, and the total overlap
+    saving. Empty dict when the process recorded none of them."""
+    out: dict = {}
+    # Not-modified ratio: store-side NOT_MODIFIED replies over all fetches,
+    # cumulative per snapshot, summed across backends.
+    fetches = [0.0] * len(proc["t"])
+    not_mod = [0.0] * len(proc["t"])
+    saw_nm = False
+    for key, series in proc.get("counters", {}).items():
+        name, _ = _parse_metric_key(key)
+        if name == "dps_store_fetches_total":
+            fetches = [a + b for a, b in zip(fetches, series)]
+        elif name == "dps_store_fetch_not_modified_total":
+            saw_nm = True
+            not_mod = [a + b for a, b in zip(not_mod, series)]
+    if saw_nm:
+        out["not_modified_ratio"] = [
+            round(nm / f, 4) if f > 0 else 0.0
+            for nm, f in zip(not_mod, fetches)]
+    # Queue depth: one gauge series per overlapped worker.
+    depth = {}
+    for key, series in proc.get("gauges", {}).items():
+        name, labels = _parse_metric_key(key)
+        if name == "dps_worker_pipeline_depth":
+            depth[f"worker-{labels.get('worker', '?')}"] = series
+    if depth:
+        out["queue_depth"] = depth
+    # Overlap savings: final-histogram totals (seconds of comms hidden
+    # behind compute) summed across workers.
+    saved_s = 0.0
+    saved_n = 0
+    for key, hist in proc.get("histograms_final", {}).items():
+        name, _ = _parse_metric_key(key)
+        if name == "dps_worker_overlap_saved_seconds":
+            saved_s += float(hist.get("sum", 0.0))
+            saved_n += int(hist.get("count", 0))
+    if saved_n:
+        out["overlap_saved_seconds_total"] = round(saved_s, 6)
+        out["overlap_windows"] = saved_n
+    return out
 
 
 def worker_throughput_series(ts_record: dict) -> dict[str, dict]:

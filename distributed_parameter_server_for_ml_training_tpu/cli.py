@@ -131,6 +131,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="K-step local SGD interval (worker.py:468)")
     t.add_argument("--k-step-mode", choices=["faithful", "accumulate"],
                    default="faithful")
+    t.add_argument("--overlap", action="store_true",
+                   default=bool(_env("DPS_OVERLAP", 0, int)),
+                   help="overlapped comms pipeline (PS-store modes): "
+                        "push + prefetch on a background thread while the "
+                        "training thread computes; identical RPC sequence "
+                        "to the serial loop, pays off with --sync-steps>1 "
+                        "(docs/WIRE_PROTOCOL.md)")
+    t.add_argument("--no-delta-fetch", action="store_true",
+                   help="disable version-gated delta fetches (have_step/"
+                        "NOT_MODIFIED handshake); full params on every "
+                        "fetch, reference parity")
     t.add_argument("--compression", choices=["none", "bf16", "fp16", "int8"],
                    default="bf16",
                    help="sync all-reduce precision (int8 = quantized "
@@ -257,6 +268,14 @@ def build_parser() -> argparse.ArgumentParser:
     w.add_argument("--heartbeat", type=float, default=0.0,
                    help="liveness ping interval in seconds (pair with the "
                         "server's --worker-timeout); 0 disables")
+    w.add_argument("--overlap", action="store_true",
+                   default=bool(_env("DPS_OVERLAP", 0, int)),
+                   help="overlapped comms pipeline: push + prefetch on a "
+                        "background thread while compute runs; pays off "
+                        "with --sync-steps>1 (docs/WIRE_PROTOCOL.md)")
+    w.add_argument("--no-delta-fetch", action="store_true",
+                   help="disable version-gated delta fetches (full params "
+                        "on every fetch, reference parity)")
     add_common(w)
 
     return p
@@ -391,6 +410,7 @@ def _cmd_train(args) -> int:
         staleness_bound=args.staleness_bound, compression=args.compression,
         strict_rounds=args.strict_rounds, elastic=args.elastic,
         worker_timeout=args.worker_timeout,
+        overlap=args.overlap, delta_fetch=not args.no_delta_fetch,
         store_backend=args.store_backend, augment=not args.no_augment,
         dtype=args.dtype, model=args.model, num_classes=num_classes,
         seed=args.seed)
@@ -483,7 +503,9 @@ def _cmd_worker(args) -> int:
                        sync_steps=args.sync_steps,
                        k_step_mode=args.k_step_mode,
                        augment=not args.no_augment, seed=args.seed,
-                       heartbeat_interval=args.heartbeat)
+                       heartbeat_interval=args.heartbeat,
+                       overlap=args.overlap,
+                       delta_fetch=not args.no_delta_fetch)
     worker = PSWorker(store, model, dataset, cfg,
                       worker_name=args.worker_name)
     worker.start()

@@ -91,6 +91,11 @@ class RemoteStore:
         #: (worker.py:264-268) and decompress after fetch.
         self.push_codec = "none"
         self.fetch_codec = "none"
+        #: True once the server advertises the delta-fetch capability at
+        #: registration; fetch(have_step=...) is only sent when set (an old
+        #: server would silently ignore the field and ship the full model,
+        #: which is correct but wasteful — gating keeps intent explicit).
+        self.supports_delta_fetch = False
         self.config = _RemoteConfig()
         # Last membership seen on the wire (elastic servers piggyback it on
         # Register/Fetch replies). Workers fetch at least once per K-step
@@ -138,6 +143,10 @@ class RemoteStore:
                 reg.counter("dps_rpc_client_calls_total", rpc=name,
                             outcome="error"),
             )
+        # Delta-fetch replies answered NOT_MODIFIED (header-only) — the
+        # client-side twin of dps_store_fetch_not_modified_total.
+        self._tm_fetch_nm = reg.counter(
+            "dps_rpc_client_fetch_not_modified_total")
 
     def _invoke(self, name: str, request: bytes):
         """Call RPC ``name`` with a deadline, retrying transient failures
@@ -209,6 +218,8 @@ class RemoteStore:
                 reply, _ = unpack_msg(raw)
                 self.push_codec = reply.get("push_codec", "none")
                 self.fetch_codec = reply.get("fetch_codec", "none")
+                self.supports_delta_fetch = bool(
+                    reply.get("delta_fetch", False))
                 self.config.elastic = bool(reply.get("elastic", False))
                 self.config.mode = reply.get("mode", "sync")
                 self.config.learning_rate = float(
@@ -230,13 +241,24 @@ class RemoteStore:
             f"registration failed after {self.register_retries} attempts: "
             f"{last_err}")
 
-    def fetch(self, worker_id: int | None = None
+    def fetch(self, worker_id: int | None = None,
+              have_step: int | None = None
               ) -> tuple[dict[str, np.ndarray], int]:
+        """Fetch params (+ step). With ``have_step`` (and a server that
+        advertised ``delta_fetch``), a server whose step hasn't advanced
+        replies NOT_MODIFIED — returned as ``({}, step)`` with
+        ``step == have_step`` — and the caller keeps its current params;
+        the round trip costs a header instead of the full model."""
         from .wire import decode_tensor_dict
         meta = {} if worker_id is None else {"worker_id": worker_id}
+        if have_step is not None and self.supports_delta_fetch:
+            meta["have_step"] = int(have_step)
         reply = self._invoke("FetchParameters", pack_msg(meta))
         rmeta, payload = unpack_msg(reply)
         self._note_membership(rmeta)
+        if rmeta.get("not_modified"):
+            self._tm_fetch_nm.inc()
+            return {}, int(rmeta["global_step"])
         params = decode_tensor_dict(payload)
         if self.fetch_codec == "fp16":
             # serve --fetch-codec: the server halves the params-in wire

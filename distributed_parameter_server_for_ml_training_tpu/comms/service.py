@@ -48,10 +48,15 @@ def pack_msg(meta: dict, payload: bytes = b"") -> bytes:
     return struct.pack("<I", len(header)) + header + payload
 
 
-def unpack_msg(data: bytes) -> tuple[dict, bytes]:
+def unpack_msg(data: bytes) -> tuple[dict, memoryview]:
+    """Split the envelope WITHOUT copying the payload: the returned
+    memoryview aliases ``data``, and the zero-copy tensor decode
+    (comms/wire.py) builds array views directly over it — bytes-slicing
+    here used to cost one full-payload copy per message."""
     (hlen,) = struct.unpack_from("<I", data, 0)
-    meta = json.loads(data[4:4 + hlen].decode("utf-8"))
-    return meta, data[4 + hlen:]
+    mv = memoryview(data)
+    meta = json.loads(bytes(mv[4:4 + hlen]).decode("utf-8"))
+    return meta, mv[4 + hlen:]
 
 
 class ParameterService:
@@ -118,6 +123,13 @@ class ParameterService:
             "mode": self.store.config.mode,
             "learning_rate": self.store.config.learning_rate,
             "elastic": bool(getattr(self.store.config, "elastic", False)),
+            # Delta-fetch capability (docs/WIRE_PROTOCOL.md): clients may
+            # send ``have_step`` on FetchParameters and must then handle a
+            # NOT_MODIFIED reply. Advertised so old clients (which never
+            # send have_step) and new clients against old servers (which
+            # would ignore it) both keep working.
+            "delta_fetch": bool(getattr(self.store, "supports_delta_fetch",
+                                        False)),
             **self._membership_fields(),
         })
 
@@ -169,8 +181,21 @@ class ParameterService:
 
     def fetch_parameters(self, request: bytes, ctx) -> bytes:
         meta, _ = unpack_msg(request)
-        wid = meta.get("worker_id")
-        params, step = self.store.fetch(None if wid is None else int(wid))
+        wid = None if meta.get("worker_id") is None \
+            else int(meta["worker_id"])
+        have = meta.get("have_step")
+        if have is not None \
+                and getattr(self.store, "supports_delta_fetch", False):
+            params, step = self.store.fetch(wid, have_step=int(have))
+            if not params and step == int(have):
+                # Version-gated delta fetch: the canonical step hasn't
+                # advanced past what the client holds — the reply costs a
+                # header instead of the full model (the straggler-wait /
+                # polling fetch win; docs/WIRE_PROTOCOL.md).
+                return pack_msg({"global_step": step, "not_modified": True,
+                                 **self._membership_fields()})
+        else:
+            params, step = self.store.fetch(wid)
         return pack_msg({"global_step": step, **self._membership_fields()},
                         encode_tensor_dict(params))
 

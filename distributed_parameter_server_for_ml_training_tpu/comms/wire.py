@@ -1,14 +1,44 @@
-"""Tensor-dict wire format.
+"""Tensor-dict wire format: versioned, zero-copy, chunkable.
 
 The reference pickles ``{name: np.ndarray}`` dicts onto the wire
 (worker.py:289, server.py:222) — simple but unsafe (pickle executes code) and
 Python-bound. This codec keeps the same logical payload with a safe,
-language-neutral layout, so a future C++/other-host peer can speak it:
+language-neutral layout, so a future C++/other-host peer can speak it.
 
-    [u32 header_len][header JSON utf-8][raw buffer 0][raw buffer 1]...
+Frame v2 (current)::
+
+    [u8 0xD5 magic][u8 version=2][u8 flags][u8 reserved]
+    [u32 header_len LE][header JSON utf-8][raw buffer 0][raw buffer 1]...
 
 header: {"tensors": [{"name": str, "dtype": str, "shape": [int...]}...]}
 Buffers are C-contiguous little-endian, concatenated in header order.
+flags bit 0 marks a CHUNK frame (see *Chunked framing* below).
+
+Frame v1 (legacy, still decoded)::
+
+    [u32 header_len LE][header JSON utf-8][raw buffer 0]...
+
+Copy discipline — the host-side cost THC and the gradient-compression
+studies (PAPERS.md) identify as the post-codec bottleneck:
+
+- **encode**: exactly ONE copy per tensor — each buffer is memcpy'd once
+  into the output frame by ``bytes.join`` over buffer views (the previous
+  codec paid ``tobytes()`` + ``join`` = two copies). A non-contiguous
+  input costs one extra copy to make it contiguous. The
+  :func:`set_copy_count_hook` test hook counts every buffer copy so the
+  single-copy invariant is pinned by a tier-1 test.
+- **decode**: ZERO copies — tensors are ``np.frombuffer`` views into
+  memoryview slices of the payload (read-only when the payload is
+  ``bytes``; the payload stays alive via the arrays' ``.base``). Callers
+  that must mutate in place pass ``copy=True``.
+
+Chunked framing: payloads near the gRPC message ceiling (500 MB here,
+GRPC_OPTIONS) can be encoded as N self-describing chunk frames
+(:func:`encode_tensor_dict_chunks`) carried as separate messages by a
+streaming transport and reassembled by
+:func:`decode_tensor_dict_chunks`. Chunk boundaries prefer tensor
+boundaries, so reassembly stays zero-copy unless a single tensor is
+bigger than the chunk budget (only the spanning tensors are copied).
 
 fp16 gradient compression (worker.py:264-268) composes naturally: cast the
 arrays before encoding and the wire carries half the bytes.
@@ -17,11 +47,27 @@ arrays before encoding and the wire carries half the bytes.
 from __future__ import annotations
 
 import json
+import math
 import struct
-from typing import Mapping
+from typing import Callable, Mapping
 
 import ml_dtypes  # ships with jax; provides the numpy bfloat16 dtype
 import numpy as np
+
+#: First byte of every v2+ frame. v1 frames start with the low byte of
+#: their u32 header length instead; decode disambiguates by checking that
+#: a v1 header begins with '{' at offset 4.
+WIRE_MAGIC = 0xD5
+WIRE_VERSION = 2
+FLAG_CHUNK = 0x01
+
+_PREAMBLE = 4  # magic + version + flags + reserved
+
+#: Upper bound on the JSON tensor table. A real table is ~100 bytes per
+#: tensor; 16 MiB is orders of magnitude past any real model and small
+#: enough that a corrupt/hostile length field can't trigger a giant
+#: allocation before validation.
+MAX_HEADER_BYTES = 16 << 20
 
 _ALLOWED_DTYPES = {
     "float16", "float32", "float64", "bfloat16",
@@ -29,50 +75,298 @@ _ALLOWED_DTYPES = {
     "uint8", "uint16", "uint32", "uint64", "bool",
 }
 
+# -- copy accounting (tier-1 zero-copy guard) --------------------------------
 
-def _resolve_dtype(name: str) -> np.dtype:
-    if name == "bfloat16":
-        return np.dtype(ml_dtypes.bfloat16)
-    return np.dtype(name)
+_copy_hook: Callable[[str, str], None] | None = None
+
+
+def set_copy_count_hook(hook: Callable[[str, str], None] | None):
+    """Install ``hook(tensor_name, reason)`` called once per buffer copy the
+    encode path performs (reasons: ``'make_contiguous'``, ``'frame_write'``).
+    Returns the previous hook. Tests use this to pin the at-most-one-copy
+    invariant; pass ``None`` to uninstall."""
+    global _copy_hook
+    prev, _copy_hook = _copy_hook, hook
+    return prev
+
+
+def _note_copy(name: str, reason: str) -> None:
+    if _copy_hook is not None:
+        _copy_hook(name, reason)
+
+
+# -- encode ------------------------------------------------------------------
+
+def _buffer_view(arr: np.ndarray) -> memoryview:
+    """Raw little-endian bytes of a C-contiguous array, WITHOUT copying.
+
+    Routed through a uint8 view because custom dtypes (bfloat16) don't
+    export a standard buffer format; reshape(-1) first so 0-d arrays view
+    cleanly."""
+    return memoryview(arr.reshape(-1).view(np.uint8))
+
+
+def _prepare(tensors: Mapping[str, np.ndarray]) -> tuple[list, list]:
+    """Validate + normalize to (metas, contiguous arrays)."""
+    metas, arrays = [], []
+    for name, arr in tensors.items():
+        a = np.asarray(arr)
+        if not a.flags.c_contiguous:
+            a = np.ascontiguousarray(a)
+            _note_copy(str(name), "make_contiguous")
+        dtype = a.dtype.name
+        if dtype not in _ALLOWED_DTYPES:
+            raise ValueError(f"unsupported dtype {dtype} for {name!r}")
+        metas.append({"name": str(name), "dtype": dtype,
+                      "shape": list(a.shape)})
+        arrays.append(a)
+    return metas, arrays
+
+
+def _frame(header_obj: dict, bodies: list, flags: int = 0) -> bytes:
+    """Assemble one v2 frame. ``bodies`` are buffer-protocol objects; each
+    is copied exactly once by the final join."""
+    header = json.dumps(header_obj).encode("utf-8")
+    preamble = struct.pack("<BBBBI", WIRE_MAGIC, WIRE_VERSION, flags, 0,
+                           len(header))
+    return b"".join([preamble, header, *bodies])
 
 
 def encode_tensor_dict(tensors: Mapping[str, np.ndarray]) -> bytes:
-    metas = []
-    buffers = []
-    for name, arr in tensors.items():
-        arr = np.ascontiguousarray(arr)
-        dtype = arr.dtype.name
-        if dtype not in _ALLOWED_DTYPES:
-            raise ValueError(f"unsupported dtype {dtype} for {name!r}")
-        metas.append({"name": name, "dtype": dtype,
-                      "shape": list(arr.shape)})
-        buffers.append(arr.tobytes())
-    header = json.dumps({"tensors": metas}).encode("utf-8")
-    return b"".join([struct.pack("<I", len(header)), header, *buffers])
+    """Encode to a single v2 frame (one buffer copy per tensor)."""
+    metas, arrays = _prepare(tensors)
+    for m, a in zip(metas, arrays):
+        if a.nbytes:
+            _note_copy(m["name"], "frame_write")
+    return _frame({"tensors": metas}, [_buffer_view(a) for a in arrays])
 
 
-def decode_tensor_dict(payload: bytes) -> dict[str, np.ndarray]:
-    if len(payload) < 4:
+def encode_tensor_dict_chunks(tensors: Mapping[str, np.ndarray],
+                              max_chunk_bytes: int) -> list[bytes]:
+    """Encode as N chunk frames, each body at most ``max_chunk_bytes``.
+
+    Chunk 0's header carries the tensor table + total payload length; every
+    chunk's header carries ``{"chunk": {"index", "total", "offset"}}``.
+    Splits land on tensor boundaries when possible (zero-copy reassembly);
+    a tensor larger than the budget is hard-split mid-buffer.
+    """
+    if max_chunk_bytes < 1:
+        raise ValueError(f"max_chunk_bytes must be >= 1, got "
+                         f"{max_chunk_bytes}")
+    metas, arrays = _prepare(tensors)
+    # Cut the logical buffer section into per-chunk segment lists.
+    chunks: list[list] = [[]]
+    sizes = [0]
+    for m, a in zip(metas, arrays):
+        if not a.nbytes:
+            continue  # zero-element tensors occupy no buffer bytes
+        _note_copy(m["name"], "frame_write")
+        view = _buffer_view(a)
+        pos = 0
+        while pos < a.nbytes:
+            room = max_chunk_bytes - sizes[-1]
+            if room == 0:
+                chunks.append([])
+                sizes.append(0)
+                continue
+            take = min(room, a.nbytes - pos)
+            # Prefer starting a fresh chunk over splitting a tensor that
+            # would fit whole in an empty one.
+            if pos == 0 and take < a.nbytes and a.nbytes <= max_chunk_bytes:
+                chunks.append([])
+                sizes.append(0)
+                continue
+            chunks[-1].append(view[pos:pos + take])
+            sizes[-1] += take
+            pos += take
+    total_payload = sum(sizes)
+    frames = []
+    offset = 0
+    for i, (bodies, size) in enumerate(zip(chunks, sizes)):
+        header: dict = {"chunk": {"index": i, "total": len(chunks),
+                                  "offset": offset}}
+        if i == 0:
+            header["tensors"] = metas
+            header["payload_len"] = total_payload
+        frames.append(_frame(header, bodies, flags=FLAG_CHUNK))
+        offset += size
+    return frames
+
+
+# -- decode ------------------------------------------------------------------
+
+def _parse_frame(payload) -> tuple[dict, memoryview, int]:
+    """-> (header dict, body memoryview, flags). Accepts v2 and legacy v1
+    frames; validates the header length BEFORE any allocation sized by it."""
+    mv = memoryview(payload)
+    if len(mv) < 4:
         raise ValueError("truncated payload")
-    (hlen,) = struct.unpack_from("<I", payload, 0)
-    header_end = 4 + hlen
-    if header_end > len(payload):
+    # Disambiguation order matters: a LEGACY v1 frame whose u32 header_len
+    # happens to be 0x...02D5 (e.g. exactly 725 — a realistic JSON table
+    # size) also starts with [0xD5, 0x02]. A v2 frame's header JSON always
+    # begins '{' at offset 8; a v1 frame's always begins '{' at offset 4 —
+    # and a v1 header can't have '{' at BOTH (offset 8 is char 4 of
+    # '{"tensors...', i.e. 'n'), so checking the v2 position first is
+    # unambiguous for every frame either encoder ever produced.
+    if (mv[0] == WIRE_MAGIC and mv[1] == WIRE_VERSION
+            and len(mv) >= _PREAMBLE + 5 and mv[_PREAMBLE + 4] == 0x7B):
+        flags, header_off = mv[2], _PREAMBLE
+    elif len(mv) >= 5 and mv[4] == 0x7B:  # '{' at offset 4 => legacy v1
+        flags, header_off = 0, 0
+    elif mv[0] == WIRE_MAGIC and mv[1] != WIRE_VERSION:
+        raise ValueError(f"unsupported wire version {mv[1]}")
+    else:
+        flags, header_off = 0, 0  # let the v1 length checks reject it
+    if len(mv) < header_off + 4:
+        raise ValueError("truncated payload")
+    (hlen,) = struct.unpack_from("<I", payload, header_off)
+    if hlen > MAX_HEADER_BYTES:
+        raise ValueError(f"header_len {hlen} exceeds cap {MAX_HEADER_BYTES}")
+    header_end = header_off + 4 + hlen
+    if header_end > len(mv):
         raise ValueError("truncated header")
-    header = json.loads(payload[4:header_end].decode("utf-8"))
+    try:
+        header = json.loads(bytes(mv[header_off + 4:header_end])
+                            .decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise ValueError(f"bad frame header: {e}") from e
+    if not isinstance(header, dict):
+        raise ValueError("bad frame header: not an object")
+    return header, mv[header_end:], flags
+
+
+def _tensor_extent(meta: dict) -> tuple[np.dtype, tuple, int]:
+    """Validated (dtype, shape, nbytes) from one header entry. Rejects
+    NaN/float/negative/bool dims and unknown dtypes before any allocation;
+    the size product is computed in unbounded Python ints, so it cannot
+    overflow into a small bogus value."""
+    dtype = meta.get("dtype")
+    if dtype not in _ALLOWED_DTYPES:
+        raise ValueError(f"unsupported dtype {dtype}")
+    dt = np.dtype(ml_dtypes.bfloat16) if dtype == "bfloat16" \
+        else np.dtype(dtype)
+    raw_shape = meta.get("shape", [])
+    if not isinstance(raw_shape, list):
+        raise ValueError(f"bad shape {raw_shape!r} for {meta.get('name')!r}")
+    for s in raw_shape:
+        if isinstance(s, bool) or not isinstance(s, int) or s < 0:
+            raise ValueError(
+                f"bad shape dim {s!r} for {meta.get('name')!r}")
+    shape = tuple(raw_shape)
+    return dt, shape, dt.itemsize * math.prod(shape)
+
+
+def _tensors_from_body(header: dict, body: memoryview,
+                       copy: bool) -> dict[str, np.ndarray]:
+    metas = header.get("tensors")
+    if not isinstance(metas, list):
+        raise ValueError("bad frame header: missing tensor table")
     out: dict[str, np.ndarray] = {}
-    offset = header_end
-    for meta in header["tensors"]:
-        dtype = meta["dtype"]
-        if dtype not in _ALLOWED_DTYPES:
-            raise ValueError(f"unsupported dtype {dtype}")
-        dt = _resolve_dtype(dtype)
-        shape = tuple(int(s) for s in meta["shape"])
-        nbytes = dt.itemsize * int(np.prod(shape, dtype=np.int64)) if shape \
-            else dt.itemsize
+    offset = 0
+    for meta in metas:
+        dt, shape, nbytes = _tensor_extent(meta)
         end = offset + nbytes
-        if end > len(payload):
-            raise ValueError(f"truncated buffer for {meta['name']!r}")
-        arr = np.frombuffer(payload[offset:end], dtype=dt).reshape(shape)
-        out[str(meta["name"])] = arr.copy()  # own the memory
+        if end > len(body):
+            raise ValueError(f"truncated buffer for {meta.get('name')!r}")
+        arr = np.frombuffer(body[offset:end], dtype=dt).reshape(shape)
+        out[str(meta.get("name"))] = arr.copy() if copy else arr
         offset = end
+    return out
+
+
+def decode_tensor_dict(payload, *, copy: bool = False
+                       ) -> dict[str, np.ndarray]:
+    """Decode one frame (v2 or legacy v1) to ``{name: ndarray}``.
+
+    Default is ZERO-COPY: arrays are read-only views into ``payload``
+    (which stays alive via ``.base``). ``copy=True`` returns owned,
+    writable arrays instead."""
+    header, body, flags = _parse_frame(payload)
+    if flags & FLAG_CHUNK:
+        raise ValueError("chunk frame: use decode_tensor_dict_chunks")
+    return _tensors_from_body(header, body, copy)
+
+
+def is_chunk_frame(payload) -> bool:
+    """True iff ``payload`` is a v2 chunk frame (cheap preamble check)."""
+    mv = memoryview(payload)
+    return (len(mv) >= _PREAMBLE and mv[0] == WIRE_MAGIC
+            and mv[1] == WIRE_VERSION and bool(mv[2] & FLAG_CHUNK))
+
+
+def decode_tensor_dict_chunks(frames, *, copy: bool = False
+                              ) -> dict[str, np.ndarray]:
+    """Reassemble chunk frames (any order) and decode.
+
+    Tensors contained within a single chunk decode as zero-copy views of
+    that chunk; only tensors spanning a chunk boundary are stitched into
+    fresh buffers."""
+    parsed: dict[int, tuple[dict, memoryview]] = {}
+    total = None
+    for frame in frames:
+        header, body, flags = _parse_frame(frame)
+        if not flags & FLAG_CHUNK:
+            raise ValueError("not a chunk frame; use decode_tensor_dict")
+        info = header.get("chunk")
+        if not isinstance(info, dict):
+            raise ValueError("chunk frame missing chunk descriptor")
+        idx, n = int(info["index"]), int(info["total"])
+        if total is None:
+            total = n
+        elif n != total:
+            raise ValueError(f"inconsistent chunk totals ({n} vs {total})")
+        if idx in parsed:
+            raise ValueError(f"duplicate chunk {idx}")
+        parsed[idx] = (header, body)
+    if total is None or sorted(parsed) != list(range(total)):
+        raise ValueError(
+            f"incomplete chunk set: have {sorted(parsed)} of {total}")
+    head = parsed[0][0]
+    metas = head.get("tensors")
+    if not isinstance(metas, list):
+        raise ValueError("chunk 0 missing tensor table")
+    payload_len = head.get("payload_len")
+    # Segment table: (logical start, body) in order, offsets contiguous.
+    segments = []
+    offset = 0
+    for i in range(total):
+        header, body = parsed[i]
+        if int(header["chunk"].get("offset", -1)) != offset:
+            raise ValueError(f"chunk {i} offset mismatch")
+        segments.append((offset, body))
+        offset += len(body)
+    if payload_len is not None and offset != int(payload_len):
+        raise ValueError(
+            f"chunk payload length {offset} != declared {payload_len}")
+
+    out: dict[str, np.ndarray] = {}
+    pos = 0
+    seg_i = 0
+    for meta in metas:
+        dt, shape, nbytes = _tensor_extent(meta)
+        end = pos + nbytes
+        if end > offset:
+            raise ValueError(f"truncated buffer for {meta.get('name')!r}")
+        # Advance to the segment containing pos.
+        while seg_i + 1 < len(segments) and segments[seg_i + 1][0] <= pos:
+            seg_i += 1
+        seg_start, seg_body = segments[seg_i]
+        if end <= seg_start + len(seg_body) or nbytes == 0:
+            raw = seg_body[pos - seg_start:end - seg_start]
+            arr = np.frombuffer(raw, dtype=dt).reshape(shape)
+            out[str(meta.get("name"))] = arr.copy() if copy else arr
+        else:  # spans chunks: stitch (the only copying reassembly path)
+            buf = bytearray(nbytes)
+            filled = 0
+            j = seg_i
+            while filled < nbytes:
+                s_start, s_body = segments[j]
+                lo = pos + filled - s_start
+                take = min(len(s_body) - lo, nbytes - filled)
+                buf[filled:filled + take] = s_body[lo:lo + take]
+                filled += take
+                j += 1
+            out[str(meta.get("name"))] = np.frombuffer(
+                bytes(buf), dtype=dt).reshape(shape)
+        pos = end
     return out

@@ -220,6 +220,11 @@ class TelemetryMixin:
         self._tm_push_rej = reg.counter("dps_store_pushes_total", backend=b,
                                         outcome="rejected")
         self._tm_fetches = reg.counter("dps_store_fetches_total", backend=b)
+        # Version-gated delta fetches answered with an empty NOT_MODIFIED
+        # payload (fetch(have_step=...) when the step hasn't advanced) —
+        # the not-modified ratio is this over dps_store_fetches_total.
+        self._tm_fetch_nm = reg.counter("dps_store_fetch_not_modified_total",
+                                        backend=b)
         # Observed for EVERY arriving async push (accepted or not): the
         # arrival distribution is the signal adaptive-staleness policies
         # need (PAPERS.md: ACE-Sync); stats.staleness_values keeps the
@@ -242,6 +247,12 @@ class AggregationBase(TelemetryMixin, MembershipMixin):
     """
 
     store_backend = "python"
+
+    #: Whether fetch() accepts ``have_step`` and answers NOT_MODIFIED
+    #: (empty payload) when the canonical step hasn't advanced. Backends
+    #: that can't check the step without materializing the payload (the
+    #: native C++ arena's seqlock fetch) leave this False.
+    supports_delta_fetch = False
 
     def _mean(self, grad_dicts: list) -> dict:
         raise NotImplementedError
@@ -496,17 +507,39 @@ class ParameterStore(AggregationBase):
 
     # -- lifecycle (register/finish/expire inherited) ----------------- ps.proto:8
 
-    def fetch(self, worker_id: int | None = None
+    supports_delta_fetch = True
+
+    def fetch(self, worker_id: int | None = None,
+              have_step: int | None = None
               ) -> tuple[dict[str, np.ndarray], int]:
         """Copy of the canonical params + current global step
         (server.py:213-237). Codec per config (reference: fp32, uncompressed).
+
+        ``have_step`` opts into the version-gated delta protocol: when it
+        equals the canonical step, the reply is NOT_MODIFIED — ``({}, step)``
+        with ``step == have_step`` — and the caller keeps the params it
+        already holds. The comparison happens under the param lock, so a
+        concurrent apply can never slip between the check and the reply:
+        either the reply step equals ``have_step`` (and the params are
+        byte-identical to what the caller fetched at that step) or the full
+        fresh payload is returned. Steps only ever advance, so equality is
+        exactly "nothing changed".
         """
         t0 = _tnow()
         with self._param_lock:
-            payload = {k: v.copy() for k, v in self.parameters.items()}
-            step = self.global_step
+            if have_step is not None and have_step == self.global_step:
+                payload, step, modified = {}, self.global_step, False
+            else:
+                payload = {k: v.copy() for k, v in self.parameters.items()}
+                step = self.global_step
+                modified = True
         if worker_id is not None:
             self.last_seen[worker_id] = time.time()
+        if not modified:
+            self._tm_fetch_nm.inc()
+            self._tm_fetch_s.observe(_tnow() - t0)
+            self._tm_fetches.inc()
+            return payload, step
         if self.config.fetch_codec == "fp16":
             payload = fp16_compress(payload)
         elif self.config.fetch_codec == "bf16":

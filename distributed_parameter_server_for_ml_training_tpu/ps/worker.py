@@ -45,6 +45,24 @@ class WorkerConfig:
     # was dead code (SURVEY.md quirk 8). 0 disables; set e.g. 30.0 to enable
     # the capability the reference intended.
     heartbeat_interval: float = 0.0
+    # Overlapped comms pipeline: pushes (and the following prefetch) run on
+    # a bounded single-slot background thread while the training thread
+    # computes the window's remaining batches. The per-worker RPC ORDER is
+    # identical to the serial loop (push then fetch, exactly-once tokens
+    # preserved); with a single worker every fetched_step is identical too
+    # and curves match bit-for-bit (pinned by test). With MULTIPLE workers
+    # the prefetch runs up to K-1 batches earlier than the serial loop's
+    # boundary fetch, so it can observe a step another worker's push would
+    # have advanced by then — at most one round per window, the same
+    # no-barrier staleness class the store already tolerates (quirk 2 in
+    # sync, the staleness bound in async). Pays off when sync_steps > 1
+    # (there is compute to hide the comms behind).
+    overlap: bool = False
+    # Version-gated delta fetches: refetches send have_step so a store
+    # whose step hasn't advanced answers NOT_MODIFIED (header-only) and
+    # the worker keeps the params it already holds — byte-identical to a
+    # full refetch at the same step, minus the wire bytes.
+    delta_fetch: bool = True
 
     def __post_init__(self):
         if self.k_step_mode not in ("faithful", "accumulate"):
@@ -98,6 +116,158 @@ class WorkerResult:
         }
 
 
+def _window_mean(accum_tree, n: int):
+    """Mean of an accumulated K-step gradient window — ONE definition
+    shared by the serial and overlapped push paths, so their numerics
+    cannot drift apart."""
+    scale = np.float32(n)
+    return jax.tree_util.tree_map(lambda a: a / scale, accum_tree)
+
+
+class _CommsPipeline:
+    """Bounded single-slot comms thread for one worker.
+
+    Executes (push, then optional prefetch) work items in submission order
+    on ONE background thread, so a worker's pushes stay strictly sequential
+    — the RemoteStore push-token dedupe contract ("a retry always precedes
+    that worker's next distinct push") holds exactly as in the serial loop
+    — and a prefetch can never overtake the push it follows. At most ONE
+    item is in flight: ``submit`` blocks until the previous item completed
+    (natural backpressure; the depth gauge is therefore 0 or 1).
+
+    Timing caveat: the prefetch is issued right after its push, up to K-1
+    batches EARLIER than the serial loop's next-boundary fetch, so with
+    multiple workers it can see a step that a peer's push would have
+    advanced by boundary time — bounded at one round per window and
+    within the store's existing no-barrier staleness model (see the
+    ``WorkerConfig.overlap`` comment and docs/WIRE_PROTOCOL.md). With one
+    worker the fetch results are identical and parity is exact.
+
+    The training thread's contract:
+
+    - ``submit(grads, fetched_step, prefetch_current)`` — push ``grads``
+      with ``fetched_step``; if ``prefetch_current`` is not None, follow
+      with a params fetch (``have_step=fetched_step``, delta-gated) whose
+      result ``await_params`` later returns.
+    - ``await_params()`` — block until the pending prefetch result is
+      available and take it.
+    - ``flush()`` — block until the pipeline is idle (epoch boundaries:
+      every push must be on the server before the epoch closes).
+
+    Comms-thread exceptions surface on the NEXT training-thread call, so a
+    dead server still fails the worker (with the original traceback as
+    ``__cause__``) instead of hanging it.
+    """
+
+    def __init__(self, worker: "PSWorker", worker_id: int):
+        self._worker = worker
+        self._worker_id = worker_id
+        self._item = None
+        self._error: Exception | None = None
+        self._go = threading.Event()
+        self._done = threading.Event()
+        self._done.set()
+        self._stop = False
+        self._result = None            # (params, step) of the last prefetch
+        self._result_ready = threading.Event()
+        self._pending_prefetch = False  # training thread only
+        self._last_comms_s = 0.0
+        from ..telemetry import get_registry
+        reg = get_registry()
+        w = str(worker_id)
+        self._tm_depth = reg.gauge("dps_worker_pipeline_depth", worker=w)
+        # Comms seconds the training thread did NOT spend blocked: the
+        # item's comms-thread duration minus the time await/flush actually
+        # waited for it — the per-window overlap win, live.
+        self._tm_saved = reg.histogram("dps_worker_overlap_saved_seconds",
+                                       worker=w)
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True,
+            name=f"comms-pipeline-{worker_id}")
+        self._thread.start()
+
+    # -- comms thread --------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            self._go.wait()
+            self._go.clear()
+            if self._stop:
+                return
+            grads, fetched_step, prefetch_current = self._item
+            self._item = None
+            t0 = _tnow()
+            try:
+                if grads is not None:
+                    self._worker._push(self._worker_id, grads, fetched_step)
+                if prefetch_current is not None:
+                    result = self._worker._fetch_params(
+                        self._worker_id, have_step=fetched_step,
+                        current=prefetch_current)
+                    # Duration published BEFORE the ready flag: a waiter
+                    # that wakes immediately must see THIS item's comms
+                    # time in its overlap-savings record, not the
+                    # previous one's.
+                    self._last_comms_s = _tnow() - t0
+                    self._result = result
+                    self._result_ready.set()
+            except Exception as e:
+                self._error = e
+                self._result_ready.set()  # wake a blocked await_params
+            finally:
+                self._last_comms_s = _tnow() - t0
+                self._tm_depth.set(0)
+                self._done.set()
+
+    # -- training thread -----------------------------------------------------
+
+    def _raise_if_failed(self) -> None:
+        if self._error is not None:
+            raise RuntimeError("comms pipeline failed") from self._error
+
+    def submit(self, grads, fetched_step: int, prefetch_current) -> None:
+        self._done.wait()  # single-slot bound: previous item must be done
+        self._raise_if_failed()
+        self._item = (grads, fetched_step, prefetch_current)
+        self._pending_prefetch = prefetch_current is not None
+        self._done.clear()
+        self._tm_depth.set(1)
+        self._go.set()
+
+    def params_pending(self) -> bool:
+        return self._pending_prefetch
+
+    def await_params(self):
+        """Take the pending prefetch result; records the overlap saving
+        (comms time hidden behind compute) for this window."""
+        t0 = _tnow()
+        self._result_ready.wait()
+        waited = _tnow() - t0
+        self._raise_if_failed()
+        params, step = self._result
+        self._result = None
+        self._result_ready.clear()
+        self._pending_prefetch = False
+        self._tm_saved.observe(max(0.0, self._last_comms_s - waited))
+        return params, step
+
+    def flush(self) -> None:
+        """Epoch barrier: wait until the in-flight item (if any) finished.
+        A pending prefetch RESULT survives a flush — the next epoch's
+        opening fetch consumes it."""
+        self._done.wait()
+        self._raise_if_failed()
+
+    def close(self) -> None:
+        # Bounded wait: a comms thread stuck deep in RPC retries must not
+        # wedge worker teardown — it is a daemon thread and will observe
+        # _stop when (if) its RPC returns.
+        self._done.wait(timeout=120.0)
+        self._stop = True
+        self._go.set()
+        self._thread.join(timeout=10.0)
+
+
 class PSWorker(threading.Thread):
     """One logical worker. Runs as a thread; compute runs on the accelerator
     via a shared jit-compiled grad step (one compile for all workers)."""
@@ -113,6 +283,9 @@ class PSWorker(threading.Thread):
         self.config = config or WorkerConfig()
         self.worker_name = worker_name
         self.result = WorkerResult()
+        # Step of the last successful fetch; the heartbeat thread reads it
+        # to delta-gate its pings (int read/write is atomic enough).
+        self._last_fetched_step: int | None = None
         # Shared compiled functions may be passed in to avoid re-tracing per
         # worker; otherwise built here.
         self._grad_step = grad_step or make_grad_step(
@@ -138,10 +311,19 @@ class PSWorker(threading.Thread):
 
     def _heartbeat_loop(self, worker_id: int, interval: float) -> None:
         """Liveness ping: periodic fetch (the reference's intended
-        health_check_loop, worker.py:112-119, implemented for real)."""
+        health_check_loop, worker.py:112-119, implemented for real).
+        Delta-gated when possible: the ping's payload is discarded anyway,
+        so against a store that supports it a ping costs a header whenever
+        the step hasn't advanced past the training thread's last fetch."""
         while not self._done.wait(interval):
             try:
-                self.store.fetch(worker_id)
+                have = self._last_fetched_step
+                if (have is not None and self.config.delta_fetch
+                        and getattr(self.store, "supports_delta_fetch",
+                                    False)):
+                    self.store.fetch(worker_id, have_step=have)
+                else:
+                    self.store.fetch(worker_id)
                 self.result.heartbeats += 1
             except Exception:
                 pass  # transient failures are what registration retry is for
@@ -195,6 +377,10 @@ class PSWorker(threading.Thread):
                                          stage="wire", worker=w)
         self._tm_fetch_post = reg.counter("dps_worker_fetch_bytes_total",
                                           stage="postcodec", worker=w)
+        # Refetches answered NOT_MODIFIED (delta fetch): the worker kept
+        # its params and moved ~zero payload bytes.
+        self._tm_fetch_nm = reg.counter(
+            "dps_worker_fetch_not_modified_total", worker=w)
 
     def _run(self) -> None:
         cfg = self.config
@@ -218,84 +404,166 @@ class PSWorker(threading.Thread):
 
         rng = jax.random.PRNGKey(cfg.seed + worker_id)
         fetched_step = 0
+        params = None
         k = cfg.sync_steps
         accum = None
         accum_n = 0
+        # Overlapped comms: pushes + prefetches ride a bounded single-slot
+        # background thread; the RPC sequence is IDENTICAL to the serial
+        # loop (see _CommsPipeline), only the training thread stops
+        # blocking on it.
+        pipe = _CommsPipeline(self, worker_id) if cfg.overlap else None
 
-        for epoch in range(cfg.num_epochs):
-            t_epoch = time.time()
-            # The epoch's first fetch happens BEFORE the shard computation:
-            # batch 0 is always a fetch boundary anyway (batch_idx % K == 0),
-            # and hoisting it means a REMOTE store's membership cache is
-            # fresh when the shard is computed — at registration time the
-            # first worker only sees itself, and an epoch-1 shard computed
-            # from that would cover the whole dataset.
-            params, fetched_step = self._fetch_params(worker_id)
-            # Contiguous shard by worker id (worker.py:166-179); ids beyond
-            # total_workers wrap (vs the reference's skewed coverage,
-            # SURVEY.md quirk 10). Recomputed each epoch: in elastic mode
-            # the split covers the LIVE membership, so a net-new joiner
-            # takes a fair slice instead of doubling up on a shard.
-            x_shard, y_shard = self._compute_shard(worker_id, total_workers)
-            for batch_idx, (xb, yb) in enumerate(make_batches(
-                    x_shard, y_shard, cfg.batch_size,
-                    seed=cfg.seed * 1000 + epoch)):
-                boundary = batch_idx % k == 0
-                if boundary and batch_idx > 0:
-                    params, fetched_step = self._fetch_params(worker_id)
+        try:
+            for epoch in range(cfg.num_epochs):
+                t_epoch = time.time()
+                # The epoch's first fetch happens BEFORE the shard
+                # computation: batch 0 is always a fetch boundary anyway
+                # (batch_idx % K == 0), and hoisting it means a REMOTE
+                # store's membership cache is fresh when the shard is
+                # computed — at registration time the first worker only
+                # sees itself, and an epoch-1 shard computed from that
+                # would cover the whole dataset. An overlapped pipeline's
+                # pending prefetch serves the same role (it IS a fetch,
+                # moments old, and refreshed the membership cache).
+                if pipe is not None and pipe.params_pending():
+                    params, fetched_step = pipe.await_params()
+                else:
+                    if pipe is not None:
+                        pipe.flush()  # a fetch must never overtake a push
+                    params, fetched_step = self._fetch_params(
+                        worker_id,
+                        have_step=(fetched_step if params is not None
+                                   else None),
+                        current=params)
+                # Contiguous shard by worker id (worker.py:166-179); ids
+                # beyond total_workers wrap (vs the reference's skewed
+                # coverage, SURVEY.md quirk 10). Recomputed each epoch: in
+                # elastic mode the split covers the LIVE membership, so a
+                # net-new joiner takes a fair slice instead of doubling up
+                # on a shard.
+                x_shard, y_shard = self._compute_shard(worker_id,
+                                                       total_workers)
+                for batch_idx, (xb, yb) in enumerate(make_batches(
+                        x_shard, y_shard, cfg.batch_size,
+                        seed=cfg.seed * 1000 + epoch)):
+                    boundary = batch_idx % k == 0
+                    if boundary and batch_idx > 0:
+                        if pipe is not None and pipe.params_pending():
+                            # The prefetch issued right after the window's
+                            # push — its latency ran under the window's
+                            # compute instead of on the critical path.
+                            params, fetched_step = pipe.await_params()
+                        else:
+                            if pipe is not None:
+                                pipe.flush()
+                            params, fetched_step = self._fetch_params(
+                                worker_id, have_step=fetched_step,
+                                current=params)
 
-                t_step = _tnow()
-                grads, batch_stats, loss, acc = self._grad_step(
-                    params, batch_stats, xb, yb, rng,
-                    self.result.local_steps_completed)
-                # Span = dispatch-to-return of the compiled step. Under jax
-                # async dispatch that can undercount device time on
-                # non-boundary batches; boundary steps (push/fetch) force
-                # completion, so the per-window totals stay honest.
-                self._tm_step_s.observe(_tnow() - t_step)
-                self._tm_steps.inc()
-                self.result.local_steps_completed += 1
+                    t_step = _tnow()
+                    grads, batch_stats, loss, acc = self._grad_step(
+                        params, batch_stats, xb, yb, rng,
+                        self.result.local_steps_completed)
+                    # Span = dispatch-to-return of the compiled step. Under
+                    # jax async dispatch that can undercount device time on
+                    # non-boundary batches; boundary steps (push/fetch)
+                    # force completion, so the per-window totals stay
+                    # honest.
+                    self._tm_step_s.observe(_tnow() - t_step)
+                    self._tm_steps.inc()
+                    self.result.local_steps_completed += 1
 
-                if cfg.k_step_mode == "accumulate" and k > 1:
-                    accum = grads if accum is None else jax.tree_util.tree_map(
-                        lambda a, b: a + b, accum, grads)
-                    accum_n += 1
-                    if accum_n == k:
-                        self._push_mean(worker_id, accum, accum_n,
-                                        fetched_step)
-                        accum, accum_n = None, 0
-                elif boundary:
-                    # Faithful: push THIS batch's gradients; the other K-1
-                    # batches' gradients are computed and dropped (quirk 7).
-                    self._push(worker_id, grads, fetched_step)
+                    if cfg.k_step_mode == "accumulate" and k > 1:
+                        accum = grads if accum is None else \
+                            jax.tree_util.tree_map(
+                                lambda a, b: a + b, accum, grads)
+                        accum_n += 1
+                        if accum_n == k:
+                            self._dispatch_push_mean(
+                                pipe, worker_id, accum, accum_n,
+                                fetched_step, params)
+                            accum, accum_n = None, 0
+                    elif boundary:
+                        # Faithful: push THIS batch's gradients; the other
+                        # K-1 batches' gradients are computed and dropped
+                        # (quirk 7).
+                        self._dispatch_push(pipe, worker_id, grads,
+                                            fetched_step, params)
 
-            # An epoch ending mid-window flushes the partial accumulator,
-            # divided by the ACTUAL number of accumulated batches — it must
-            # not leak into the next epoch's first window (which would push a
-            # >K-batch sum divided by K, against stale params).
-            if accum is not None:
-                self._push_mean(worker_id, accum, accum_n, fetched_step)
-                accum, accum_n = None, 0
+                # An epoch ending mid-window flushes the partial
+                # accumulator, divided by the ACTUAL number of accumulated
+                # batches — it must not leak into the next epoch's first
+                # window (which would push a >K-batch sum divided by K,
+                # against stale params).
+                if accum is not None:
+                    self._dispatch_push_mean(pipe, worker_id, accum,
+                                             accum_n, fetched_step, params)
+                    accum, accum_n = None, 0
+                if pipe is not None:
+                    # Epoch barrier: the epoch's last push must be ON the
+                    # server before the epoch closes, so epoch timings and
+                    # sync-round accounting match the serial loop; the
+                    # prefetch RESULT survives into the next epoch's
+                    # opening fetch.
+                    pipe.flush()
 
-            self.result.epoch_times.append(time.time() - t_epoch)
-            self._tm_epochs.inc()
-            if cfg.eval_each_epoch:
-                self.result.test_accuracies.append(
-                    self.evaluate(params, batch_stats))
-                self._tm_acc.set(self.result.test_accuracies[-1])
-            # Per-epoch progress line (the reference workers logged epochs
-            # to CloudWatch, worker.py:329-335); run_wire_matrix's elastic
-            # cell also keys its mid-run kill off this marker.
-            acc = (f", test_acc={self.result.test_accuracies[-1]:.4f}"
-                   if self.result.test_accuracies else "")
-            print(f"EPOCH_DONE worker={self.worker_name} id={worker_id} "
-                  f"epoch={epoch + 1}/{cfg.num_epochs} "
-                  f"time={self.result.epoch_times[-1]:.1f}s{acc}",
-                  flush=True)
+                self.result.epoch_times.append(time.time() - t_epoch)
+                self._tm_epochs.inc()
+                if cfg.eval_each_epoch:
+                    self.result.test_accuracies.append(
+                        self.evaluate(params, batch_stats))
+                    self._tm_acc.set(self.result.test_accuracies[-1])
+                # Per-epoch progress line (the reference workers logged
+                # epochs to CloudWatch, worker.py:329-335);
+                # run_wire_matrix's elastic cell also keys its mid-run kill
+                # off this marker.
+                acc = (f", test_acc={self.result.test_accuracies[-1]:.4f}"
+                       if self.result.test_accuracies else "")
+                print(f"EPOCH_DONE worker={self.worker_name} id={worker_id} "
+                      f"epoch={epoch + 1}/{cfg.num_epochs} "
+                      f"time={self.result.epoch_times[-1]:.1f}s{acc}",
+                      flush=True)
+        finally:
+            if pipe is not None:
+                pipe.close()
 
-    def _fetch_params(self, worker_id: int):
-        """One FetchParameters round trip -> (params pytree, fetched step)."""
-        flat, fetched_step = self.store.fetch(worker_id)
+    def _dispatch_push(self, pipe, worker_id: int, grads_tree,
+                       fetched_step: int, params) -> None:
+        """Push now (serial) or hand to the comms pipeline with a prefetch
+        of the next params riding behind it (overlapped)."""
+        if pipe is None:
+            self._push(worker_id, grads_tree, fetched_step)
+        else:
+            pipe.submit(grads_tree, fetched_step, prefetch_current=params)
+
+    def _dispatch_push_mean(self, pipe, worker_id: int, accum_tree, n: int,
+                            fetched_step: int, params) -> None:
+        if pipe is None:
+            self._push_mean(worker_id, accum_tree, n, fetched_step)
+        else:
+            pipe.submit(_window_mean(accum_tree, n), fetched_step,
+                        prefetch_current=params)
+
+    def _fetch_params(self, worker_id: int, have_step: int | None = None,
+                      current=None):
+        """One FetchParameters round trip -> (params pytree, fetched step).
+
+        With ``have_step`` + ``current`` (the pytree fetched at that step)
+        and a delta-capable store, a NOT_MODIFIED reply hands back
+        ``current`` unchanged — the params a full refetch would have
+        returned byte-for-byte, since the canonical step didn't move."""
+        use_delta = (have_step is not None and current is not None
+                     and self.config.delta_fetch
+                     and getattr(self.store, "supports_delta_fetch", False))
+        if use_delta:
+            flat, fetched_step = self.store.fetch(worker_id,
+                                                  have_step=have_step)
+            if not flat and fetched_step == have_step:
+                self._tm_fetch_nm.inc()
+                return current, fetched_step
+        else:
+            flat, fetched_step = self.store.fetch(worker_id)
         if (getattr(self.store, "fetch_codec", "none") in ("fp16", "bf16")
                 and not getattr(self.store, "decompresses_fetches", False)):
             # In-process compressed fetch (RemoteStore already decompressed
@@ -308,15 +576,13 @@ class PSWorker(threading.Thread):
             # the RPC-layer counters (device stores move zero bytes — skip).
             self._tm_fetch_post.inc(
                 sum(int(v.nbytes) for v in flat.values()))
+        self._last_fetched_step = fetched_step
         return unflatten_params(flat), fetched_step
 
     def _push_mean(self, worker_id, accum_tree, n: int,
                    fetched_step) -> None:
         """Push the mean of an accumulated gradient window of n batches."""
-        scale = np.float32(n)
-        self._push(worker_id,
-                   jax.tree_util.tree_map(lambda a: a / scale, accum_tree),
-                   fetched_step)
+        self._push(worker_id, _window_mean(accum_tree, n), fetched_step)
 
     def _push(self, worker_id, grads_tree, fetched_step) -> None:
         if getattr(self.store, "keeps_device_arrays", False):

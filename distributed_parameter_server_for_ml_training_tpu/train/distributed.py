@@ -46,6 +46,11 @@ class DistributedConfig:
     strict_rounds: bool = False
     elastic: bool = False          # elastic membership (StoreConfig.elastic)
     worker_timeout: float | None = None  # liveness expiry (seconds)
+    # Overlapped comms pipeline + version-gated delta fetches for the
+    # PS-worker path (ps/worker.py WorkerConfig fields of the same names);
+    # the SPMD sync trainer has no RPCs to overlap.
+    overlap: bool = False
+    delta_fetch: bool = True
     # Async store backend: 'python' (host numpy), 'native' (C++ arena), or
     # 'device' (HBM-resident — zero host-link bytes per worker step; the
     # only backend that runs reference-scale async on a remote-attached
@@ -324,6 +329,8 @@ class AsyncTrainer:
                              num_epochs=cfg.num_epochs,
                              sync_steps=cfg.sync_steps,
                              k_step_mode=cfg.k_step_mode,
+                             overlap=cfg.overlap,
+                             delta_fetch=cfg.delta_fetch,
                              augment=cfg.augment, seed=cfg.seed,
                              # With expiry on, workers must prove liveness
                              # even while their first step COMPILES (which

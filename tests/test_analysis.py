@@ -211,3 +211,58 @@ def test_reads_reference_schema(tmp_path):
     viz = ExperimentVisualizer(str(tmp_path))
     viz.plot_scaling_analysis(str(tmp_path / "s.png"))
     assert "ref_style" in viz.summary_table()
+
+
+def test_telemetry_timeseries_pipeline_section():
+    """build_telemetry_timeseries surfaces the comms-pipeline metrics
+    (docs/WIRE_PROTOCOL.md): delta-fetch not-modified ratio, per-worker
+    queue depth, and the overlap-savings total."""
+    import json
+
+    from distributed_parameter_server_for_ml_training_tpu.analysis.parse_logs \
+        import build_telemetry_timeseries
+
+    def snap(seq, ts, fetches, nm, depth, saved_sum, saved_n):
+        return "METRICS_JSON: " + json.dumps({
+            "kind": "snapshot", "seq": seq, "ts": ts,
+            "uptime_seconds": ts - 100.0, "role": "worker", "pid": 7,
+            "counters": {
+                "dps_store_fetches_total{backend=python}": fetches,
+                "dps_store_fetch_not_modified_total{backend=python}": nm,
+            },
+            "gauges": {"dps_worker_pipeline_depth{worker=0}": depth},
+            "histograms": {
+                "dps_worker_overlap_saved_seconds{worker=0}": {
+                    "le": [0.001, 0.01], "counts": [saved_n, 0, 0],
+                    "sum": saved_sum, "count": saved_n}},
+        })
+
+    log = "\n".join([
+        snap(1, 100.0, 4, 0, 1, 0.0, 0),
+        snap(2, 105.0, 10, 4, 0, 0.02, 5),
+        snap(3, 110.0, 20, 12, 1, 0.05, 12),
+    ])
+    ts = build_telemetry_timeseries(log)
+    proc = ts["procs"]["worker:7"]
+    pipe = proc["pipeline"]
+    assert pipe["not_modified_ratio"] == [0.0, 0.4, 0.6]
+    assert pipe["queue_depth"] == {"worker-0": [1, 0, 1]}
+    assert pipe["overlap_saved_seconds_total"] == 0.05
+    assert pipe["overlap_windows"] == 12
+
+
+def test_telemetry_timeseries_no_pipeline_section_without_metrics():
+    """Streams without pipeline metrics keep the old record shape — no
+    spurious empty sections."""
+    import json
+
+    from distributed_parameter_server_for_ml_training_tpu.analysis.parse_logs \
+        import build_telemetry_timeseries
+
+    line = "METRICS_JSON: " + json.dumps({
+        "kind": "snapshot", "seq": 1, "ts": 50.0, "uptime_seconds": 1.0,
+        "role": "server", "pid": 3,
+        "counters": {"dps_store_fetches_total{backend=python}": 5},
+        "gauges": {}, "histograms": {}})
+    ts = build_telemetry_timeseries(line)
+    assert "pipeline" not in ts["procs"]["server:3"]

@@ -47,6 +47,136 @@ class TestWireCodec:
         meta, payload = unpack_msg(pack_msg({"a": 1}, b"xyz"))
         assert meta == {"a": 1} and payload == b"xyz"
 
+    def test_zero_element_tensors_roundtrip(self):
+        """Zero-element buffers occupy no payload bytes but must decode to
+        the exact (dtype, shape) — previously untested."""
+        import ml_dtypes
+        d = {"empty_f32": np.zeros((0, 7), np.float32),
+             "empty_bf16": np.zeros((0,), ml_dtypes.bfloat16),
+             "empty_i8": np.zeros((3, 0, 2), np.int8),
+             "w": np.ones(4, np.float32)}
+        out = decode_tensor_dict(encode_tensor_dict(d))
+        for k, v in d.items():
+            assert out[k].dtype == v.dtype, k
+            assert out[k].shape == v.shape, k
+            np.testing.assert_array_equal(out[k], v)
+
+    def test_bf16_roundtrip_exact(self):
+        """bfloat16 crosses the wire bit-exactly (the fetch-codec payload
+        dtype) — previously only piggybacked on the multi-dtype test."""
+        rng = np.random.default_rng(7)
+        a = rng.normal(size=(33, 5)).astype(ml_dtypes.bfloat16)
+        out = decode_tensor_dict(encode_tensor_dict({"b": a}))
+        assert out["b"].dtype == np.dtype(ml_dtypes.bfloat16)
+        np.testing.assert_array_equal(
+            out["b"].view(np.uint16), a.view(np.uint16))
+
+    def test_v2_frame_has_magic_and_version(self):
+        from distributed_parameter_server_for_ml_training_tpu.comms import (
+            wire)
+        blob = encode_tensor_dict({"w": np.ones(2, np.float32)})
+        assert blob[0] == wire.WIRE_MAGIC
+        assert blob[1] == wire.WIRE_VERSION
+
+    def test_legacy_v1_frame_still_decodes(self):
+        """Pre-version frames ([u32 hlen][json][buffers]) remain readable —
+        recorded artifacts and old peers don't break."""
+        import json
+        import struct
+        a = np.arange(6, dtype=np.float32).reshape(2, 3)
+        header = json.dumps({"tensors": [
+            {"name": "w", "dtype": "float32", "shape": [2, 3]}]}).encode()
+        v1 = struct.pack("<I", len(header)) + header + a.tobytes()
+        out = decode_tensor_dict(v1)
+        np.testing.assert_array_equal(out["w"], a)
+
+    def test_unknown_version_rejected(self):
+        import struct
+        from distributed_parameter_server_for_ml_training_tpu.comms import (
+            wire)
+        evil = struct.pack("<BBBBI", wire.WIRE_MAGIC, 99, 0, 0, 2) + b"{}"
+        with pytest.raises(ValueError, match="version"):
+            decode_tensor_dict(evil)
+
+    def test_oversized_header_len_rejected_before_alloc(self):
+        """A corrupt/hostile header_len must be rejected by the cap check,
+        not by attempting to slice/parse gigabytes."""
+        import struct
+        from distributed_parameter_server_for_ml_training_tpu.comms import (
+            wire)
+        evil = struct.pack("<BBBBI", wire.WIRE_MAGIC, wire.WIRE_VERSION,
+                           0, 0, 1 << 31) + b"{" + b"x" * 63
+        with pytest.raises(ValueError, match="cap"):
+            decode_tensor_dict(evil)
+
+    def test_legacy_v1_header_len_collides_with_magic(self):
+        """Regression: a v1 frame whose header_len is exactly 0x02D5 (725)
+        starts with the v2 magic+version bytes; the '{'-position check must
+        still route it to the v1 decoder."""
+        import json
+        import struct
+        metas = [{"name": f"t{i:02d}", "dtype": "float32", "shape": [2]}
+                 for i in range(8)]
+        pad = 0
+        header = json.dumps({"tensors": metas, "_pad": ""}).encode()
+        while len(header) != 725:  # converges: length is linear in pad
+            pad += 725 - len(header)
+            header = json.dumps({"tensors": metas,
+                                 "_pad": "x" * pad}).encode()
+        bufs = b"".join(np.full(2, i, np.float32).tobytes()
+                        for i in range(8))
+        v1 = struct.pack("<I", len(header)) + header + bufs
+        assert v1[0] == 0xD5 and v1[1] == 0x02  # the collision under test
+        out = decode_tensor_dict(v1)
+        assert len(out) == 8
+        np.testing.assert_array_equal(out["t03"],
+                                      np.full(2, 3, np.float32))
+
+    def test_nan_and_bogus_shape_dims_rejected(self):
+        import json
+        import struct
+        from distributed_parameter_server_for_ml_training_tpu.comms import (
+            wire)
+        for dim in ["NaN", "-1", "1.5", "true", '"8"']:
+            h = (b'{"tensors": [{"name": "x", "dtype": "float32", '
+                 b'"shape": [' + dim.encode() + b']}]}')
+            json.loads(h.replace(b"NaN", b"0"))  # otherwise-valid JSON
+            evil = struct.pack("<BBBBI", wire.WIRE_MAGIC,
+                               wire.WIRE_VERSION, 0, 0, len(h)) \
+                + h + b"\x00" * 64
+            with pytest.raises(ValueError, match="shape"):
+                decode_tensor_dict(evil)
+
+    def test_chunked_roundtrip_and_reassembly(self):
+        from distributed_parameter_server_for_ml_training_tpu.comms import (
+            wire)
+        rng = np.random.default_rng(3)
+        d = {"big": rng.normal(size=(1000,)).astype(np.float32),  # 4000 B
+             "small": np.arange(10, dtype=np.int32),
+             "scalar": np.float32(2.5).reshape(())}
+        for chunk_bytes in (512, 1500, 4000, 1 << 20):
+            frames = wire.encode_tensor_dict_chunks(d, chunk_bytes)
+            assert all(wire.is_chunk_frame(f) for f in frames)
+            assert all(len(f) < chunk_bytes + 4096 for f in frames)
+            out = wire.decode_tensor_dict_chunks(list(reversed(frames)))
+            for k in d:
+                np.testing.assert_array_equal(out[k], np.asarray(d[k]))
+        # single-frame payloads reject chunk frames and vice versa
+        with pytest.raises(ValueError, match="chunk"):
+            decode_tensor_dict(
+                wire.encode_tensor_dict_chunks(d, 512)[0])
+        with pytest.raises(ValueError, match="chunk"):
+            wire.decode_tensor_dict_chunks([encode_tensor_dict(d)])
+
+    def test_chunked_detects_missing_chunk(self):
+        from distributed_parameter_server_for_ml_training_tpu.comms import (
+            wire)
+        frames = wire.encode_tensor_dict_chunks(
+            {"w": np.ones(1000, np.float32)}, 1024)
+        assert len(frames) > 2
+        with pytest.raises(ValueError, match="incomplete"):
+            wire.decode_tensor_dict_chunks(frames[:-1])
+
 
 @pytest.fixture()
 def live_server():
@@ -455,6 +585,199 @@ class TestGrpcService:
         assert client.config.elastic is False
         client.fetch(0)
         assert client.membership_snapshot() == []
+        client.close()
+
+    def test_delta_fetch_not_modified_over_wire(self, live_server):
+        """fetch(have_step=current) costs a header, not the model; the
+        reply is NOT_MODIFIED and the client hands back ({}, step)."""
+        store, port = live_server
+        client = RemoteStore(f"localhost:{port}")
+        wid, _ = client.register_worker("delta")
+        assert client.supports_delta_fetch is True
+        base = client.wire_stats()["wire_bytes_in"]
+        params, step = client.fetch(wid)
+        full_bytes = client.wire_stats()["wire_bytes_in"] - base
+        p2, s2 = client.fetch(wid, have_step=step)
+        nm_bytes = client.wire_stats()["wire_bytes_in"] - base - full_bytes
+        assert p2 == {} and s2 == step
+        # the NOT_MODIFIED reply is header-only: no tensor frame at all
+        assert nm_bytes < full_bytes - 8 * 4
+        # store counted it
+        assert store._tm_fetch_nm.value >= 1
+        client.close()
+
+    def test_delta_fetch_never_serves_stale_params(self, live_server):
+        """The acceptance property (ISSUE satellite): once the step
+        advances past have_step, the reply MUST carry the fresh model —
+        NOT_MODIFIED only ever means byte-identical params."""
+        store, port = live_server
+        client = RemoteStore(f"localhost:{port}")
+        wid, _ = client.register_worker("fresh")
+        params, step = client.fetch(wid)
+        # async store: the push applies immediately and bumps the step
+        assert client.push(wid, {"w": np.full(8, 0.5, np.float16)}, step)
+        p2, s2 = client.fetch(wid, have_step=step)
+        assert s2 == step + 1
+        assert "w" in p2  # full payload, not NOT_MODIFIED
+        np.testing.assert_allclose(p2["w"], params["w"] - 0.1 * 0.5)
+        client.close()
+
+    def test_delta_fetch_not_modified_race_free(self):
+        """Hammer the lock ordering: concurrent delta fetches and pushes.
+        Every reply must be either (full params, step > have) or
+        ({}, step == have) — an empty reply with an advanced step would be
+        the stale-params bug."""
+        import threading
+
+        store = ParameterStore({"w": np.ones(64, np.float32)}, StoreConfig(
+            mode="async", total_workers=2, push_codec="none",
+            staleness_bound=10**9))
+        store.register_worker()
+        stop = threading.Event()
+
+        def pusher():
+            while not stop.is_set():
+                store.push(0, {"w": np.full(64, 1e-4, np.float32)},
+                           store.global_step)
+
+        t = threading.Thread(target=pusher, daemon=True)
+        t.start()
+        try:
+            violations = []
+            for _ in range(500):
+                _, have = store.fetch(1)
+                payload, step = store.fetch(1, have_step=have)
+                if payload:
+                    if step <= have:
+                        violations.append(("full-but-not-newer", have,
+                                           step))
+                elif step != have:
+                    violations.append(("empty-but-advanced", have, step))
+            assert not violations, violations[:5]
+        finally:
+            stop.set()
+            t.join(timeout=10)
+
+    def test_overlap_exactly_once_under_rpc_retries(self, tiny_model):
+        """ISSUE satellite: the overlapped pipeline preserves push-token
+        exactly-once semantics under injected transient RPC failures —
+        every gradient is applied exactly once, none duplicated into a
+        later round, and the run completes."""
+        import jax
+
+        from distributed_parameter_server_for_ml_training_tpu.data import (
+            synthetic_cifar100)
+        from distributed_parameter_server_for_ml_training_tpu.ps import (
+            PSWorker, WorkerConfig)
+        from distributed_parameter_server_for_ml_training_tpu.utils.pytree \
+            import flatten_params
+
+        class FakeRpcError(grpc.RpcError):
+            def __init__(self, code):
+                self._code = code
+
+            def code(self):
+                return self._code
+
+        class Flaky:
+            """Fails every 2nd call once with UNAVAILABLE, then passes the
+            retry through — so nearly every push/fetch takes the retry
+            path at least once."""
+
+            def __init__(self, inner):
+                self.inner = inner
+                self.calls = 0
+                self.injected = 0
+                self._fail_next = False
+
+            def __call__(self, request, timeout=None):
+                self.calls += 1
+                if self.calls % 2 == 0 and not self._fail_next:
+                    self._fail_next = True
+                    self.injected += 1
+                    raise FakeRpcError(grpc.StatusCode.UNAVAILABLE)
+                self._fail_next = False
+                return self.inner(request, timeout=timeout)
+
+        model = tiny_model()
+        variables = model.init(jax.random.PRNGKey(0),
+                               np.zeros((1, 32, 32, 3), np.float32),
+                               train=False)
+        store = ParameterStore(
+            flatten_params(variables["params"]),
+            StoreConfig(mode="sync", total_workers=1))
+        server, port = serve(store, port=0)
+        try:
+            client = RemoteStore(f"localhost:{port}", rpc_backoff=0.01)
+            flaky = {name: Flaky(client._call[name])
+                     for name in ("FetchParameters", "PushGradrients",
+                                  "JobFinished")}
+            client._call.update(flaky)
+
+            ds = synthetic_cifar100(n_train=128, n_test=16, num_classes=10)
+            w = PSWorker(client, tiny_model(), ds,
+                         WorkerConfig(batch_size=16, num_epochs=2,
+                                      sync_steps=2, augment=False,
+                                      overlap=True, eval_each_epoch=False))
+            w.start()
+            w.join(timeout=300)
+            assert not w.is_alive()
+            assert w.result.error is None, w.result.error
+            # 2 epochs x 8 batches, K=2 -> 4 boundary pushes per epoch;
+            # exactly-once: every push applied once, so with
+            # total_workers=1 each accepted push completes one round.
+            assert w.result.local_steps_completed == 16
+            assert w.result.pushes_accepted == 8
+            assert store.stats.gradients_processed == 8
+            assert store.global_step == 8
+            assert sum(f.injected for f in flaky.values()) >= 4
+            client.close()
+        finally:
+            server.stop(grace=None)
+
+    def test_overlap_comms_error_fails_worker_not_hangs(self, tiny_model):
+        """A comms-thread failure (server gone, non-retryable) surfaces as
+        the worker's error instead of wedging the training thread."""
+        import jax
+
+        from distributed_parameter_server_for_ml_training_tpu.data import (
+            synthetic_cifar100)
+        from distributed_parameter_server_for_ml_training_tpu.ps import (
+            PSWorker, WorkerConfig)
+        from distributed_parameter_server_for_ml_training_tpu.utils.pytree \
+            import flatten_params
+
+        model = tiny_model()
+        variables = model.init(jax.random.PRNGKey(0),
+                               np.zeros((1, 32, 32, 3), np.float32),
+                               train=False)
+        store = ParameterStore(flatten_params(variables["params"]),
+                               StoreConfig(mode="async", total_workers=1))
+        server, port = serve(store, port=0)
+        client = RemoteStore(f"localhost:{port}", rpc_retries=0,
+                             rpc_timeout=5.0)
+        ds = synthetic_cifar100(n_train=96, n_test=16, num_classes=10)
+        w = PSWorker(client, tiny_model(), ds,
+                     WorkerConfig(batch_size=16, num_epochs=3,
+                                  sync_steps=3, augment=False,
+                                  overlap=True, eval_each_epoch=False))
+
+        class Dead:
+            def __call__(self, request, timeout=None):
+                e = grpc.RpcError()
+                e.code = lambda: grpc.StatusCode.INTERNAL
+                raise e
+
+        # Registration and fetches work; every push dies non-retryably on
+        # the COMMS thread. The pipeline must surface that on the training
+        # thread (await/flush), not hang the worker.
+        client._call["PushGradrients"] = Dead()
+        w.start()
+        w.join(timeout=120)
+        server.stop(grace=None)
+        assert not w.is_alive()
+        assert w.result.error is not None
+        assert isinstance(w.result.error.__cause__, grpc.RpcError)
         client.close()
 
     def test_remote_worker_end_to_end(self, live_server, tiny_model):

@@ -1,0 +1,96 @@
+"""Tier-1 copy-budget guard for the wire codec (docs/WIRE_PROTOCOL.md).
+
+The zero-copy wire path is a perf invariant, not a behavior — nothing
+functional fails when someone reintroduces a ``tobytes()`` per tensor, so
+this microbenchmark pins it structurally: every buffer copy the encode
+path performs is counted through :func:`wire.set_copy_count_hook`, and the
+budget is AT MOST ONE copy per contiguous tensor. Decode is pinned to
+ZERO copies by checking the returned arrays are views into the payload.
+"""
+
+import numpy as np
+import pytest
+
+from distributed_parameter_server_for_ml_training_tpu.comms import wire
+
+
+@pytest.fixture()
+def copy_counts():
+    counts: dict[str, list] = {}
+
+    def hook(name, reason):
+        counts.setdefault(name, []).append(reason)
+
+    prev = wire.set_copy_count_hook(hook)
+    try:
+        yield counts
+    finally:
+        wire.set_copy_count_hook(prev)
+
+
+def _payload(n_tensors=16, size=4096):
+    rng = np.random.default_rng(0)
+    return {f"layer{i}/w": rng.normal(size=(size,)).astype(np.float32)
+            for i in range(n_tensors)}
+
+
+class TestEncodeCopyBudget:
+    def test_contiguous_tensors_copy_exactly_once(self, copy_counts):
+        tensors = _payload()
+        wire.encode_tensor_dict(tensors)
+        assert set(copy_counts) == set(tensors)
+        for name, reasons in copy_counts.items():
+            assert reasons == ["frame_write"], (name, reasons)
+
+    def test_chunked_encode_same_budget(self, copy_counts):
+        tensors = _payload(n_tensors=8)
+        wire.encode_tensor_dict_chunks(tensors, max_chunk_bytes=10_000)
+        for name, reasons in copy_counts.items():
+            assert reasons == ["frame_write"], (name, reasons)
+
+    def test_non_contiguous_input_costs_one_extra(self, copy_counts):
+        arr = np.asfortranarray(
+            np.arange(64, dtype=np.float32).reshape(8, 8))
+        wire.encode_tensor_dict({"f_order": arr})
+        assert copy_counts["f_order"] == ["make_contiguous", "frame_write"]
+
+    def test_zero_element_tensor_costs_nothing(self, copy_counts):
+        wire.encode_tensor_dict({"empty": np.zeros((0, 3), np.float32)})
+        assert "empty" not in copy_counts
+
+    def test_budget_holds_at_realistic_model_size(self, copy_counts):
+        """~1M fp32 params across 32 tensors — a tiny-ResNet-scale payload
+        through the real path, still one copy per tensor."""
+        rng = np.random.default_rng(1)
+        tensors = {f"p{i}": rng.normal(size=(32_768,)).astype(np.float32)
+                   for i in range(32)}
+        blob = wire.encode_tensor_dict(tensors)
+        assert len(blob) > 32 * 32_768 * 4
+        assert all(reasons == ["frame_write"]
+                   for reasons in copy_counts.values()), copy_counts
+
+
+class TestDecodeZeroCopy:
+    def test_decoded_arrays_are_views_into_payload(self):
+        blob = wire.encode_tensor_dict(_payload(n_tensors=4))
+        out = wire.decode_tensor_dict(blob)
+        for name, arr in out.items():
+            assert not arr.flags.owndata, name        # a view, not a copy
+            assert not arr.flags.writeable, name      # payload is immutable
+            assert arr.base is not None, name
+
+    def test_copy_true_returns_owned_writable_arrays(self):
+        blob = wire.encode_tensor_dict({"w": np.ones(8, np.float32)})
+        out = wire.decode_tensor_dict(blob, copy=True)
+        assert out["w"].flags.owndata and out["w"].flags.writeable
+        out["w"][0] = 5.0  # must not raise
+
+    def test_chunk_decode_views_when_tensor_fits_chunk(self):
+        tensors = {"a": np.arange(100, dtype=np.float32),
+                   "b": np.arange(50, dtype=np.float32)}
+        frames = wire.encode_tensor_dict_chunks(tensors,
+                                                max_chunk_bytes=512)
+        out = wire.decode_tensor_dict_chunks(frames)
+        for name in tensors:
+            np.testing.assert_array_equal(out[name], tensors[name])
+            assert not out[name].flags.owndata, name
