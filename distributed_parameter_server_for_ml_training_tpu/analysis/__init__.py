@@ -9,9 +9,20 @@ from .parse_logs import (
     worker_throughput_series,
 )
 from .runner import run_cell, run_matrix
+from .traces import (
+    assemble_traces,
+    critical_path_report,
+    find_trace_dumps,
+    load_trace_dumps,
+    save_chrome_trace,
+    to_chrome_trace,
+)
 from .visualize import ExperimentVisualizer
 
-__all__ = ["aggregate_worker_metrics", "build_telemetry_timeseries",
-           "parse_experiment", "parse_snapshot_series", "staleness_series",
+__all__ = ["aggregate_worker_metrics", "assemble_traces",
+           "build_telemetry_timeseries", "critical_path_report",
+           "find_trace_dumps", "load_trace_dumps",
+           "parse_experiment", "parse_snapshot_series",
+           "save_chrome_trace", "staleness_series", "to_chrome_trace",
            "worker_throughput_series",
            "ExperimentVisualizer", "run_cell", "run_matrix"]

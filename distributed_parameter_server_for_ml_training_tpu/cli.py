@@ -57,8 +57,25 @@ def build_parser() -> argparse.ArgumentParser:
                        help="seconds between snapshot lines")
         q.add_argument("--metrics-port", type=int,
                        default=_env("DPS_METRICS_PORT", None, int),
-                       help="serve Prometheus /metrics + /healthz on this "
-                            "port (0 = pick a free port; omit = disabled)")
+                       help="serve Prometheus /metrics + /healthz + "
+                            "/debug/trace on this port (0 = pick a free "
+                            "port; omit = disabled)")
+        q.add_argument("--trace", action="store_true",
+                       default=bool(_env("DPS_TRACE", 0, int)),
+                       help="record per-step trace spans into the "
+                            "in-process flight recorder (propagated "
+                            "worker->server over the wire; dumped on "
+                            "SIGTERM/crash/exit and via /debug/trace — "
+                            "docs/OBSERVABILITY.md)")
+        q.add_argument("--trace-buffer", type=int,
+                       default=_env("DPS_TRACE_BUFFER", 4096, int),
+                       help="flight-recorder ring size (spans kept per "
+                            "process; oldest evicted)")
+        q.add_argument("--trace-dump-dir",
+                       default=_env("DPS_TRACE_DUMP_DIR", None),
+                       help="write the recorder tail as JSON here on "
+                            "SIGTERM/unhandled-fault/atexit "
+                            "(trace-<role>-<pid>-<reason>.json)")
 
     def add_common(q):
         add_platform(q)
@@ -166,6 +183,11 @@ def build_parser() -> argparse.ArgumentParser:
     t.add_argument("--resume", action="store_true",
                    help="resume from the newest checkpoint in "
                         "--checkpoint-dir")
+    t.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler (XLA-level) trace of the "
+                        "training loop into this directory — opens in "
+                        "TensorBoard/Perfetto beside the framework-level "
+                        "--trace spans (docs/OBSERVABILITY.md)")
     t.add_argument("--multihost", action="store_true",
                    help="join a multi-process SPMD job before training "
                         "(sync mode): one global mesh across hosts")
@@ -276,6 +298,10 @@ def build_parser() -> argparse.ArgumentParser:
     w.add_argument("--no-delta-fetch", action="store_true",
                    help="disable version-gated delta fetches (full params "
                         "on every fetch, reference parity)")
+    w.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler (XLA-level) trace of the "
+                        "worker loop into this directory (TensorBoard/"
+                        "Perfetto; pairs with --trace span traces)")
     add_common(w)
 
     return p
@@ -287,29 +313,69 @@ from contextlib import contextmanager
 @contextmanager
 def _telemetry_session(args, role: str):
     """Start/stop the opt-in telemetry surfaces around a command body:
-    the periodic snapshot emitter (``--telemetry``) and the Prometheus
-    endpoint (``--metrics-port``). The emitter's final flush runs even on
-    failure — a crashed run still leaves its last complete totals in the
-    log (the round-5 bench lesson: never die with nothing written)."""
+    the periodic snapshot emitter (``--telemetry``), the Prometheus/
+    debug endpoint (``--metrics-port``), and the tracing flight recorder
+    (``--trace``/``--trace-buffer``/``--trace-dump-dir``). The emitter's
+    final flush runs even on failure — a crashed run still leaves its
+    last complete totals in the log (the round-5 bench lesson: never die
+    with nothing written) — and the shutdown hooks extend that guarantee
+    to SIGTERM: the recorder tail is dumped and the snapshot emitter
+    flushes its final interval instead of silently dropping it."""
     emitter = http_server = None
+    tracing = getattr(args, "trace", False)
+    dump_dir = getattr(args, "trace_dump_dir", None)
+    if tracing:
+        from .telemetry import enable_tracing
+        enable_tracing(buffer=getattr(args, "trace_buffer", 4096),
+                       role=role)
+    if tracing or dump_dir or getattr(args, "telemetry", False):
+        from .telemetry import install_shutdown_hooks
+        install_shutdown_hooks(dump_dir=dump_dir, role=role)
     port = getattr(args, "metrics_port", None)
     if port is not None:
-        from .telemetry import start_metrics_server
+        from .telemetry import register_build_info, start_metrics_server
+        register_build_info()  # fleet-wide scrape correlation gauge
         http_server, bound = start_metrics_server(port=port)
         print(f"telemetry: serving /metrics on :{bound}", file=sys.stderr,
               flush=True)
     if getattr(args, "telemetry", False):
-        from .telemetry import SnapshotEmitter
+        from .telemetry import (SnapshotEmitter, add_shutdown_flush,
+                                register_build_info)
+        register_build_info()
         emitter = SnapshotEmitter(
             interval=getattr(args, "telemetry_interval", 5.0),
             role=role).start()
+        # SIGTERM/atexit flush: the final snapshot of a terminating
+        # process is never lost (ISSUE 3 satellite; flush_now is a no-op
+        # once stop() below already emitted the final line).
+        add_shutdown_flush(emitter.flush_now)
     try:
         yield
     finally:
         if emitter is not None:
+            from .telemetry import remove_shutdown_flush
             emitter.stop(final=True)
+            remove_shutdown_flush(emitter.flush_now)
         if http_server is not None:
             http_server.shutdown()
+
+
+@contextmanager
+def _profiler_session(profile_dir: str | None):
+    """``--profile-dir``: bracket the hot loop with
+    ``jax.profiler.start_trace``/``stop_trace`` (via utils/tracing.py) so
+    an XLA-level timeline (MXU utilization, HBM traffic, collectives)
+    lands beside the framework-level span traces. No-op when unset."""
+    if not profile_dir:
+        yield
+        return
+    import os as _os
+    _os.makedirs(profile_dir, exist_ok=True)
+    from .utils.tracing import trace
+    print(f"profiler: tracing into {profile_dir}", file=sys.stderr,
+          flush=True)
+    with trace(profile_dir):
+        yield
 
 
 def _load_dataset(args):
@@ -366,10 +432,11 @@ def _cmd_train(args) -> int:
                              dtype=args.dtype, model=args.model,
                              num_classes=num_classes, seed=args.seed)
         trainer = BaselineTrainer(dataset, cfg)
-        trainer.train(plot_path=args.plot,
-                      emit_metrics=args.emit_metrics,
-                      checkpoint_dir=args.checkpoint_dir,
-                      resume=args.resume)
+        with _profiler_session(getattr(args, "profile_dir", None)):
+            trainer.train(plot_path=args.plot,
+                          emit_metrics=args.emit_metrics,
+                          checkpoint_dir=args.checkpoint_dir,
+                          resume=args.resume)
         return 0
 
     if args.mode in ("tp", "pp", "sp", "moe"):
@@ -390,9 +457,10 @@ def _cmd_train(args) -> int:
         trainer = {"tp": TPTrainer, "pp": PipelineTrainer,
                    "sp": SPTrainer, "moe": MoETrainer}[args.mode](
             dataset, mp_cfg)
-        metrics = trainer.train(emit_metrics=args.emit_metrics,
-                                checkpoint_dir=args.checkpoint_dir,
-                                resume=args.resume)
+        with _profiler_session(getattr(args, "profile_dir", None)):
+            metrics = trainer.train(emit_metrics=args.emit_metrics,
+                                    checkpoint_dir=args.checkpoint_dir,
+                                    resume=args.resume)
         print(f"done: {metrics}", file=sys.stderr)
         return 0
 
@@ -416,9 +484,10 @@ def _cmd_train(args) -> int:
         seed=args.seed)
     trainer = (SyncTrainer if args.mode == "sync" else AsyncTrainer)(
         dataset, cfg)
-    metrics = trainer.train(emit_metrics=args.emit_metrics,
-                            checkpoint_dir=args.checkpoint_dir,
-                            resume=args.resume)
+    with _profiler_session(getattr(args, "profile_dir", None)):
+        metrics = trainer.train(emit_metrics=args.emit_metrics,
+                                checkpoint_dir=args.checkpoint_dir,
+                                resume=args.resume)
     print(f"done: {metrics}", file=sys.stderr)
     return 0
 
@@ -508,8 +577,9 @@ def _cmd_worker(args) -> int:
                        delta_fetch=not args.no_delta_fetch)
     worker = PSWorker(store, model, dataset, cfg,
                       worker_name=args.worker_name)
-    worker.start()
-    worker.join()
+    with _profiler_session(getattr(args, "profile_dir", None)):
+        worker.start()
+        worker.join()
     if worker.result.error is not None:
         raise worker.result.error
     if args.emit_metrics:

@@ -34,7 +34,7 @@ import time
 import grpc
 import numpy as np
 
-from ..telemetry import now as _tnow
+from ..telemetry import current_wire_trace, now as _tnow, trace_span
 
 from .service import GRPC_OPTIONS, SERVICE_NAME, pack_msg, unpack_msg
 
@@ -96,6 +96,11 @@ class RemoteStore:
         #: server would silently ignore the field and ship the full model,
         #: which is correct but wasteful — gating keeps intent explicit).
         self.supports_delta_fetch = False
+        #: True once the server advertises trace-context propagation at
+        #: registration (same gating discipline as delta fetch,
+        #: docs/WIRE_PROTOCOL.md): the trace field is only attached to
+        #: push frames / fetch meta when the peer said it understands it.
+        self.supports_trace_context = False
         self.config = _RemoteConfig()
         # Last membership seen on the wire (elastic servers piggyback it on
         # Register/Fetch replies). Workers fetch at least once per K-step
@@ -156,29 +161,46 @@ class RemoteStore:
         delay = self.rpc_backoff
         for attempt in range(self.rpc_retries + 1):
             t0 = _tnow()
-            try:
-                reply = self._call[name](request, timeout=self.rpc_timeout)
-                hist.observe(_tnow() - t0)
-                with self._wire_lock:
-                    self.wire_bytes_out += len(request)
-                    self.wire_bytes_in += len(reply)
-                    self.rpc_counts[name] = self.rpc_counts.get(name, 0) + 1
-                b_out.inc(len(request))
-                b_in.inc(len(reply))
-                c_ok.inc()
-                return reply
-            except grpc.RpcError as e:
-                # Failed attempts record their latency too — a deadline
-                # expiry spent real wall time, and dropping it would bias
-                # the distribution toward the happy path.
-                hist.observe(_tnow() - t0)
-                code = e.code() if callable(getattr(e, "code", None)) else None
-                if attempt >= self.rpc_retries or code not in RETRYABLE_CODES:
-                    c_err.inc()
-                    raise
-                c_retry.inc()
-                time.sleep(delay)
-                delay *= 2
+            # One trace span per ATTEMPT (not per logical call): a retried
+            # RPC's trace tree shows each wire round trip, and the error
+            # attr on a failed attempt marks exactly where time went.
+            with trace_span("rpc.client", rpc=name, attempt=attempt) as sp:
+                try:
+                    reply = self._call[name](request,
+                                             timeout=self.rpc_timeout)
+                except grpc.RpcError as e:
+                    # Failed attempts record their latency too — a
+                    # deadline expiry spent real wall time, and dropping
+                    # it would bias the distribution toward the happy
+                    # path.
+                    hist.observe(_tnow() - t0)
+                    code = e.code() if callable(getattr(e, "code", None)) \
+                        else None
+                    # Mark the span even when the retry path SWALLOWS the
+                    # exception (the span exits cleanly then, so the
+                    # automatic error attr would not fire) — a retry
+                    # storm's post-mortem must show which attempts burned
+                    # the time.
+                    sp.attrs["error"] = (code.name if code is not None
+                                         else type(e).__name__)
+                    if attempt >= self.rpc_retries \
+                            or code not in RETRYABLE_CODES:
+                        c_err.inc()
+                        raise
+                    c_retry.inc()
+                else:
+                    hist.observe(_tnow() - t0)
+                    with self._wire_lock:
+                        self.wire_bytes_out += len(request)
+                        self.wire_bytes_in += len(reply)
+                        self.rpc_counts[name] = \
+                            self.rpc_counts.get(name, 0) + 1
+                    b_out.inc(len(request))
+                    b_in.inc(len(reply))
+                    c_ok.inc()
+                    return reply
+            time.sleep(delay)
+            delay *= 2
 
     def wire_stats(self) -> dict:
         """Cumulative client-side wire accounting (bytes + per-RPC counts
@@ -220,6 +242,8 @@ class RemoteStore:
                 self.fetch_codec = reply.get("fetch_codec", "none")
                 self.supports_delta_fetch = bool(
                     reply.get("delta_fetch", False))
+                self.supports_trace_context = bool(
+                    reply.get("trace_context", False))
                 self.config.elastic = bool(reply.get("elastic", False))
                 self.config.mode = reply.get("mode", "sync")
                 self.config.learning_rate = float(
@@ -253,24 +277,33 @@ class RemoteStore:
         meta = {} if worker_id is None else {"worker_id": worker_id}
         if have_step is not None and self.supports_delta_fetch:
             meta["have_step"] = int(have_step)
+        if self.supports_trace_context:
+            # A fetch request carries no tensor frame, so the trace
+            # context rides the envelope meta (docs/WIRE_PROTOCOL.md);
+            # None (tracing off / no open span) attaches nothing.
+            wt = current_wire_trace()
+            if wt is not None:
+                meta["trace"] = wt
         reply = self._invoke("FetchParameters", pack_msg(meta))
         rmeta, payload = unpack_msg(reply)
         self._note_membership(rmeta)
         if rmeta.get("not_modified"):
             self._tm_fetch_nm.inc()
             return {}, int(rmeta["global_step"])
-        params = decode_tensor_dict(payload)
-        if self.fetch_codec == "fp16":
-            # serve --fetch-codec: the server halves the params-in wire
-            # term (the reference's dominant cost, server.py:222); restore
-            # fp32 here so callers never see compressed dtypes. Wire
-            # accounting above already counted the COMPRESSED reply.
-            # (PSWorker sees decompresses_fetches and does NOT cast again.)
-            from ..ops.compression import fp16_decompress
-            params = fp16_decompress(params)
-        elif self.fetch_codec == "bf16":
-            from ..ops.compression import bf16_decompress
-            params = bf16_decompress(params)
+        with trace_span("worker.codec", stage="decode"):
+            params = decode_tensor_dict(payload)
+            if self.fetch_codec == "fp16":
+                # serve --fetch-codec: the server halves the params-in
+                # wire term (the reference's dominant cost,
+                # server.py:222); restore fp32 here so callers never see
+                # compressed dtypes. Wire accounting above already
+                # counted the COMPRESSED reply. (PSWorker sees
+                # decompresses_fetches and does NOT cast again.)
+                from ..ops.compression import fp16_decompress
+                params = fp16_decompress(params)
+            elif self.fetch_codec == "bf16":
+                from ..ops.compression import bf16_decompress
+                params = bf16_decompress(params)
         return params, int(rmeta["global_step"])
 
     def push(self, worker_id: int, gradients: dict, fetched_step: int) -> bool:
@@ -278,10 +311,20 @@ class RemoteStore:
         codec, so compressed bytes hit the wire exactly once."""
         from .wire import encode_tensor_dict
         self._push_count += 1
+        # Trace context rides the v2 FRAME header (capability-gated): the
+        # request bytes are packed once — token and trace included — and
+        # retried verbatim, so every retry carries the same span identity.
+        # The same object is duplicated into the envelope meta so the
+        # server's wrapper reads it without re-parsing the frame header
+        # (docs/WIRE_PROTOCOL.md); the frame field remains the wire
+        # contract for peers that only speak frames.
+        wt = current_wire_trace() if self.supports_trace_context else None
+        meta = {"worker_id": worker_id, "fetched_step": fetched_step,
+                "push_token": f"{self._push_nonce}:{self._push_count}"}
+        if wt is not None:
+            meta["trace"] = wt
         reply = self._invoke("PushGradrients", pack_msg(
-            {"worker_id": worker_id, "fetched_step": fetched_step,
-             "push_token": f"{self._push_nonce}:{self._push_count}"},
-            encode_tensor_dict(gradients)))
+            meta, encode_tensor_dict(gradients, trace=wt)))
         rmeta, _ = unpack_msg(reply)
         return bool(rmeta["accepted"])
 

@@ -130,6 +130,13 @@ class ParameterService:
             # would ignore it) both keep working.
             "delta_fetch": bool(getattr(self.store, "supports_delta_fetch",
                                         False)),
+            # Trace-context capability (docs/WIRE_PROTOCOL.md): clients may
+            # attach a trace field to push frame headers / fetch meta and
+            # this server will parent its handler/store spans on it. Same
+            # gating discipline as delta_fetch — old clients never attach,
+            # new clients against old servers see no advertisement and
+            # stay silent, so mixed versions degrade to untraced.
+            "trace_context": True,
             **self._membership_fields(),
         })
 
@@ -209,16 +216,35 @@ class ParameterService:
     def _instrumented(self, name: str, fn):
         """Wrap an RPC body with its span + byte counters. The span covers
         the full handler (decode + store work + encode); durations record
-        even when the body raises/aborts — error handling time is real."""
-        from ..telemetry import now
+        even when the body raises/aborts — error handling time is real.
+
+        With tracing enabled, the wrapper also adopts the client's
+        propagated trace context (fetch meta / push frame header,
+        docs/WIRE_PROTOCOL.md) and opens an ``rpc.server`` span under it,
+        so the store spans recorded inside the body attach causally to
+        the worker step that issued the RPC. An untraced or legacy peer
+        yields no context and the span becomes a local root."""
+        from ..telemetry import now, trace_enabled, trace_span, \
+            use_wire_context
+        from .wire import peek_trace
         hist, b_in, b_out, calls = self._tm_rpc[name]
 
         def wrapped(request: bytes, ctx) -> bytes:
             t0 = now()
             b_in.inc(len(request))
             calls.inc()
+            wire_ctx = None
+            if trace_enabled():
+                try:
+                    meta, payload = unpack_msg(request)
+                    wire_ctx = meta.get("trace") or \
+                        (peek_trace(payload) if len(payload) else None)
+                except Exception:
+                    wire_ctx = None  # malformed request fails in fn, not here
             try:
-                reply = fn(request, ctx)
+                with use_wire_context(wire_ctx), \
+                        trace_span("rpc.server", rpc=name):
+                    reply = fn(request, ctx)
             finally:
                 hist.observe(now() - t0)
             b_out.inc(len(reply))

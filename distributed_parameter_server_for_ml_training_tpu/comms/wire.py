@@ -132,13 +132,25 @@ def _frame(header_obj: dict, bodies: list, flags: int = 0) -> bytes:
     return b"".join([preamble, header, *bodies])
 
 
-def encode_tensor_dict(tensors: Mapping[str, np.ndarray]) -> bytes:
-    """Encode to a single v2 frame (one buffer copy per tensor)."""
+def encode_tensor_dict(tensors: Mapping[str, np.ndarray],
+                       trace: dict | None = None) -> bytes:
+    """Encode to a single v2 frame (one buffer copy per tensor).
+
+    ``trace`` (optional, capability-gated by the caller —
+    docs/WIRE_PROTOCOL.md) adds a ``"trace"`` field to the v2 frame
+    header: ``{"trace_id": str, "span_id": str}``, the distributed-tracing
+    context of the worker operation that produced this payload. Decoders
+    that don't know the field ignore it (the tensor table is keyed), and
+    legacy v1 frames simply never carry one — mixed versions degrade to
+    untraced, never break."""
     metas, arrays = _prepare(tensors)
     for m, a in zip(metas, arrays):
         if a.nbytes:
             _note_copy(m["name"], "frame_write")
-    return _frame({"tensors": metas}, [_buffer_view(a) for a in arrays])
+    header: dict = {"tensors": metas}
+    if trace is not None:
+        header["trace"] = trace
+    return _frame(header, [_buffer_view(a) for a in arrays])
 
 
 def encode_tensor_dict_chunks(tensors: Mapping[str, np.ndarray],
@@ -285,6 +297,19 @@ def decode_tensor_dict(payload, *, copy: bool = False
     if flags & FLAG_CHUNK:
         raise ValueError("chunk frame: use decode_tensor_dict_chunks")
     return _tensors_from_body(header, body, copy)
+
+
+def peek_trace(payload) -> dict | None:
+    """Trace context from a frame header, or None (absent field, legacy v1
+    frame, malformed/empty payload — never raises: a garbled trace field
+    must degrade to untraced, not fail the RPC). Parses only the JSON
+    header; the tensor buffers are untouched."""
+    try:
+        header, _, _ = _parse_frame(payload)
+    except (ValueError, struct.error):
+        return None
+    trace = header.get("trace")
+    return trace if isinstance(trace, dict) else None
 
 
 def is_chunk_frame(payload) -> bool:

@@ -32,7 +32,7 @@ import numpy as np
 
 from ..ops.compression import _SCALE_SUFFIX
 from ..ps.store import MembershipMixin, StoreConfig, TelemetryMixin, _Stats
-from ..telemetry import now as _tnow
+from ..telemetry import now as _tnow, trace_span
 from .bindings import _f32p, _i8p, _i64p, _u16p, load_library
 
 
@@ -142,6 +142,11 @@ class NativeParameterStore(TelemetryMixin, MembershipMixin):
     def fetch(self, worker_id: int | None = None
               ) -> tuple[dict[str, np.ndarray], int]:
         t0 = _tnow()
+        with trace_span("store.fetch", backend=self.store_backend):
+            return self._fetch_traced(worker_id, t0)
+
+    def _fetch_traced(self, worker_id: int | None, t0: float
+                      ) -> tuple[dict[str, np.ndarray], int]:
         flat, step = self._fetch_flat()
         if worker_id is not None:
             self.last_seen[worker_id] = time.time()
@@ -233,10 +238,14 @@ class NativeParameterStore(TelemetryMixin, MembershipMixin):
     def push(self, worker_id: int, gradients: Mapping[str, np.ndarray],
              fetched_step: int) -> bool:
         t_push = _tnow()
-        try:
-            return self._push_timed(worker_id, gradients, fetched_step)
-        finally:
-            self._tm_push_s.observe(_tnow() - t_push)
+        with trace_span("store.push", backend=self.store_backend) as sp:
+            try:
+                accepted = self._push_timed(worker_id, gradients,
+                                            fetched_step)
+                sp.attrs["accepted"] = accepted
+                return accepted
+            finally:
+                self._tm_push_s.observe(_tnow() - t_push)
 
     def _push_timed(self, worker_id: int,
                     gradients: Mapping[str, np.ndarray],
@@ -259,19 +268,23 @@ class NativeParameterStore(TelemetryMixin, MembershipMixin):
         bound = int(self.config.staleness_bound)
         before = self.global_step
         self._tm_staleness.observe(before - int(fetched_step))
-        if packed[0] == "int8":
-            _, flat, scales = packed
-            new_step = int(self._lib.dps_store_push_int8(
-                self._handle, _i8p(flat), _f32p(scales),
-                _i64p(self._offsets), len(self._names),
-                int(fetched_step), bound))
-        elif packed[0] == "fp16":
-            new_step = int(self._lib.dps_store_push_fp16(
-                self._handle, _u16p(packed[1].view(np.uint16)),
-                int(fetched_step), bound))
-        else:
-            new_step = int(self._lib.dps_store_push_fp32(
-                self._handle, _f32p(packed[1]), int(fetched_step), bound))
+        with trace_span("store.apply", backend=self.store_backend,
+                        mode="async",
+                        staleness=before - int(fetched_step)):
+            if packed[0] == "int8":
+                _, flat, scales = packed
+                new_step = int(self._lib.dps_store_push_int8(
+                    self._handle, _i8p(flat), _f32p(scales),
+                    _i64p(self._offsets), len(self._names),
+                    int(fetched_step), bound))
+            elif packed[0] == "fp16":
+                new_step = int(self._lib.dps_store_push_fp16(
+                    self._handle, _u16p(packed[1].view(np.uint16)),
+                    int(fetched_step), bound))
+            else:
+                new_step = int(self._lib.dps_store_push_fp32(
+                    self._handle, _f32p(packed[1]), int(fetched_step),
+                    bound))
         if new_step < 0:
             self.stats.gradients_rejected += 1
             self._tm_push_rej.inc()
@@ -337,8 +350,10 @@ class NativeParameterStore(TelemetryMixin, MembershipMixin):
             t0 = time.time()
             try:
                 slots = np.fromiter(self._pending.values(), np.int64)
-                self._lib.dps_store_apply_mean(
-                    self._handle, _i64p(slots), len(slots))
+                with trace_span("store.apply", backend=self.store_backend,
+                                mode="sync", n_grads=len(slots)):
+                    self._lib.dps_store_apply_mean(
+                        self._handle, _i64p(slots), len(slots))
                 self.stats.total_parameter_updates += 1
                 dt = time.time() - t0
                 self.stats.update_times.append(dt)

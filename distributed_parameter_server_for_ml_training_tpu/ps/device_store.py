@@ -39,7 +39,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .store import AggregationBase, StoreConfig, _Stats
-from ..telemetry import now as _tnow
+from ..telemetry import now as _tnow, trace_span
 
 
 @jax.jit
@@ -138,9 +138,10 @@ class DeviceParameterStore(AggregationBase):
         (immutability makes the reference's copy-under-lock, server.py:222,
         free here)."""
         t0 = _tnow()
-        with self._param_lock:
-            payload = dict(self.parameters)
-            step = self.global_step
+        with trace_span("store.fetch", backend=self.store_backend):
+            with self._param_lock:
+                payload = dict(self.parameters)
+                step = self.global_step
         if worker_id is not None:
             self.last_seen[worker_id] = time.time()
         # NOTE: the span measures the dict-copy handoff (~us) — fetch here
@@ -170,10 +171,16 @@ class DeviceParameterStore(AggregationBase):
                       f"shape {g.shape} != server {p.shape}")
                 return False
         try:
-            if self.config.mode == "sync":
-                self._push_sync(worker_id, dict(gradients))
-                return True
-            return self._push_async(worker_id, dict(gradients), fetched_step)
+            with trace_span("store.push",
+                            backend=self.store_backend) as sp:
+                if self.config.mode == "sync":
+                    self._push_sync(worker_id, dict(gradients))
+                    sp.attrs["accepted"] = True
+                    return True
+                accepted = self._push_async(worker_id, dict(gradients),
+                                            fetched_step)
+                sp.attrs["accepted"] = accepted
+                return accepted
         finally:
             self._tm_push_s.observe(_tnow() - t0)
 

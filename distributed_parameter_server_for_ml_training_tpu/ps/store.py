@@ -37,7 +37,7 @@ from typing import Mapping
 import numpy as np
 
 from ..ops.compression import fp16_compress, fp16_decompress
-from ..telemetry import now as _tnow
+from ..telemetry import now as _tnow, trace_span
 from .semantics import (
     DEFAULT_STALENESS_BOUND,
     mean_gradients,
@@ -309,8 +309,15 @@ class AggregationBase(TelemetryMixin, MembershipMixin):
         if self._gradients_received >= self._round_target():
             t0 = time.time()
             try:
-                self._round_update(list(self._pending.values()),
-                                   self.config.learning_rate)
+                # The apply span parents on the handler/worker span of the
+                # push that COMPLETED the round — the causally responsible
+                # step (trace context is thread-local; the last pusher's
+                # thread runs the aggregation).
+                with trace_span("store.apply", backend=self.store_backend,
+                                mode="sync",
+                                n_grads=self._gradients_received):
+                    self._round_update(list(self._pending.values()),
+                                       self.config.learning_rate)
                 self.stats.total_parameter_updates += 1
             finally:
                 # The round MUST reset even if aggregation raises —
@@ -372,9 +379,12 @@ class AggregationBase(TelemetryMixin, MembershipMixin):
             return False
         weight = staleness_weight(staleness)
         t0 = time.time()
-        with self._param_lock:
-            self._apply(grads, self.config.learning_rate, weight)
-            self.global_step += 1
+        with trace_span("store.apply", backend=self.store_backend,
+                        mode="async", staleness=staleness,
+                        weight=round(weight, 4)):
+            with self._param_lock:
+                self._apply(grads, self.config.learning_rate, weight)
+                self.global_step += 1
         self._tm_step.set(self.global_step)
         measured = self._after_apply() is not False
         self.stats.gradients_processed += 1
@@ -526,28 +536,31 @@ class ParameterStore(AggregationBase):
         exactly "nothing changed".
         """
         t0 = _tnow()
-        with self._param_lock:
-            if have_step is not None and have_step == self.global_step:
-                payload, step, modified = {}, self.global_step, False
-            else:
-                payload = {k: v.copy() for k, v in self.parameters.items()}
-                step = self.global_step
-                modified = True
-        if worker_id is not None:
-            self.last_seen[worker_id] = time.time()
-        if not modified:
-            self._tm_fetch_nm.inc()
+        with trace_span("store.fetch", backend=self.store_backend) as sp:
+            with self._param_lock:
+                if have_step is not None and have_step == self.global_step:
+                    payload, step, modified = {}, self.global_step, False
+                else:
+                    payload = {k: v.copy()
+                               for k, v in self.parameters.items()}
+                    step = self.global_step
+                    modified = True
+            if worker_id is not None:
+                self.last_seen[worker_id] = time.time()
+            if not modified:
+                sp.attrs["not_modified"] = True
+                self._tm_fetch_nm.inc()
+                self._tm_fetch_s.observe(_tnow() - t0)
+                self._tm_fetches.inc()
+                return payload, step
+            if self.config.fetch_codec == "fp16":
+                payload = fp16_compress(payload)
+            elif self.config.fetch_codec == "bf16":
+                from ..ops.compression import bf16_compress
+                payload = bf16_compress(payload)
             self._tm_fetch_s.observe(_tnow() - t0)
             self._tm_fetches.inc()
             return payload, step
-        if self.config.fetch_codec == "fp16":
-            payload = fp16_compress(payload)
-        elif self.config.fetch_codec == "bf16":
-            from ..ops.compression import bf16_compress
-            payload = bf16_compress(payload)
-        self._tm_fetch_s.observe(_tnow() - t0)
-        self._tm_fetches.inc()
-        return payload, step
 
     def push(self, worker_id: int, gradients: Mapping[str, np.ndarray],
              fetched_step: int) -> bool:
@@ -561,10 +574,14 @@ class ParameterStore(AggregationBase):
         accepts, matching PushReply(received=True), server.py:286-288).
         """
         t0 = _tnow()
-        try:
-            return self._push_timed(worker_id, gradients, fetched_step)
-        finally:
-            self._tm_push_s.observe(_tnow() - t0)
+        with trace_span("store.push", backend=self.store_backend) as sp:
+            try:
+                accepted = self._push_timed(worker_id, gradients,
+                                            fetched_step)
+                sp.attrs["accepted"] = accepted
+                return accepted
+            finally:
+                self._tm_push_s.observe(_tnow() - t0)
 
     def _push_timed(self, worker_id: int,
                     gradients: Mapping[str, np.ndarray],

@@ -24,7 +24,12 @@ import jax
 import numpy as np
 
 from ..data.cifar import Dataset, make_batches, shard_range
-from ..telemetry import now as _tnow
+from ..telemetry import (
+    current_wire_trace,
+    now as _tnow,
+    trace_span,
+    use_wire_context,
+)
 from ..train.steps import make_eval_step, make_grad_step
 from ..utils.pytree import flatten_params, unflatten_params
 from .store import ParameterStore
@@ -194,23 +199,31 @@ class _CommsPipeline:
             self._go.clear()
             if self._stop:
                 return
-            grads, fetched_step, prefetch_current = self._item
+            grads, fetched_step, prefetch_current, wctx = self._item
             self._item = None
             t0 = _tnow()
             try:
-                if grads is not None:
-                    self._worker._push(self._worker_id, grads, fetched_step)
-                if prefetch_current is not None:
-                    result = self._worker._fetch_params(
-                        self._worker_id, have_step=fetched_step,
-                        current=prefetch_current)
-                    # Duration published BEFORE the ready flag: a waiter
-                    # that wakes immediately must see THIS item's comms
-                    # time in its overlap-savings record, not the
-                    # previous one's.
-                    self._last_comms_s = _tnow() - t0
-                    self._result = result
-                    self._result_ready.set()
+                # Adopt the submitting step's trace context so this item's
+                # comms span (and the RPC/store spans under it) attach to
+                # the step whose window hides the latency.
+                with use_wire_context(wctx), \
+                        trace_span("pipeline.comms",
+                                   worker=self._worker_id,
+                                   prefetch=prefetch_current is not None):
+                    if grads is not None:
+                        self._worker._push(self._worker_id, grads,
+                                           fetched_step)
+                    if prefetch_current is not None:
+                        result = self._worker._fetch_params(
+                            self._worker_id, have_step=fetched_step,
+                            current=prefetch_current)
+                        # Duration published BEFORE the ready flag: a
+                        # waiter that wakes immediately must see THIS
+                        # item's comms time in its overlap-savings
+                        # record, not the previous one's.
+                        self._last_comms_s = _tnow() - t0
+                        self._result = result
+                        self._result_ready.set()
             except Exception as e:
                 self._error = e
                 self._result_ready.set()  # wake a blocked await_params
@@ -228,7 +241,10 @@ class _CommsPipeline:
     def submit(self, grads, fetched_step: int, prefetch_current) -> None:
         self._done.wait()  # single-slot bound: previous item must be done
         self._raise_if_failed()
-        self._item = (grads, fetched_step, prefetch_current)
+        # Trace context captured on the TRAINING thread (the submitting
+        # step's push_wait span) — the comms thread re-enters it.
+        self._item = (grads, fetched_step, prefetch_current,
+                      current_wire_trace())
         self._pending_prefetch = prefetch_current is not None
         self._done.clear()
         self._tm_depth.set(1)
@@ -426,16 +442,27 @@ class PSWorker(threading.Thread):
                 # would cover the whole dataset. An overlapped pipeline's
                 # pending prefetch serves the same role (it IS a fetch,
                 # moments old, and refreshed the membership cache).
-                if pipe is not None and pipe.params_pending():
-                    params, fetched_step = pipe.await_params()
-                else:
-                    if pipe is not None:
-                        pipe.flush()  # a fetch must never overtake a push
-                    params, fetched_step = self._fetch_params(
-                        worker_id,
-                        have_step=(fetched_step if params is not None
-                                   else None),
-                        current=params)
+                # The opening fetch gets its own root trace entry (attr
+                # epoch_open): a worker stuck here — a stale server, a
+                # slow wire — shows up in the straggler report as a
+                # fetch-wait-dominant step rather than vanishing into
+                # epoch bookkeeping.
+                with trace_span("worker.step", root=True, worker=worker_id,
+                                step=self.result.local_steps_completed,
+                                epoch=epoch, epoch_open=True):
+                    with trace_span("worker.fetch_wait"):
+                        if pipe is not None and pipe.params_pending():
+                            params, fetched_step = pipe.await_params()
+                        else:
+                            if pipe is not None:
+                                # a fetch must never overtake a push
+                                pipe.flush()
+                            params, fetched_step = self._fetch_params(
+                                worker_id,
+                                have_step=(fetched_step
+                                           if params is not None
+                                           else None),
+                                current=params)
                 # Contiguous shard by worker id (worker.py:166-179); ids
                 # beyond total_workers wrap (vs the reference's skewed
                 # coverage, SURVEY.md quirk 10). Recomputed each epoch: in
@@ -448,48 +475,75 @@ class PSWorker(threading.Thread):
                         x_shard, y_shard, cfg.batch_size,
                         seed=cfg.seed * 1000 + epoch)):
                     boundary = batch_idx % k == 0
-                    if boundary and batch_idx > 0:
-                        if pipe is not None and pipe.params_pending():
-                            # The prefetch issued right after the window's
-                            # push — its latency ran under the window's
-                            # compute instead of on the critical path.
-                            params, fetched_step = pipe.await_params()
-                        else:
-                            if pipe is not None:
-                                pipe.flush()
-                            params, fetched_step = self._fetch_params(
-                                worker_id, have_step=fetched_step,
-                                current=params)
+                    # One ROOT trace per loop iteration: fetch wait,
+                    # compute, and push wait nest under it, the push's
+                    # context crosses the wire, and the server's
+                    # handler/store/apply spans join the same trace —
+                    # the per-step causal tree the critical-path
+                    # attribution consumes (analysis/traces.py).
+                    step_span = trace_span(
+                        "worker.step", root=True, worker=worker_id,
+                        step=self.result.local_steps_completed,
+                        epoch=epoch)
+                    with step_span:
+                        if boundary and batch_idx > 0:
+                            with trace_span("worker.fetch_wait"):
+                                if pipe is not None \
+                                        and pipe.params_pending():
+                                    # The prefetch issued right after the
+                                    # window's push — its latency ran
+                                    # under the window's compute instead
+                                    # of on the critical path.
+                                    params, fetched_step = \
+                                        pipe.await_params()
+                                else:
+                                    if pipe is not None:
+                                        pipe.flush()
+                                    params, fetched_step = \
+                                        self._fetch_params(
+                                            worker_id,
+                                            have_step=fetched_step,
+                                            current=params)
 
-                    t_step = _tnow()
-                    grads, batch_stats, loss, acc = self._grad_step(
-                        params, batch_stats, xb, yb, rng,
-                        self.result.local_steps_completed)
-                    # Span = dispatch-to-return of the compiled step. Under
-                    # jax async dispatch that can undercount device time on
-                    # non-boundary batches; boundary steps (push/fetch)
-                    # force completion, so the per-window totals stay
-                    # honest.
-                    self._tm_step_s.observe(_tnow() - t_step)
-                    self._tm_steps.inc()
-                    self.result.local_steps_completed += 1
+                        t_step = _tnow()
+                        with trace_span("worker.compute") as _csp:
+                            grads, batch_stats, loss, acc = \
+                                self._grad_step(
+                                    params, batch_stats, xb, yb, rng,
+                                    self.result.local_steps_completed)
+                            if _csp.ctx is not None:
+                                # Tracing: pin jax's async dispatch so
+                                # device time lands on THIS span instead
+                                # of on whichever later span first
+                                # materializes the grads (the codec's
+                                # device_get would otherwise absorb the
+                                # whole step and poison the attribution).
+                                jax.block_until_ready(grads)
+                        # Span = dispatch-to-return of the compiled step.
+                        # Under jax async dispatch that can undercount
+                        # device time on non-boundary batches; boundary
+                        # steps (push/fetch) force completion, so the
+                        # per-window totals stay honest.
+                        self._tm_step_s.observe(_tnow() - t_step)
+                        self._tm_steps.inc()
+                        self.result.local_steps_completed += 1
 
-                    if cfg.k_step_mode == "accumulate" and k > 1:
-                        accum = grads if accum is None else \
-                            jax.tree_util.tree_map(
-                                lambda a, b: a + b, accum, grads)
-                        accum_n += 1
-                        if accum_n == k:
-                            self._dispatch_push_mean(
-                                pipe, worker_id, accum, accum_n,
-                                fetched_step, params)
-                            accum, accum_n = None, 0
-                    elif boundary:
-                        # Faithful: push THIS batch's gradients; the other
-                        # K-1 batches' gradients are computed and dropped
-                        # (quirk 7).
-                        self._dispatch_push(pipe, worker_id, grads,
-                                            fetched_step, params)
+                        if cfg.k_step_mode == "accumulate" and k > 1:
+                            accum = grads if accum is None else \
+                                jax.tree_util.tree_map(
+                                    lambda a, b: a + b, accum, grads)
+                            accum_n += 1
+                            if accum_n == k:
+                                self._dispatch_push_mean(
+                                    pipe, worker_id, accum, accum_n,
+                                    fetched_step, params)
+                                accum, accum_n = None, 0
+                        elif boundary:
+                            # Faithful: push THIS batch's gradients; the
+                            # other K-1 batches' gradients are computed
+                            # and dropped (quirk 7).
+                            self._dispatch_push(pipe, worker_id, grads,
+                                                fetched_step, params)
 
                 # An epoch ending mid-window flushes the partial
                 # accumulator, divided by the ACTUAL number of accumulated
@@ -511,8 +565,10 @@ class PSWorker(threading.Thread):
                 self.result.epoch_times.append(time.time() - t_epoch)
                 self._tm_epochs.inc()
                 if cfg.eval_each_epoch:
-                    self.result.test_accuracies.append(
-                        self.evaluate(params, batch_stats))
+                    with trace_span("worker.eval", root=True,
+                                    worker=worker_id, epoch=epoch):
+                        self.result.test_accuracies.append(
+                            self.evaluate(params, batch_stats))
                     self._tm_acc.set(self.result.test_accuracies[-1])
                 # Per-epoch progress line (the reference workers logged
                 # epochs to CloudWatch, worker.py:329-335);
@@ -531,19 +587,27 @@ class PSWorker(threading.Thread):
     def _dispatch_push(self, pipe, worker_id: int, grads_tree,
                        fetched_step: int, params) -> None:
         """Push now (serial) or hand to the comms pipeline with a prefetch
-        of the next params riding behind it (overlapped)."""
-        if pipe is None:
-            self._push(worker_id, grads_tree, fetched_step)
-        else:
-            pipe.submit(grads_tree, fetched_step, prefetch_current=params)
+        of the next params riding behind it (overlapped).
+
+        The push_wait span is the training thread's blocked time either
+        way: the full push RPC when serial, the single-slot backpressure
+        when overlapped (near zero while the pipeline keeps up — the
+        overlap win, visible per step in the trace)."""
+        with trace_span("worker.push_wait"):
+            if pipe is None:
+                self._push(worker_id, grads_tree, fetched_step)
+            else:
+                pipe.submit(grads_tree, fetched_step,
+                            prefetch_current=params)
 
     def _dispatch_push_mean(self, pipe, worker_id: int, accum_tree, n: int,
                             fetched_step: int, params) -> None:
-        if pipe is None:
-            self._push_mean(worker_id, accum_tree, n, fetched_step)
-        else:
-            pipe.submit(_window_mean(accum_tree, n), fetched_step,
-                        prefetch_current=params)
+        with trace_span("worker.push_wait"):
+            if pipe is None:
+                self._push_mean(worker_id, accum_tree, n, fetched_step)
+            else:
+                pipe.submit(_window_mean(accum_tree, n), fetched_step,
+                            prefetch_current=params)
 
     def _fetch_params(self, worker_id: int, have_step: int | None = None,
                       current=None):
@@ -564,20 +628,24 @@ class PSWorker(threading.Thread):
                 return current, fetched_step
         else:
             flat, fetched_step = self.store.fetch(worker_id)
-        if (getattr(self.store, "fetch_codec", "none") in ("fp16", "bf16")
-                and not getattr(self.store, "decompresses_fetches", False)):
-            # In-process compressed fetch (RemoteStore already decompressed
-            # client-side — casting again would copy the full parameter
-            # set a second time per fetch for nothing).
-            from ..ops.compression import fp16_decompress
-            flat = fp16_decompress(flat)
-        if not getattr(self.store, "keeps_device_arrays", False):
-            # Decoded (fp32) payload bytes; the on-the-wire size lives in
-            # the RPC-layer counters (device stores move zero bytes — skip).
-            self._tm_fetch_post.inc(
-                sum(int(v.nbytes) for v in flat.values()))
-        self._last_fetched_step = fetched_step
-        return unflatten_params(flat), fetched_step
+        with trace_span("worker.codec", stage="decode"):
+            if (getattr(self.store, "fetch_codec", "none")
+                    in ("fp16", "bf16")
+                    and not getattr(self.store, "decompresses_fetches",
+                                    False)):
+                # In-process compressed fetch (RemoteStore already
+                # decompressed client-side — casting again would copy the
+                # full parameter set a second time per fetch for nothing).
+                from ..ops.compression import fp16_decompress
+                flat = fp16_decompress(flat)
+            if not getattr(self.store, "keeps_device_arrays", False):
+                # Decoded (fp32) payload bytes; the on-the-wire size
+                # lives in the RPC-layer counters (device stores move
+                # zero bytes — skip).
+                self._tm_fetch_post.inc(
+                    sum(int(v.nbytes) for v in flat.values()))
+            self._last_fetched_step = fetched_step
+            return unflatten_params(flat), fetched_step
 
     def _push_mean(self, worker_id, accum_tree, n: int,
                    fetched_step) -> None:
@@ -585,27 +653,29 @@ class PSWorker(threading.Thread):
         self._push(worker_id, _window_mean(accum_tree, n), fetched_step)
 
     def _push(self, worker_id, grads_tree, fetched_step) -> None:
-        if getattr(self.store, "keeps_device_arrays", False):
-            # Device-resident store: hand over the device arrays untouched —
-            # no host round-trip, no wire, no codec.
-            flat = flatten_params(grads_tree, as_numpy=False)
-        else:
-            flat = flatten_params(jax.device_get(grads_tree))
-            pre_bytes = sum(int(v.nbytes) for v in flat.values())
-            # Worker-side compression (worker.py:264-268): the store/service
-            # advertises its codec; the encode happens here, once, before
-            # the wire (fp16 = the reference's cast; int8 = per-tensor
-            # symmetric quantization at ~half fp16's bytes).
-            codec = getattr(self.store, "push_codec", "none")
-            if codec == "fp16":
-                from ..ops.compression import fp16_compress
-                flat = fp16_compress(flat)
-            elif codec == "int8":
-                from ..ops.compression import int8_wire_compress
-                flat = int8_wire_compress(flat)
-            self._tm_push_pre.inc(pre_bytes)
-            self._tm_push_wire.inc(
-                sum(int(v.nbytes) for v in flat.values()))
+        with trace_span("worker.codec", stage="encode"):
+            if getattr(self.store, "keeps_device_arrays", False):
+                # Device-resident store: hand over the device arrays
+                # untouched — no host round-trip, no wire, no codec.
+                flat = flatten_params(grads_tree, as_numpy=False)
+            else:
+                flat = flatten_params(jax.device_get(grads_tree))
+                pre_bytes = sum(int(v.nbytes) for v in flat.values())
+                # Worker-side compression (worker.py:264-268): the store/
+                # service advertises its codec; the encode happens here,
+                # once, before the wire (fp16 = the reference's cast;
+                # int8 = per-tensor symmetric quantization at ~half
+                # fp16's bytes).
+                codec = getattr(self.store, "push_codec", "none")
+                if codec == "fp16":
+                    from ..ops.compression import fp16_compress
+                    flat = fp16_compress(flat)
+                elif codec == "int8":
+                    from ..ops.compression import int8_wire_compress
+                    flat = int8_wire_compress(flat)
+                self._tm_push_pre.inc(pre_bytes)
+                self._tm_push_wire.inc(
+                    sum(int(v.nbytes) for v in flat.values()))
         if self.store.push(worker_id, flat, fetched_step):
             self.result.pushes_accepted += 1
         else:

@@ -1,4 +1,5 @@
-"""Live telemetry: registry + spans + snapshot stream + Prometheus endpoint.
+"""Live telemetry: registry + spans + snapshot stream + Prometheus endpoint
++ distributed tracing with a crash-safe flight recorder.
 
 The layer SURVEY.md §5.5 couldn't have: the reference emitted one
 ``METRICS_JSON`` line per process *at exit* and nothing before it. Here the
@@ -11,10 +12,15 @@ in all three backends) record into a process-global
   the existing ETL (`analysis/parse_logs.py`, CloudWatch-style scraping,
   pod-log ssh collection) gains time-series without changes;
 - :func:`~.prometheus.start_metrics_server` — ``GET /metrics`` text
-  exposition + ``/healthz`` from the serving process.
+  exposition + ``/healthz`` + ``/debug/trace`` from the serving process.
 
-Metric names, bucket schemes, and the snapshot line format are documented
-in docs/OBSERVABILITY.md.
+The third surface is causal rather than aggregate: :mod:`.trace` carries a
+per-step trace context through the worker loop and across the wire, records
+finished spans into a bounded per-process flight recorder, and dumps the
+tail on SIGTERM/unhandled-fault/atexit — see docs/OBSERVABILITY.md.
+
+Metric names, bucket schemes, span names, and the snapshot line format are
+documented in docs/OBSERVABILITY.md.
 """
 
 from .registry import (
@@ -26,23 +32,55 @@ from .registry import (
     MetricsRegistry,
     STALENESS_BUCKETS,
     get_registry,
+    register_build_info,
 )
 from .snapshot import SnapshotEmitter
 from .spans import now, span
 from .prometheus import render_prometheus, start_metrics_server
+from .trace import (
+    SPAN_CATALOG,
+    FlightRecorder,
+    TraceContext,
+    add_shutdown_flush,
+    current_context,
+    current_wire_trace,
+    disable_tracing,
+    enable_tracing,
+    get_recorder,
+    install_shutdown_hooks,
+    remove_shutdown_flush,
+    trace_enabled,
+    trace_span,
+    use_wire_context,
+)
 
 __all__ = [
     "BYTES_BUCKETS",
     "Counter",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "LATENCY_BUCKETS_S",
     "MetricsRegistry",
     "STALENESS_BUCKETS",
+    "SPAN_CATALOG",
     "SnapshotEmitter",
+    "TraceContext",
+    "add_shutdown_flush",
+    "current_context",
+    "current_wire_trace",
+    "disable_tracing",
+    "enable_tracing",
+    "get_recorder",
     "get_registry",
+    "install_shutdown_hooks",
     "now",
+    "register_build_info",
+    "remove_shutdown_flush",
     "render_prometheus",
     "span",
     "start_metrics_server",
+    "trace_enabled",
+    "trace_span",
+    "use_wire_context",
 ]

@@ -84,11 +84,28 @@ class _MetricsHandler(BaseHTTPRequestHandler):
     registry: MetricsRegistry  # set by start_metrics_server
 
     def do_GET(self):  # noqa: N802 (http.server API)
-        if self.path.split("?")[0] == "/metrics":
+        path, _, query = self.path.partition("?")
+        if path == "/metrics":
             body = render_prometheus(self.registry).encode()
             ctype = "text/plain; version=0.0.4; charset=utf-8"
-        elif self.path.split("?")[0] == "/healthz":
+        elif path == "/healthz":
             body = json.dumps({"ok": True}).encode()
+            ctype = "application/json"
+        elif path == "/debug/trace":
+            # On-demand flight-recorder dump (docs/OBSERVABILITY.md): the
+            # same payload a SIGTERM post-mortem writes, served live.
+            # ``?n=100`` limits to the most recent N spans.
+            from .trace import get_recorder, trace_enabled
+            n = None
+            for part in query.split("&"):
+                if part.startswith("n="):
+                    try:
+                        n = max(0, int(part[2:]))
+                    except ValueError:
+                        pass
+            payload = get_recorder().dump_payload(reason="on_demand", n=n)
+            payload["enabled"] = trace_enabled()
+            body = json.dumps(payload).encode()
             ctype = "application/json"
         else:
             self.send_error(404)

@@ -29,6 +29,7 @@ Design constraints, in order:
 
 from __future__ import annotations
 
+import sys
 import threading
 from bisect import bisect_left
 
@@ -247,3 +248,18 @@ _DEFAULT = MetricsRegistry()
 
 def get_registry() -> MetricsRegistry:
     return _DEFAULT
+
+
+def register_build_info(registry: MetricsRegistry | None = None) -> Gauge:
+    """Register the ``dps_build_info`` gauge (value 1; the information is
+    in the labels: package version, jax version, host platform) — the
+    standard Prometheus idiom for fleet-wide scrape correlation: join any
+    other series on the target to see which build produced it."""
+    import jax
+
+    from .. import __version__
+    g = (registry or get_registry()).gauge(
+        "dps_build_info", version=__version__, jax=jax.__version__,
+        platform=sys.platform)
+    g.set(1)
+    return g

@@ -79,6 +79,15 @@ class SnapshotEmitter:
         while not self._stop.wait(self.interval):
             self.emit_once()
 
+    def flush_now(self) -> None:
+        """Shutdown-hook form of :meth:`emit_once`: flush one final
+        snapshot unless the emitter was already stopped (whose ``stop``
+        emitted the final line). Registered with
+        ``telemetry.add_shutdown_flush`` so a SIGTERM'd process's tail
+        interval is never silently dropped (ISSUE 3 satellite)."""
+        if not self._stop.is_set():
+            self.emit_once()
+
     def start(self) -> "SnapshotEmitter":
         if self._thread is not None:
             raise RuntimeError("emitter already started")

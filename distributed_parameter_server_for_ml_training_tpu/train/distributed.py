@@ -146,7 +146,7 @@ class SyncTrainer:
         # Live telemetry (telemetry/): the sync trainer IS the whole
         # server+workers deployment here, so one set of mode-labeled
         # instruments gives the snapshot stream its throughput series.
-        from ..telemetry import get_registry, now as _tnow
+        from ..telemetry import get_registry, now as _tnow, trace_span
         reg = get_registry()
         tm_step_s = reg.histogram("dps_trainer_step_seconds", mode="sync")
         tm_steps = reg.counter("dps_trainer_steps_total", mode="sync")
@@ -166,7 +166,13 @@ class SyncTrainer:
                                        seed=cfg.seed * 997 + epoch):
                 bi, bl = self._shard((xb, yb))
                 t_step = _tnow()
-                self.state, m = self._step(self.state, bi, bl, rng)
+                # Root span per SPMD step: there are no comms phases here
+                # (the all-reduce is inside the compiled program), so the
+                # trace's value is the step-time series itself — same
+                # dispatch-to-return caveat as the histogram below.
+                with trace_span("trainer.step", root=True, mode="sync",
+                                step=self.global_steps, epoch=epoch):
+                    self.state, m = self._step(self.state, bi, bl, rng)
                 losses.append(m["loss"])
                 # Span = dispatch-to-return; appending m["loss"] keeps a
                 # handle the epoch print later forces, and the per-epoch

@@ -1,0 +1,97 @@
+"""Docs <-> code drift guard (ISSUE 3 satellite, tier-1).
+
+docs/OBSERVABILITY.md is the operator-facing contract for metric and span
+names; this static check pins it to the code in BOTH directions:
+
+- every ``dps_*`` metric registered anywhere in the package appears in the
+  doc, and every ``dps_*`` name the doc mentions is actually registered
+  (a renamed metric that leaves a stale dashboard recipe fails CI, not a
+  production debugging session);
+- every span name in ``telemetry.SPAN_CATALOG`` is documented, every
+  span-like name the doc mentions exists in the catalog, and every
+  ``trace_span(...)`` call site in the package uses a catalog name.
+
+Pure text analysis — no training, no jax beyond the package import.
+"""
+
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+from distributed_parameter_server_for_ml_training_tpu.telemetry import (
+    SPAN_CATALOG)
+
+REPO = Path(__file__).resolve().parents[1]
+PKG = REPO / "distributed_parameter_server_for_ml_training_tpu"
+OBS_DOC = REPO / "docs" / "OBSERVABILITY.md"
+
+#: An instrument registration: ``.counter("dps_...")`` / ``.gauge(...)`` /
+#: ``.histogram(...)`` — possibly line-wrapped between the paren and the
+#: name literal. Comparison string literals (ETL name matches in
+#: analysis/parse_logs.py) deliberately do NOT match.
+_REG_RE = re.compile(
+    r'\.(?:counter|gauge|histogram)\(\s*"(dps_[a-z0-9_]+)"', re.S)
+
+_DOC_METRIC_RE = re.compile(r"dps_[a-z0-9_]+")
+
+#: A span name mentioned in the doc: backticked, dotted, first segment
+#: from the known namespaces. File mentions like ``ps/worker.py`` don't
+#: match (the backtick is not immediately followed by the namespace);
+#: ``.py`` tails are filtered below for safety.
+_DOC_SPAN_RE = re.compile(
+    r"`((?:worker|rpc|store|pipeline|trainer)\.[a-z_]+)`")
+
+_CALLSITE_RE = re.compile(r'trace_span\(\s*"([a-z_.]+)"', re.S)
+
+
+def _package_sources() -> list[tuple[Path, str]]:
+    return [(p, p.read_text()) for p in sorted(PKG.rglob("*.py"))]
+
+
+def test_every_registered_metric_is_documented_and_vice_versa():
+    registered: set[str] = set()
+    for _, text in _package_sources():
+        registered |= set(_REG_RE.findall(text))
+    assert registered, "no registrations found — regex rotted?"
+    documented = set(_DOC_METRIC_RE.findall(OBS_DOC.read_text()))
+    missing_from_doc = sorted(registered - documented)
+    unknown_in_doc = sorted(documented - registered)
+    assert not missing_from_doc, (
+        f"metrics registered in code but absent from docs/OBSERVABILITY.md:"
+        f" {missing_from_doc}")
+    assert not unknown_in_doc, (
+        f"docs/OBSERVABILITY.md mentions metrics no code registers "
+        f"(renamed or removed?): {unknown_in_doc}")
+
+
+def test_every_catalog_span_is_documented_and_vice_versa():
+    doc_spans = {n for n in _DOC_SPAN_RE.findall(OBS_DOC.read_text())
+                 if not n.endswith(".py")}
+    catalog = set(SPAN_CATALOG)
+    missing_from_doc = sorted(catalog - doc_spans)
+    unknown_in_doc = sorted(doc_spans - catalog)
+    assert not missing_from_doc, (
+        f"SPAN_CATALOG names absent from docs/OBSERVABILITY.md: "
+        f"{missing_from_doc}")
+    assert not unknown_in_doc, (
+        f"docs/OBSERVABILITY.md mentions span names not in SPAN_CATALOG: "
+        f"{unknown_in_doc}")
+
+
+def test_every_trace_span_call_site_uses_a_catalog_name():
+    offenders = []
+    for path, text in _package_sources():
+        for name in _CALLSITE_RE.findall(text):
+            if name not in SPAN_CATALOG:
+                offenders.append((str(path.relative_to(REPO)), name))
+    assert not offenders, (
+        f"trace_span() call sites with names missing from SPAN_CATALOG "
+        f"(add them there AND to docs/OBSERVABILITY.md): {offenders}")
+
+
+def test_catalog_names_are_namespaced_and_lowercase():
+    for name in SPAN_CATALOG:
+        assert re.fullmatch(r"[a-z]+\.[a-z_]+", name), name
+        assert name.split(".")[0] in {"worker", "rpc", "store",
+                                      "pipeline", "trainer"}, name
