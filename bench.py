@@ -169,6 +169,20 @@ def run_bench(args) -> dict:
             retries=getattr(args, "init_retries", INIT_RETRIES),
             backoff=getattr(args, "init_backoff", INIT_BACKOFF_S),
             cpu_fallback=not getattr(args, "no_cpu_fallback", False))
+        if fallback == "cpu":
+            # The TPU-sized default workload (3072 x 80) takes HOURS on a
+            # 1-core CPU — the fallback record would time out instead of
+            # landing, defeating its whole purpose. Shrink to the workload
+            # the 1-core environment is known to finish in ~2 min
+            # (compile dominates; 64x4 already blew a 10-minute budget).
+            # The record is already marked platform_fallback, so its
+            # absolute number is never compared against chip numbers.
+            args.batch_size = min(args.batch_size, 16)
+            args.scan_steps = min(args.scan_steps, 2)
+            args.trials = min(args.trials, 1)
+            print(f"cpu fallback: shrinking workload to batch "
+                  f"{args.batch_size} x {args.scan_steps} steps x "
+                  f"{args.trials} trials", file=sys.stderr)
 
         stage = "build"
         import jax.numpy as jnp

@@ -11,9 +11,13 @@ state holders checkpoint natively:
 from .manager import (
     CheckpointManager,
     PeriodicStoreCheckpointer,
+    STORE_SNAPSHOT_VERSION,
+    load_store_record,
+    restore_server_state,
     restore_store,
     save_store,
 )
 
-__all__ = ["CheckpointManager", "PeriodicStoreCheckpointer", "save_store",
-           "restore_store"]
+__all__ = ["CheckpointManager", "PeriodicStoreCheckpointer",
+           "STORE_SNAPSHOT_VERSION", "load_store_record",
+           "restore_server_state", "restore_store", "save_store"]

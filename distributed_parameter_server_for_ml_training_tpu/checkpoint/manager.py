@@ -5,6 +5,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 
 import jax
 import numpy as np
@@ -76,8 +77,20 @@ class CheckpointManager:
 
 # -- async store snapshots ----------------------------------------------------
 
-def save_store(store: ParameterStore, directory: str) -> str:
-    """Atomic snapshot of a parameter store: params npz + metadata JSON.
+#: Store-snapshot record format. v1 was (npz, {global_step,...} json); v2
+#: adds the aggregation-config block and the push-token journal that make a
+#: server restart transparent to retrying clients (docs/ROBUSTNESS.md).
+#: Restore accepts both.
+STORE_SNAPSHOT_VERSION = 2
+
+
+def save_store(store: ParameterStore, directory: str,
+               journal_fn=None) -> str:
+    """Atomic, versioned snapshot of a parameter store: params npz +
+    metadata JSON (format v2: global step, aggregation-mode config, and —
+    via ``journal_fn``, typically ``ParameterService.journal_snapshot`` —
+    the bounded journal of recent push-token outcomes, so a restarted
+    server still dedupes pre-crash push retries).
 
     Works for every store backend through the uniform ``snapshot()`` surface:
     host-numpy ParameterStore (copy under param_lock), HBM-resident
@@ -87,30 +100,60 @@ def save_store(store: ParameterStore, directory: str) -> str:
     (baseline_summary.json distributed_system_targets; SURVEY.md §4).
     """
     os.makedirs(directory, exist_ok=True)
+    # Journal BEFORE params: steps are monotonic, so every journaled
+    # outcome's apply is at a step <= the snapshot step and therefore
+    # INCLUDED in the saved params — a restored server can never answer
+    # "duplicate, accepted" for a gradient its restored params lack (the
+    # silent-loss failure). The reverse ordering would allow exactly
+    # that. The residual window (a push applying between the two
+    # captures is in params but not the journal, so its retry re-applies
+    # after a crash) is microseconds wide and errs toward an extra
+    # down-weighted gradient rather than a lost-but-claimed one.
+    journal = list(journal_fn()) if journal_fn is not None else []
     arrays, step = store.snapshot()
-    # Unique temp name per call: concurrent snapshots (periodic thread +
-    # final snapshot) must never interleave writes into one file.
-    tmp = os.path.join(directory,
-                       f".tmp-{os.getpid()}-{threading.get_ident()}.npz")
-    np.savez(tmp, **arrays)
-    final = os.path.join(directory, f"store_{step:08d}.npz")
-    os.replace(tmp, final)
+    cfg = store.config
     meta = {
+        "format_version": STORE_SNAPSHOT_VERSION,
         "global_step": step,
-        "mode": store.config.mode,
-        "total_workers": store.config.total_workers,
-        "learning_rate": store.config.learning_rate,
-        "staleness_bound": store.config.staleness_bound,
+        "mode": cfg.mode,
+        "total_workers": cfg.total_workers,
+        "learning_rate": cfg.learning_rate,
+        "staleness_bound": cfg.staleness_bound,
+        "aggregation": {
+            "mode": cfg.mode,
+            "learning_rate": cfg.learning_rate,
+            "staleness_bound": cfg.staleness_bound,
+            "total_workers": cfg.total_workers,
+            "strict_rounds": bool(getattr(cfg, "strict_rounds", False)),
+            "elastic": bool(getattr(cfg, "elastic", False)),
+            "push_codec": getattr(store, "push_codec", None),
+            "fetch_codec": getattr(store, "fetch_codec", "none"),
+        },
+        "push_journal": journal,
+        "saved_at": time.time(),
     }
-    with open(os.path.join(directory, f"store_{step:08d}.json"), "w") as f:
+    # Unique temp names per call: concurrent snapshots (periodic thread +
+    # final snapshot) must never interleave writes into one file. Publish
+    # order is json THEN npz: restore discovers records by .npz, so a
+    # crash between the two renames leaves either a harmless orphan json
+    # or nothing — never a visible npz without its metadata.
+    suffix = f"{os.getpid()}-{threading.get_ident()}"
+    tmp_npz = os.path.join(directory, f".tmp-{suffix}.npz")
+    tmp_json = os.path.join(directory, f".tmp-{suffix}.json")
+    np.savez(tmp_npz, **arrays)
+    with open(tmp_json, "w") as f:
         json.dump(meta, f)
+    final = os.path.join(directory, f"store_{step:08d}.npz")
+    os.replace(tmp_json, os.path.join(directory, f"store_{step:08d}.json"))
+    os.replace(tmp_npz, final)
     return final
 
 
-def restore_store(store: ParameterStore, directory: str,
-                  step: int | None = None) -> int:
-    """Load the newest (or given-step) snapshot into the store. Returns the
-    restored global step."""
+def load_store_record(directory: str, step: int | None = None
+                      ) -> tuple[dict[str, np.ndarray], dict]:
+    """Read the newest (or given-step) snapshot -> (params, meta dict).
+    v1 records (no ``format_version``) load with an empty journal and no
+    aggregation block."""
     snaps = sorted(f for f in os.listdir(directory)
                    if f.startswith("store_") and f.endswith(".npz"))
     if not snaps:
@@ -126,8 +169,46 @@ def restore_store(store: ParameterStore, directory: str,
                            name.replace(".npz", ".json"))) as f:
         meta = json.load(f)
     params = {k: np.array(data[k], np.float32) for k in data.files}
+    return params, meta
+
+
+def restore_store(store: ParameterStore, directory: str,
+                  step: int | None = None) -> int:
+    """Load the newest (or given-step) snapshot into the store. Returns the
+    restored global step (also published as the ``dps_store_restore_step``
+    gauge, so telemetry streams show where a restarted server resumed)."""
+    params, meta = load_store_record(directory, step)
     store.load_snapshot(params, int(meta["global_step"]))
+    from ..telemetry import get_registry
+    get_registry().gauge(
+        "dps_store_restore_step",
+        backend=getattr(store, "store_backend", "python"),
+    ).set(store.global_step)
     return store.global_step
+
+
+def restore_server_state(store: ParameterStore, service, directory: str,
+                         step: int | None = None,
+                         record: tuple | None = None) -> tuple[int, int]:
+    """Full server-side restore: params + step into the store, push-token
+    journal into the service's dedupe table. Returns (restored_step,
+    journal_entries_loaded). The one-call recovery path ``cli serve
+    --restore`` uses. ``record`` accepts an already-loaded
+    ``(params, meta)`` pair so a caller that inspected the snapshot first
+    (config adoption) restores the SAME record it read — re-listing the
+    directory could pick up a newer snapshot published in between."""
+    params, meta = record if record is not None \
+        else load_store_record(directory, step)
+    store.load_snapshot(params, int(meta["global_step"]))
+    from ..telemetry import get_registry
+    get_registry().gauge(
+        "dps_store_restore_step",
+        backend=getattr(store, "store_backend", "python"),
+    ).set(store.global_step)
+    loaded = 0
+    if service is not None:
+        loaded = service.load_journal(meta.get("push_journal", []))
+    return store.global_step, loaded
 
 
 class PeriodicStoreCheckpointer(threading.Thread):
@@ -141,11 +222,15 @@ class PeriodicStoreCheckpointer(threading.Thread):
     """
 
     def __init__(self, store: ParameterStore, directory: str,
-                 interval: float = 30.0):
+                 interval: float = 30.0, journal_fn=None):
         super().__init__(daemon=True)
         self.store = store
         self.directory = directory
         self.interval = interval
+        #: Optional push-token journal source (typically
+        #: ``ParameterService.journal_snapshot``), persisted into every
+        #: snapshot so a restart keeps deduping pre-crash push retries.
+        self.journal_fn = journal_fn
         self.last_error: Exception | None = None
         # NB: must not be named _stop — that would shadow
         # threading.Thread._stop(), which join() calls internally.
@@ -154,12 +239,23 @@ class PeriodicStoreCheckpointer(threading.Thread):
     def run(self):
         while not self._stop_event.wait(self.interval):
             try:
-                save_store(self.store, self.directory)
+                save_store(self.store, self.directory,
+                           journal_fn=self.journal_fn)
                 self.last_error = None
             except Exception as e:  # noqa: BLE001 — keep snapshotting
                 self.last_error = e
                 print(f"periodic store snapshot failed (will retry in "
                       f"{self.interval:.0f}s): {e!r}")
+
+    def flush_now(self) -> None:
+        """One immediate snapshot, independent of the tick — registered as
+        a telemetry shutdown flush (``add_shutdown_flush``) so SIGTERM
+        drains the store's end state through the same path that dumps the
+        flight recorder. Exceptions propagate to the shutdown runner,
+        which swallows them (a failed final snapshot must not mask the
+        shutdown itself); the periodic ``last_error`` is left for the
+        next tick's bookkeeping."""
+        save_store(self.store, self.directory, journal_fn=self.journal_fn)
 
     def stop(self, final_snapshot: bool = True) -> Exception | None:
         """Stop the thread; returns the last unrecovered periodic failure
@@ -171,6 +267,7 @@ class PeriodicStoreCheckpointer(threading.Thread):
             # The final snapshot still raises on failure: unlike a periodic
             # tick there is no later retry, and the caller must know the
             # run's end state was not persisted.
-            save_store(self.store, self.directory)
+            save_store(self.store, self.directory,
+                       journal_fn=self.journal_fn)
             self.last_error = None
         return self.last_error

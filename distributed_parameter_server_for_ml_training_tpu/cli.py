@@ -247,6 +247,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "numpy, C++ arena (the multi-host host-side hot "
                         "path the native core was built for), or "
                         "HBM-resident")
+    s.add_argument("--checkpoint-dir",
+                   default=_env("DPS_CHECKPOINT_DIR", None),
+                   help="durable server state (docs/ROBUSTNESS.md): "
+                        "periodic atomic snapshots of params + step + "
+                        "aggregation config + the push-token journal, "
+                        "plus a final snapshot on SIGTERM/exit")
+    s.add_argument("--checkpoint-interval", type=float,
+                   default=_env("DPS_CHECKPOINT_INTERVAL", 30.0, float),
+                   help="seconds between periodic store snapshots")
+    s.add_argument("--restore", action="store_true",
+                   help="resume from the newest snapshot in "
+                        "--checkpoint-dir: params + global step restored, "
+                        "push-token journal re-seeded so pre-crash push "
+                        "retries still dedupe")
+    s.add_argument("--faults", default=_env("DPS_FAULTS_SERVER", None),
+                   help="deterministic server-side fault injection spec "
+                        "(comms/faults.py), e.g. "
+                        "'seed=7;push.drop_reply@n=3;any.kill@n=40'")
     add_platform(s)
     add_telemetry(s)
 
@@ -298,6 +316,17 @@ def build_parser() -> argparse.ArgumentParser:
     w.add_argument("--no-delta-fetch", action="store_true",
                    help="disable version-gated delta fetches (full params "
                         "on every fetch, reference parity)")
+    w.add_argument("--reconnect-timeout", type=float,
+                   default=_env("DPS_RECONNECT_TIMEOUT", 0.0, float),
+                   help="session resume window in seconds "
+                        "(docs/ROBUSTNESS.md): on exhausted RPC retries "
+                        "the worker re-registers, re-fetches at the "
+                        "restored server step, and reconciles its "
+                        "in-flight gradient instead of dying; 0 disables")
+    w.add_argument("--faults", default=_env("DPS_FAULTS_CLIENT", None),
+                   help="deterministic client-side fault injection spec "
+                        "(comms/faults.py), e.g. "
+                        "'seed=7;push.unavailable@p=0.1'")
     w.add_argument("--profile-dir", default=None,
                    help="capture a jax.profiler (XLA-level) trace of the "
                         "worker loop into this directory (TensorBoard/"
@@ -503,7 +532,7 @@ def _cmd_serve(args) -> int:
     import jax
     import numpy as np
 
-    from .comms.service import serve
+    from .comms.service import ParameterService, serve
     from .models import get_model
     from .ps import make_store
     from .ps.store import StoreConfig
@@ -526,10 +555,65 @@ def _cmd_serve(args) -> int:
                     push_codec=(None if args.push_codec == "default"
                                 else args.push_codec),
                     fetch_codec=args.fetch_codec))
-    server, port = serve(store, port=args.port)
+    svc = ParameterService(store, faults=getattr(args, "faults", None))
+    ckpt_dir = getattr(args, "checkpoint_dir", None)
+    ckpt = None
+    restored = None
+    if getattr(args, "restore", False):
+        if not ckpt_dir:
+            raise SystemExit("--restore needs --checkpoint-dir")
+        from .checkpoint import load_store_record, restore_server_state
+        try:
+            # Adopt the snapshot's aggregation semantics: a restarted
+            # server must resume the RUN it crashed out of, not silently
+            # start a different one because a flag defaulted differently.
+            # Loaded ONCE and passed through to the restore below, so the
+            # adopted config and the restored params/journal come from
+            # the same record even if a newer snapshot lands in between.
+            record = load_store_record(ckpt_dir)
+            _, meta = record
+        except FileNotFoundError:
+            # A restart policy passes --restore unconditionally; the very
+            # first boot has nothing to restore and starts fresh.
+            print(f"restore: no snapshot in {ckpt_dir}; starting fresh",
+                  file=sys.stderr)
+            meta = None
+        if meta is not None:
+            agg = meta.get("aggregation", {})
+            for field in ("mode", "learning_rate", "staleness_bound"):
+                if field in agg \
+                        and getattr(store.config, field) != agg[field]:
+                    print(f"restore: adopting snapshot {field}="
+                          f"{agg[field]!r} (flags said "
+                          f"{getattr(store.config, field)!r})",
+                          file=sys.stderr)
+                    setattr(store.config, field, agg[field])
+            step, journal_n = restore_server_state(store, svc, ckpt_dir,
+                                                   record=record)
+            restored = step
+            print(f"restored store at step {step} "
+                  f"(+{journal_n} journaled push tokens) from {ckpt_dir}",
+                  file=sys.stderr)
+    if ckpt_dir:
+        from .checkpoint import PeriodicStoreCheckpointer
+        from .telemetry import add_shutdown_flush, install_shutdown_hooks
+        ckpt = PeriodicStoreCheckpointer(
+            store, ckpt_dir,
+            interval=getattr(args, "checkpoint_interval", 30.0),
+            journal_fn=svc.journal_snapshot)
+        ckpt.start()
+        # SIGTERM drains the store's end state through the same shutdown
+        # path that dumps the flight recorder — a terminated server
+        # resumes exactly where it was killed (docs/ROBUSTNESS.md).
+        install_shutdown_hooks(role="server")
+        add_shutdown_flush(ckpt.flush_now)
+    server, port = serve(store, port=args.port, service=svc)
     print(f"parameter server up on :{port} "
-          f"(mode={args.mode}, workers={args.workers}, "
-          f"backend={args.store_backend})", file=sys.stderr)
+          f"(mode={store.config.mode}, workers={args.workers}, "
+          f"backend={args.store_backend}"
+          + (f", restored_step={restored}" if restored is not None else "")
+          + (", faults=on" if svc.faults is not None else "")
+          + ")", file=sys.stderr)
     try:
         # server.py:399-403 sleep-forever loop, but exiting cleanly once all
         # registered workers report JobFinished — and, with --worker-timeout,
@@ -543,6 +627,13 @@ def _cmd_serve(args) -> int:
         pass
     finally:
         server.stop(grace=2.0)
+        if ckpt is not None:
+            from .telemetry import remove_shutdown_flush
+            remove_shutdown_flush(ckpt.flush_now)
+            err = ckpt.stop(final_snapshot=True)
+            if err is not None:
+                print(f"warning: last periodic snapshot failed: {err!r}",
+                      file=sys.stderr)
     if args.emit_metrics:
         emit_metrics_json(store.metrics())
     return 0
@@ -560,7 +651,7 @@ def _cmd_worker(args) -> int:
     from .utils.metrics import emit_metrics_json
 
     dataset = _load_dataset(args)
-    store = RemoteStore(args.server)
+    store = RemoteStore(args.server, faults=getattr(args, "faults", None))
     import jax.numpy as jnp
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     # Honor --model/--dataset like cmd_train does — a mismatched architecture
@@ -574,7 +665,8 @@ def _cmd_worker(args) -> int:
                        augment=not args.no_augment, seed=args.seed,
                        heartbeat_interval=args.heartbeat,
                        overlap=args.overlap,
-                       delta_fetch=not args.no_delta_fetch)
+                       delta_fetch=not args.no_delta_fetch,
+                       reconnect_timeout=args.reconnect_timeout)
     worker = PSWorker(store, model, dataset, cfg,
                       worker_name=args.worker_name)
     with _profiler_session(getattr(args, "profile_dir", None)):

@@ -9,12 +9,16 @@ length-prefixed tensor codec instead of pickle, and the TPU-native sync path
 
 from .wire import encode_tensor_dict, decode_tensor_dict
 from .service import ParameterService, serve
-from .client import RemoteStore
+from .client import RemoteStore, SessionLostError
+from .faults import FaultInjector, install_client_faults
 
 __all__ = [
     "encode_tensor_dict",
     "decode_tensor_dict",
+    "FaultInjector",
+    "install_client_faults",
     "ParameterService",
     "serve",
     "RemoteStore",
+    "SessionLostError",
 ]

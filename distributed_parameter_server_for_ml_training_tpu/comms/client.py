@@ -47,6 +47,17 @@ RETRYABLE_CODES = frozenset({
 })
 
 
+class SessionLostError(ConnectionError):
+    """Transient failures outlived the retry budget: the server is most
+    likely down or restarting. This replaces the old terminal behavior
+    (the last ``grpc.RpcError`` escaping and killing the worker): it is a
+    distinct, catchable signal the worker's reconnect state machine
+    (`ps/worker.py:PSWorker._recover_session`) acts on — re-register for a
+    fresh id, re-fetch at the restored server step, reconcile the
+    in-flight gradient (docs/ROBUSTNESS.md). The last wire error rides as
+    ``__cause__``."""
+
+
 class _RemoteConfig:
     """Server-side StoreConfig facts the client learns at registration.
     PSWorker duck-types ``store.config`` for the elastic flag
@@ -57,6 +68,10 @@ class _RemoteConfig:
         self.elastic = False
         self.mode = "sync"
         self.learning_rate = 0.1
+        # Advertised at registration; the reconnect reconciliation uses it
+        # to decide discard-vs-repush for an in-flight gradient without a
+        # wasted round trip (docs/ROBUSTNESS.md).
+        self.staleness_bound = 5
 
 
 class RemoteStore:
@@ -71,21 +86,33 @@ class RemoteStore:
                  register_retries: int = 5,
                  rpc_timeout: float = 60.0,
                  rpc_retries: int = 3,
-                 rpc_backoff: float = 0.5):
+                 rpc_backoff: float = 0.5,
+                 faults=None):
         self.address = address
         self.register_retries = register_retries
         self.rpc_timeout = rpc_timeout
         self.rpc_retries = rpc_retries
         self.rpc_backoff = rpc_backoff
-        self._channel = grpc.insecure_channel(address, options=GRPC_OPTIONS)
-        ident = lambda b: b  # noqa: E731
-        self._call = {
-            name: self._channel.unary_unary(
-                f"/{SERVICE_NAME}/{name}",
-                request_serializer=ident, response_deserializer=ident)
-            for name in ["RegisterWorker", "PushGradrients",
-                         "FetchParameters", "JobFinished"]
-        }
+        # Deterministic client-side fault injection (comms/faults.py):
+        # a spec string (or prebuilt FaultInjector) interposes between the
+        # retry layer and the channel, so injected faults exercise the
+        # real backoff/reconnect machinery. Env DPS_FAULTS_CLIENT applies
+        # fleet-wide without code changes (chaos drills).
+        import os as _os
+        if faults is None:
+            faults = _os.environ.get("DPS_FAULTS_CLIENT") or None
+        if faults is not None and isinstance(faults, str):
+            from .faults import FaultInjector
+            faults = FaultInjector(faults, side="client")
+        self.faults = faults
+        self._channel = None
+        self._build_channel()
+        # The most recent push's (token, payload, fetched_step): after a
+        # session loss the reconnect path re-sends it VERBATIM except for
+        # the worker id (repush_last) — same token means a push the
+        # crashed server already applied and journaled replays as a
+        # duplicate instead of double-applying.
+        self._last_push: tuple[str, bytes, int] | None = None
         #: filled in at registration from the server's config; PSWorker reads
         #: these to apply the fp16 cast client-side before push
         #: (worker.py:264-268) and decompress after fetch.
@@ -183,10 +210,21 @@ class RemoteStore:
                     # the time.
                     sp.attrs["error"] = (code.name if code is not None
                                          else type(e).__name__)
-                    if attempt >= self.rpc_retries \
-                            or code not in RETRYABLE_CODES:
+                    if code not in RETRYABLE_CODES:
                         c_err.inc()
                         raise
+                    if attempt >= self.rpc_retries:
+                        # Transient failures outlived the budget: the
+                        # server is down or restarting. Escalate as the
+                        # catchable session-loss signal (the worker's
+                        # reconnect state machine takes it from here)
+                        # rather than a bare RpcError the caller can only
+                        # die on.
+                        c_err.inc()
+                        raise SessionLostError(
+                            f"{name} failed with {code.name} after "
+                            f"{attempt + 1} attempts against "
+                            f"{self.address}") from e
                     c_retry.inc()
                 else:
                     hist.observe(_tnow() - t0)
@@ -201,6 +239,44 @@ class RemoteStore:
                     return reply
             time.sleep(delay)
             delay *= 2
+
+    def _build_channel(self) -> None:
+        """(Re)build the channel + method stubs + fault wrappers — the ONE
+        place the method list and channel options are wired, shared by
+        construction and ``reset_channel`` so the two can never drift."""
+        self._channel = grpc.insecure_channel(self.address,
+                                              options=GRPC_OPTIONS)
+        ident = lambda b: b  # noqa: E731
+        self._call = {
+            name: self._channel.unary_unary(
+                f"/{SERVICE_NAME}/{name}",
+                request_serializer=ident, response_deserializer=ident)
+            for name in ["RegisterWorker", "PushGradrients",
+                         "FetchParameters", "JobFinished"]
+        }
+        if self.faults is not None:
+            from .faults import install_client_faults
+            install_client_faults(self, self.faults)
+
+    def reset_channel(self) -> None:
+        """Tear down and rebuild the gRPC channel + method stubs.
+
+        A channel that was connected to a server process that DIED can
+        stay wedged in connect-failure backoff even after a replacement
+        is listening on the same port (observed: every attempt fails
+        'Timeout occurred: FD Shutdown' against a live listener, while a
+        fresh channel connects instantly). The worker's reconnect state
+        machine calls this before each re-registration attempt. Client-
+        side fault injection survives the reset (same injector, same
+        schedule state, re-installed over the fresh stubs); ad-hoc test
+        wrappers around the old stubs do not — by the time a reset
+        happens their work (killing a server at call N) is done."""
+        old = self._channel
+        self._build_channel()
+        try:
+            old.close()
+        except Exception:  # noqa: BLE001 — a dead channel may complain
+            pass
 
     def wire_stats(self) -> dict:
         """Cumulative client-side wire accounting (bytes + per-RPC counts
@@ -222,17 +298,27 @@ class RemoteStore:
         reply from an elastic server."""
         return list(self._membership)
 
-    def register_worker(self, worker_name: str = "") -> tuple[int, int]:
-        """Retry x5 with exponential backoff (worker.py:215-229)."""
+    def register_worker(self, worker_name: str = "",
+                        retries: int | None = None) -> tuple[int, int]:
+        """Retry x5 with exponential backoff (worker.py:215-229).
+        ``retries`` overrides the constructor budget — the reconnect state
+        machine passes 1 and paces its own backoff against the overall
+        reconnect window instead."""
         hist, b_out, b_in, c_ok, c_retry, c_err = \
             self._tm_rpc["RegisterWorker"]
         delay = 1.0
         last_err = None
-        for attempt in range(self.register_retries):
+        register_retries = (self.register_retries if retries is None
+                            else max(1, int(retries)))
+        for attempt in range(register_retries):
             t0 = _tnow()
             try:
                 request = pack_msg({"worker_name": worker_name})
-                raw = self._call["RegisterWorker"](request)
+                # Deadline like the hot RPCs: an undeadlined registration
+                # against a half-up server would hang the worker (and the
+                # reconnect state machine) indefinitely.
+                raw = self._call["RegisterWorker"](request,
+                                                   timeout=self.rpc_timeout)
                 hist.observe(_tnow() - t0)
                 b_out.inc(len(request))
                 b_in.inc(len(raw))
@@ -248,21 +334,23 @@ class RemoteStore:
                 self.config.mode = reply.get("mode", "sync")
                 self.config.learning_rate = float(
                     reply.get("learning_rate", 0.1))
+                self.config.staleness_bound = int(
+                    reply.get("staleness_bound", 5))
                 self._note_membership(reply)
                 return int(reply["worker_id"]), int(reply["total_workers"])
             except grpc.RpcError as e:
                 hist.observe(_tnow() - t0)
                 # The LAST failed attempt is an error (the caller sees
                 # ConnectionError), not a retry — dashboards alert on it.
-                if attempt == self.register_retries - 1:
+                if attempt == register_retries - 1:
                     c_err.inc()
                 else:
                     c_retry.inc()
+                    time.sleep(delay)
+                    delay *= 2
                 last_err = e
-                time.sleep(delay)
-                delay *= 2
         raise ConnectionError(
-            f"registration failed after {self.register_retries} attempts: "
+            f"registration failed after {register_retries} attempts: "
             f"{last_err}")
 
     def fetch(self, worker_id: int | None = None,
@@ -319,12 +407,34 @@ class RemoteStore:
         # (docs/WIRE_PROTOCOL.md); the frame field remains the wire
         # contract for peers that only speak frames.
         wt = current_wire_trace() if self.supports_trace_context else None
+        token = f"{self._push_nonce}:{self._push_count}"
         meta = {"worker_id": worker_id, "fetched_step": fetched_step,
-                "push_token": f"{self._push_nonce}:{self._push_count}"}
+                "push_token": token}
         if wt is not None:
             meta["trace"] = wt
-        reply = self._invoke("PushGradrients", pack_msg(
-            meta, encode_tensor_dict(gradients, trace=wt)))
+        payload = encode_tensor_dict(gradients, trace=wt)
+        # Recorded BEFORE the send: a push that dies mid-RPC is exactly
+        # the one the reconnect path must be able to re-send verbatim.
+        self._last_push = (token, payload, int(fetched_step))
+        reply = self._invoke("PushGradrients", pack_msg(meta, payload))
+        rmeta, _ = unpack_msg(reply)
+        return bool(rmeta["accepted"])
+
+    def repush_last(self, worker_id: int) -> bool | None:
+        """Re-send the most recent push — same token, same payload, same
+        ``fetched_step`` — under (possibly) a new worker id. The session-
+        resume reconciliation path: the server's dedupe table is keyed by
+        the token's NONCE, not the worker id, so if the pre-crash server
+        applied this push and journaled it, the replay answers
+        ``duplicate`` from the journal instead of applying twice; if the
+        apply was lost with the crash, it applies now. Returns the
+        accepted outcome, or None when there is nothing to re-send."""
+        if self._last_push is None:
+            return None
+        token, payload, fetched_step = self._last_push
+        meta = {"worker_id": worker_id, "fetched_step": fetched_step,
+                "push_token": token}
+        reply = self._invoke("PushGradrients", pack_msg(meta, payload))
         rmeta, _ = unpack_msg(reply)
         return bool(rmeta["accepted"])
 

@@ -1,0 +1,312 @@
+"""Deterministic, seeded fault injection for the comms layer.
+
+The crash-recovery subsystem (docs/ROBUSTNESS.md) is only credible if its
+claims hold under *injected* faults, reproducibly — "we survived one lucky
+run" is not fault tolerance. This module is the single injection point both
+sides of the wire share:
+
+- **client side** (`install_client_faults`): wraps ``RemoteStore``'s raw
+  gRPC callables, so an injected UNAVAILABLE/DEADLINE_EXCEEDED exercises
+  the real retry + reconnect machinery (`comms/client.py`), and an
+  injected ``drop_reply`` performs the REAL call and then discards the
+  reply — the server applied the gradient, the client never heard — which
+  is exactly the lost-reply case the push-token exactly-once dedupe exists
+  for (`comms/service.py`);
+- **server side** (``ParameterService(faults=...)``): wraps the RPC
+  handler bodies — delays model a slow server, aborts model an
+  overloaded one, ``drop_reply`` aborts AFTER the handler (and therefore
+  the store apply) completed, and ``kill`` hard-exits the process
+  mid-handler to produce a deterministic crash point for restart drills
+  (`experiments/run_chaos_soak.py`).
+
+Determinism: every rule owns a counter and, for probabilistic rules, a
+``random.Random`` seeded from ``(spec seed, rule index)``. A decision is a
+pure function of the spec and the per-op call index, so the same seed and
+the same call sequence replay the same fault schedule
+(tests/test_recovery.py pins this).
+
+Spec grammar (CLI ``--faults`` / env ``DPS_FAULTS_CLIENT`` /
+``DPS_FAULTS_SERVER``)::
+
+    spec  := [ 'seed=' int ';' ] rule ( ';' rule )*
+    rule  := op '.' kind [ '=' float ] '@' when
+    op    := 'push' | 'fetch' | 'register' | 'finish' | 'any'
+    kind  := 'unavailable' | 'deadline' | 'delay' | 'drop_reply' | 'kill'
+    when  := 'p=' float          # per-call probability (seeded RNG)
+           | 'n=' int(,int)*     # specific 1-based call indices for op
+           | 'every=' int        # every k-th call
+
+Examples::
+
+    seed=7;push.unavailable@p=0.2        # 20% of pushes fail UNAVAILABLE
+    fetch.delay=0.05@every=3             # every 3rd fetch sleeps 50 ms
+    push.drop_reply@n=2,5                # pushes 2 and 5 apply, reply lost
+    any.kill@n=40                        # the 40th RPC kills the server
+
+The first matching rule per call wins. ``delay`` composes with nothing —
+it IS the action (the call proceeds after the sleep).
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass
+
+import grpc
+
+__all__ = [
+    "FAULT_KINDS",
+    "FAULT_OPS",
+    "FaultInjector",
+    "FaultRule",
+    "InjectedRpcError",
+    "install_client_faults",
+    "parse_fault_spec",
+]
+
+#: op name (spec vocabulary) -> RPC method name (None = matches all four).
+FAULT_OPS = {
+    "push": "PushGradrients",  # quirk 1 typo is the wire contract
+    "fetch": "FetchParameters",
+    "register": "RegisterWorker",
+    "finish": "JobFinished",
+    "any": None,
+}
+
+FAULT_KINDS = ("unavailable", "deadline", "delay", "drop_reply", "kill")
+
+_STATUS = {
+    "unavailable": grpc.StatusCode.UNAVAILABLE,
+    "deadline": grpc.StatusCode.DEADLINE_EXCEEDED,
+    "drop_reply": grpc.StatusCode.UNAVAILABLE,  # a lost reply looks transient
+}
+
+
+class InjectedRpcError(grpc.RpcError):
+    """Client-side injected failure, shaped like a live-channel error (the
+    retry layer only reads ``.code()``)."""
+
+    def __init__(self, code: grpc.StatusCode, detail: str):
+        super().__init__()
+        self._code = code
+        self._detail = detail
+
+    def code(self) -> grpc.StatusCode:
+        return self._code
+
+    def details(self) -> str:
+        return self._detail
+
+    def __str__(self) -> str:
+        return f"injected {self._code.name}: {self._detail}"
+
+
+@dataclass(frozen=True)
+class FaultRule:
+    op: str                        # key of FAULT_OPS
+    kind: str                      # one of FAULT_KINDS
+    value: float = 0.0             # delay seconds (kind='delay')
+    prob: float | None = None      # when := p=
+    at: frozenset | None = None    # when := n= (1-based per-op call index)
+    every: int | None = None       # when := every=
+
+    def matches_rpc(self, rpc_name: str) -> bool:
+        target = FAULT_OPS[self.op]
+        return target is None or target == rpc_name
+
+
+def parse_fault_spec(spec: str) -> tuple[int, list[FaultRule]]:
+    """Parse a spec string -> (seed, rules). Raises ValueError with the
+    offending fragment on any malformed rule — a typo'd chaos schedule must
+    fail the run at startup, not silently inject nothing."""
+    seed = 0
+    rules: list[FaultRule] = []
+    for raw in spec.split(";"):
+        part = raw.strip()
+        if not part:
+            continue
+        if part.startswith("seed="):
+            seed = int(part[5:])
+            continue
+        try:
+            head, when = part.split("@", 1)
+            op, _, kind_val = head.partition(".")
+            kind, _, val = kind_val.partition("=")
+            if op not in FAULT_OPS:
+                raise ValueError(f"unknown op {op!r}")
+            if kind not in FAULT_KINDS:
+                raise ValueError(f"unknown kind {kind!r}")
+            value = float(val) if val else 0.0
+            prob = at = every = None
+            if when.startswith("p="):
+                prob = float(when[2:])
+                if not 0.0 <= prob <= 1.0:
+                    raise ValueError(f"p={prob} outside [0, 1]")
+            elif when.startswith("n="):
+                at = frozenset(int(x) for x in when[2:].split(","))
+                if not at or min(at) < 1:
+                    raise ValueError("n= wants 1-based call indices")
+            elif when.startswith("every="):
+                every = int(when[6:])
+                if every < 1:
+                    raise ValueError("every= wants a positive int")
+            else:
+                raise ValueError(f"unknown trigger {when!r}")
+            rules.append(FaultRule(op=op, kind=kind, value=value,
+                                   prob=prob, at=at, every=every))
+        except ValueError as e:
+            raise ValueError(f"bad fault rule {part!r}: {e}") from None
+    if not rules:
+        raise ValueError(f"fault spec {spec!r} contains no rules")
+    return seed, rules
+
+
+class FaultInjector:
+    """Decides, per RPC call, which fault (if any) to inject.
+
+    One injector instance per process side; thread-safe (RPCs arrive on
+    gRPC's thread pool / the worker's comms thread). Decisions consume
+    per-rule state (call counters, seeded RNG draws), so two injectors
+    built from the same spec replay identical schedules for identical
+    call sequences.
+    """
+
+    def __init__(self, spec: str, side: str = "client",
+                 _telemetry: bool = True):
+        self.spec = spec
+        self.side = side
+        self.seed, self.rules = parse_fault_spec(spec)
+        self._lock = threading.Lock()
+        # Per-op call counters (1-based at decision time) + one RNG per
+        # rule: a probabilistic rule's draw sequence must not shift when an
+        # unrelated rule is added or another op is called.
+        self._op_calls: dict[str, int] = {}
+        self._rngs = [random.Random((self.seed << 8) ^ (i * 2654435761))
+                      for i in range(len(self.rules))]
+        # _telemetry=False (schedule_preview's probe) keeps phantom
+        # counters out of the process registry: a preview replays the
+        # schedule without claiming injections happened on the wire.
+        if _telemetry:
+            from ..telemetry import get_registry
+            reg = get_registry()
+            self._tm = {
+                (op, kind): reg.counter("dps_fault_injections_total",
+                                        side=side, op=op, kind=kind)
+                for op in FAULT_OPS for kind in FAULT_KINDS
+            }
+        else:
+            class _Noop:
+                def inc(self, n=1):
+                    pass
+            noop = _Noop()
+            self._tm = {(op, kind): noop
+                        for op in FAULT_OPS for kind in FAULT_KINDS}
+
+    def decide(self, rpc_name: str) -> FaultRule | None:
+        """One decision per RPC call: the first rule that matches and
+        triggers wins; None = no fault this call."""
+        with self._lock:
+            n = self._op_calls.get(rpc_name, 0) + 1
+            self._op_calls[rpc_name] = n
+            for i, rule in enumerate(self.rules):
+                if not rule.matches_rpc(rpc_name):
+                    continue
+                if rule.at is not None:
+                    hit = n in rule.at
+                elif rule.every is not None:
+                    hit = n % rule.every == 0
+                else:
+                    # The draw happens on every matching call (hit or not)
+                    # so the sequence is reproducible regardless of which
+                    # draws land.
+                    hit = self._rngs[i].random() < (rule.prob or 0.0)
+                if hit:
+                    self._tm[(rule.op, rule.kind)].inc()
+                    return rule
+        return None
+
+    def schedule_preview(self, rpc_name: str, calls: int) -> list:
+        """The schedule a FRESH injector with this spec would produce for
+        ``calls`` consecutive ``rpc_name`` calls — determinism evidence for
+        tests and for the chaos artifact's provenance record."""
+        probe = FaultInjector(self.spec, side=f"{self.side}-preview",
+                              _telemetry=False)
+        out = []
+        for _ in range(calls):
+            rule = probe.decide(rpc_name)
+            out.append(None if rule is None else (rule.kind, rule.value))
+        return out
+
+    # -- server side ---------------------------------------------------------
+
+    def wrap_handler(self, rpc_name: str, fn):
+        """Wrap one service RPC body. ``delay`` sleeps then runs;
+        ``unavailable``/``deadline`` abort BEFORE the store is touched;
+        ``drop_reply`` runs the body (the apply happens) then aborts — the
+        reply is lost after the side effect, the exactly-once crucible;
+        ``kill`` hard-exits mid-handler (the chaos soak's crash point)."""
+
+        def wrapped(request: bytes, ctx) -> bytes:
+            rule = self.decide(rpc_name)
+            if rule is None:
+                return fn(request, ctx)
+            if rule.kind == "delay":
+                time.sleep(rule.value)
+                return fn(request, ctx)
+            if rule.kind == "kill":
+                print(f"fault injection: killing server mid-{rpc_name}",
+                      flush=True)
+                os._exit(137)  # SIGKILL-alike: no flush, no atexit
+            if rule.kind == "drop_reply":
+                fn(request, ctx)  # the apply HAPPENS; the reply does not
+                self._abort(ctx, "drop_reply", rpc_name)
+            self._abort(ctx, rule.kind, rpc_name)
+
+        return wrapped
+
+    def _abort(self, ctx, kind: str, rpc_name: str):
+        code = _STATUS[kind]
+        if ctx is not None:
+            ctx.abort(code, f"injected {kind} ({rpc_name})")
+        raise InjectedRpcError(code, f"server-side {kind} ({rpc_name})")
+
+
+class _FaultyCall:
+    """Client-side wrapper over one raw gRPC callable."""
+
+    def __init__(self, inner, injector: FaultInjector, rpc_name: str):
+        self._inner = inner
+        self._injector = injector
+        self._rpc_name = rpc_name
+
+    def __call__(self, request, timeout=None):
+        rule = self._injector.decide(self._rpc_name)
+        if rule is None:
+            return self._inner(request, timeout=timeout)
+        if rule.kind == "delay":
+            time.sleep(rule.value)
+            return self._inner(request, timeout=timeout)
+        if rule.kind == "kill":
+            print(f"fault injection: killing client mid-{self._rpc_name}",
+                  flush=True)
+            os._exit(137)
+        if rule.kind == "drop_reply":
+            self._inner(request, timeout=timeout)  # server saw it...
+            raise InjectedRpcError(_STATUS["drop_reply"],
+                                   f"reply dropped ({self._rpc_name})")
+        raise InjectedRpcError(_STATUS[rule.kind],
+                               f"client-side {rule.kind} "
+                               f"({self._rpc_name})")
+
+
+def install_client_faults(remote_store, injector: FaultInjector) -> None:
+    """Interpose the injector between RemoteStore and its channel. The
+    wrappers sit UNDER the retry layer, so injected transients exercise
+    the same backoff/reconnect paths a real flaky network would."""
+    remote_store._call = {
+        name: _FaultyCall(call, injector, name)
+        for name, call in remote_store._call.items()
+    }

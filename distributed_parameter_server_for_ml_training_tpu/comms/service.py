@@ -24,6 +24,7 @@ from __future__ import annotations
 import json
 import struct
 import threading
+from collections import OrderedDict
 from concurrent import futures
 
 import grpc
@@ -33,6 +34,34 @@ from .wire import decode_tensor_dict, encode_tensor_dict
 
 SERVICE_NAME = "ps.ParameterServer"
 
+#: Completed push-token outcomes kept for dedupe (and persisted in store
+#: snapshots, checkpoint/manager.py). One entry per client nonce; 4x the
+#: 32-worker cap leaves room for reconnecting clients' fresh nonces without
+#: evicting live ones.
+PUSH_SEEN_CAP = 128
+
+#: Ceiling on how long a duplicate push waits for its original's outcome
+#: when the caller carries no deadline. With a deadline, the wait is
+#: bounded by ``ctx.time_remaining()`` minus a reply margin instead —
+#: a flat 120 s outlived the client's 60 s rpc_timeout and pinned server
+#: threads (round-5 ADVICE).
+DUP_WAIT_CAP_S = 30.0
+
+
+def parse_push_token(token) -> tuple[str, int]:
+    """Split a ``nonce:count`` push token. The count orders a client's
+    pushes, so the dedupe table can refuse ZOMBIE tokens — a
+    deadline-expired first attempt executing after its retry succeeded and
+    newer pushes landed (round-5 ADVICE: any ``count <=`` last-seen is a
+    duplicate, and a lower count never evicts a higher one). A token
+    without a parsable counter degrades to exact-match semantics: the
+    whole token becomes the nonce, count -1."""
+    s = str(token)
+    nonce, sep, cnt = s.rpartition(":")
+    if sep and cnt.isdigit():
+        return nonce, int(cnt)
+    return s, -1
+
 # server.py:372-378 / worker.py:203-209
 GRPC_OPTIONS = [
     ("grpc.max_send_message_length", 500 * 1024 * 1024),
@@ -40,6 +69,14 @@ GRPC_OPTIONS = [
     ("grpc.keepalive_time_ms", 30_000),
     ("grpc.keepalive_timeout_ms", 5_000),
     ("grpc.keepalive_permit_without_calls", 1),
+    # Client-channel reconnect pacing (ignored by servers). gRPC's default
+    # reconnect backoff grows to ~2 minutes; a worker waiting out a server
+    # RESTART (docs/ROBUSTNESS.md) would then sit in channel backoff long
+    # after the replacement is up, and the reconnect window would expire
+    # on a healthy server. Capping at 2 s keeps session resume prompt
+    # while still backing off a truly dead peer.
+    ("grpc.initial_reconnect_backoff_ms", 250),
+    ("grpc.max_reconnect_backoff_ms", 2_000),
 ]
 
 
@@ -62,23 +99,32 @@ def unpack_msg(data: bytes) -> tuple[dict, memoryview]:
 class ParameterService:
     """Generic-handler implementation of the 4-RPC lifecycle."""
 
-    def __init__(self, store: ParameterStore):
+    def __init__(self, store: ParameterStore, faults=None):
         self.store = store
         # Push dedupe: the client retries hot RPCs at-least-once
         # (client.py:_invoke); without this, a push whose reply was lost
         # AFTER it completed a sync round would be re-stashed into the
         # NEXT round as a stale duplicate (round-4 ADVICE). The client
-        # stamps every push with a unique token (identical bytes across
-        # retries); a token matching the worker's most recent push is a
-        # retry of work already applied (or still applying: a
-        # DEADLINE_EXCEEDED retry can overtake its original — the retry
-        # then WAITS on the entry's event so the reply reports the
-        # original's true outcome, not a guess). Most-recent-only
-        # suffices: pushes are synchronous per worker, so a retry always
-        # precedes that worker's next distinct push.
-        # wid -> [token, outcome (None while in flight), done event]
-        self._push_seen: dict[int, list] = {}
+        # stamps every push with a ``nonce:count`` token (identical bytes
+        # across retries); the table is keyed by NONCE and ordered by
+        # COUNT, so (a) a zombie attempt whose count is below the last
+        # seen is refused instead of re-applied (round-5 ADVICE — the old
+        # most-recent-token-per-worker scheme let it evict the newer
+        # record AND re-apply the old gradient), and (b) a client that
+        # reconnects under a fresh worker id after a server restart keeps
+        # deduping, because its nonce — not its id — is the key. A retry
+        # of a still-in-flight original WAITS on the entry's event so the
+        # reply reports the original's true outcome, not a guess.
+        # nonce -> [count, outcome (None while in flight), done event,
+        #           worker_id, step_at_completion]; LRU-bounded.
+        self._push_seen: OrderedDict[str, list] = OrderedDict()
         self._push_seen_lock = threading.Lock()
+        # Deterministic fault injection (comms/faults.py): wraps the RPC
+        # handler bodies in handlers(); None = no faults.
+        from .faults import FaultInjector
+        if isinstance(faults, str):
+            faults = FaultInjector(faults, side="server")
+        self.faults = faults
         # Handler-side telemetry: per-RPC span + request/reply byte
         # counters (telemetry/). Client-side spans (comms/client.py)
         # include the wire + queueing; the delta between the two
@@ -122,6 +168,11 @@ class ParameterService:
             "fetch_codec": getattr(self.store, "fetch_codec", "none"),
             "mode": self.store.config.mode,
             "learning_rate": self.store.config.learning_rate,
+            # The async staleness bound, so a reconnecting client can make
+            # the worker-side discard-or-repush call for its in-flight
+            # gradient without a wasted round trip (docs/ROBUSTNESS.md).
+            "staleness_bound": int(getattr(self.store.config,
+                                           "staleness_bound", 5)),
             "elastic": bool(getattr(self.store.config, "elastic", False)),
             # Delta-fetch capability (docs/WIRE_PROTOCOL.md): clients may
             # send ``have_step`` on FetchParameters and must then handle a
@@ -144,20 +195,53 @@ class ParameterService:
         meta, payload = unpack_msg(request)
         wid = int(meta["worker_id"])
         token = meta.get("push_token")
+        entry = None
         if token is not None:
+            nonce, count = parse_push_token(token)
             with self._push_seen_lock:
-                prev = self._push_seen.get(wid)
-                if prev is not None and prev[0] == token:
-                    dup = prev
+                prev = self._push_seen.get(nonce)
+                if prev is not None and count <= prev[0]:
+                    dup, stale = prev, count < prev[0]
                 else:
-                    dup = None
-                    self._push_seen[wid] = [token, None, threading.Event()]
+                    # New push (or the first with a HIGHER count): record
+                    # it. A lower count never replaces a higher one — the
+                    # branch above already routed it away.
+                    dup, stale = None, False
+                    entry = [count, None, threading.Event(), wid, None]
+                    self._push_seen[nonce] = entry
+                    self._push_seen.move_to_end(nonce)
+                    while len(self._push_seen) > PUSH_SEEN_CAP:
+                        self._push_seen.popitem(last=False)
             if dup is not None:
-                # Retry of a push already seen. If the original is still
-                # in flight, wait for its outcome — answering early with
-                # a fabricated accepted=True would misreport an async
-                # push the staleness gate later rejects.
-                finished = dup[2].wait(timeout=120.0)
+                if stale:
+                    # ZOMBIE: a deadline-expired attempt executing after
+                    # newer pushes from the same client already landed.
+                    # Its gradient was either applied by the retry that
+                    # overtook it or superseded — re-applying it here was
+                    # the round-5 double-apply bug. Nobody is usually
+                    # listening for this reply; answer terminally.
+                    return pack_msg({
+                        "received": True, "accepted": False,
+                        "duplicate": True, "stale_token": True,
+                        "global_step": self.store.global_step})
+                # Retry of the push most recently seen from this client.
+                # If the original is still in flight, wait for its
+                # outcome — answering early with a fabricated
+                # accepted=True would misreport an async push the
+                # staleness gate later rejects. The wait is bounded by
+                # the CALLER's remaining deadline (minus a margin to get
+                # the reply out), falling back to a cap well under the
+                # client's 60 s rpc_timeout — a flat 120 s outlived every
+                # caller and pinned one of the 20 pool threads per
+                # stacked retry (round-5 ADVICE).
+                budget = DUP_WAIT_CAP_S
+                remaining = None
+                if ctx is not None and callable(
+                        getattr(ctx, "time_remaining", None)):
+                    remaining = ctx.time_remaining()
+                if remaining is not None:
+                    budget = max(0.0, min(budget, remaining - 1.0))
+                finished = dup[2].wait(timeout=budget)
                 if not finished and dup[1] is None:
                     # Original STILL running after the wait: don't invent
                     # an outcome in either direction — fail retryably so
@@ -176,15 +260,60 @@ class ParameterService:
             accepted = self.store.push(wid, grads, int(meta["fetched_step"]))
         finally:
             # On an exception the event still fires (outcome False) so a
-            # waiting retry is never stranded until its timeout.
-            if token is not None:
-                with self._push_seen_lock:
-                    entry = self._push_seen.get(wid)
-                    if entry is not None and entry[0] == token:
-                        entry[1] = accepted
-                        entry[2].set()
+            # waiting retry is never stranded until its timeout. The
+            # captured entry object is updated directly — an entry the
+            # LRU bound evicted mid-flight still wakes its waiters.
+            if entry is not None:
+                entry[1] = accepted
+                entry[4] = self.store.global_step
+                entry[2].set()
         return pack_msg({"received": True, "accepted": accepted,
                          "global_step": self.store.global_step})
+
+    # -- durable push-token journal (docs/ROBUSTNESS.md) ---------------------
+
+    def journal_snapshot(self) -> list[dict]:
+        """COMPLETED push-token outcomes, oldest first — the bounded
+        journal a store snapshot persists (checkpoint/manager.py) so a
+        restarted server still dedupes in-flight push retries from before
+        the crash. In-flight entries are skipped: their outcome is
+        unknown, and claiming one either way would be a lie the retry
+        acts on."""
+        with self._push_seen_lock:
+            return [
+                {"nonce": nonce, "count": e[0], "accepted": bool(e[1]),
+                 "worker_id": e[3], "step": e[4]}
+                for nonce, e in self._push_seen.items() if e[2].is_set()
+            ]
+
+    def load_journal(self, entries) -> int:
+        """Seed the dedupe table from a persisted journal (server
+        restart). Returns the number of entries loaded. Entries arrive
+        completed (their events are pre-set); malformed records are
+        skipped — a corrupt journal must degrade to weaker dedupe, not a
+        refused restore."""
+        loaded = 0
+        with self._push_seen_lock:
+            for rec in entries or []:
+                try:
+                    nonce = str(rec["nonce"])
+                    count = int(rec["count"])
+                    accepted = bool(rec["accepted"])
+                    wid = int(rec.get("worker_id", -1))
+                    step = rec.get("step")
+                except (KeyError, TypeError, ValueError):
+                    continue
+                prev = self._push_seen.get(nonce)
+                if prev is not None and count <= prev[0]:
+                    continue  # never downgrade to a lower count
+                ev = threading.Event()
+                ev.set()
+                self._push_seen[nonce] = [count, accepted, ev, wid, step]
+                self._push_seen.move_to_end(nonce)
+                loaded += 1
+            while len(self._push_seen) > PUSH_SEEN_CAP:
+                self._push_seen.popitem(last=False)
+        return loaded
 
     def fetch_parameters(self, request: bytes, ctx) -> bytes:
         meta, _ = unpack_msg(request)
@@ -260,24 +389,39 @@ class ParameterService:
             "FetchParameters": self.fetch_parameters,
             "JobFinished": self.job_finished,
         }
+        def wire(name, fn):
+            # Fault injection sits INSIDE the instrumentation wrapper, so
+            # injected delays/aborts land in the handler latency histogram
+            # and call counters like real ones would — chaos telemetry
+            # must look like production telemetry.
+            body = fn
+            if self.faults is not None:
+                body = self.faults.wrap_handler(name, body)
+            return self._instrumented(name, body)
+
         return grpc.method_handlers_generic_handler(SERVICE_NAME, {
             name: grpc.unary_unary_rpc_method_handler(
-                self._instrumented(name, fn),
+                wire(name, fn),
                 request_deserializer=ident, response_serializer=ident)
             for name, fn in method_map.items()
         })
 
 
 def serve(store: ParameterStore, port: int = 8000,
-          max_rpc_workers: int = 20) -> tuple[grpc.Server, int]:
+          max_rpc_workers: int = 20,
+          service: ParameterService | None = None
+          ) -> tuple[grpc.Server, int]:
     """Start the service (server.py:370-393). Returns (server, bound_port) —
     pass port=0 to pick a free port. Callers own shutdown. ThreadPool of 20
     reproduces the reference's cap — including its quirk 9 (20 < the
-    32-worker max)."""
+    32-worker max). ``service`` lets callers that need a handle on the
+    service object (push-token journal persistence, fault injection —
+    cli serve) construct it themselves."""
     server = grpc.server(
         futures.ThreadPoolExecutor(max_workers=max_rpc_workers),
         options=GRPC_OPTIONS)
-    server.add_generic_rpc_handlers((ParameterService(store).handlers(),))
+    svc = service if service is not None else ParameterService(store)
+    server.add_generic_rpc_handlers((svc.handlers(),))
     bound = server.add_insecure_port(f"[::]:{port}")
     server.start()
     return server, bound

@@ -413,6 +413,33 @@ def _1f1b_body(stage_params, x_mb, y_mb, *, stage_fn, loss_fn, tables,
     return loss, grads
 
 
+def _check_homogeneous_stage(stage_fn: Callable, stacked_params, x,
+                             num_microbatches: int) -> None:
+    """Both schedules route every stage's output into the next stage's
+    input slot (and, in 1F1B, into shared x/g ring buffers sized from the
+    input), so ``stage_fn`` MUST map a microbatch to the same shape and
+    dtype. A heterogeneous stage used to surface only at trace time as an
+    opaque ``lax.cond`` branch-shape mismatch (round-5 ADVICE); this
+    shape-level check (``jax.eval_shape`` — no FLOPs, no tracing of the
+    schedule) names the actual contract instead."""
+    mb = x.shape[0] // num_microbatches
+    x_sds = jax.ShapeDtypeStruct((mb,) + tuple(x.shape[1:]), x.dtype)
+    one_stage = jax.tree_util.tree_map(
+        lambda p: jax.ShapeDtypeStruct(tuple(p.shape[1:]), p.dtype),
+        stacked_params)
+    out = jax.eval_shape(stage_fn, one_stage, x_sds)
+    if not hasattr(out, "shape") or tuple(out.shape) != tuple(x_sds.shape) \
+            or out.dtype != x_sds.dtype:
+        got = (f"{getattr(out, 'dtype', '?')}{list(getattr(out, 'shape', []))}"
+               if hasattr(out, "shape") else type(out).__name__)
+        raise ValueError(
+            f"pipeline stages must be homogeneous: stage_fn must map a "
+            f"microbatch of {x_sds.dtype}{list(x_sds.shape)} to the same "
+            f"shape/dtype (its output feeds the next stage's input and "
+            f"the fixed-shape ring buffers), but it returned {got}. "
+            f"Fold any shape change (embedding, head) inside a stage.")
+
+
 def make_pipeline_train_step(mesh: Mesh, stage_fn: Callable,
                              loss_fn: Callable, num_microbatches: int,
                              schedule: str = "gpipe",
@@ -429,8 +456,27 @@ def make_pipeline_train_step(mesh: Mesh, stage_fn: Callable,
     - ``schedule='gpipe'``: the forward pipeline above + jax autodiff.
     - ``schedule='1f1b'``: the fused manual schedule (same tick count,
       O(S) instead of O(M) stashed activations — see module comment).
+
+    ``stage_fn`` must be shape/dtype-preserving per microbatch (validated
+    up front on the first call per input signature — a heterogeneous
+    stage raises a clear error instead of an opaque ``lax.cond`` trace
+    failure).
     """
     axis_size = mesh.shape[axis]
+
+    def _validated(step_fn: Callable, seen: set = None) -> Callable:
+        seen = set() if seen is None else seen
+
+        def step(stacked_params, x, y):
+            key = (tuple(x.shape), str(x.dtype))
+            if key not in seen:
+                _check_homogeneous_stage(stage_fn, stacked_params, x,
+                                         num_microbatches)
+                seen.add(key)
+            return step_fn(stacked_params, x, y)
+
+        return step
+
     if schedule == "gpipe":
         apply = make_pipeline_apply(mesh, stage_fn, num_microbatches,
                                     axis=axis, shard_io=False, remat=remat)
@@ -443,7 +489,7 @@ def make_pipeline_train_step(mesh: Mesh, stage_fn: Callable,
             losses = jax.vmap(loss_fn)(y_pred_mb, y_mb)
             return jnp.mean(losses)
 
-        return jax.jit(jax.value_and_grad(total_loss))
+        return _validated(jax.jit(jax.value_and_grad(total_loss)))
 
     if schedule != "1f1b":
         raise ValueError(f"schedule must be gpipe|1f1b, got {schedule!r}")
@@ -470,4 +516,4 @@ def make_pipeline_train_step(mesh: Mesh, stage_fn: Callable,
         y_mb = y.reshape(num_microbatches, mb, *y.shape[1:])
         return sharded(stacked_params, x_mb, y_mb)
 
-    return step
+    return _validated(step)

@@ -68,6 +68,15 @@ class WorkerConfig:
     # the worker keeps the params it already holds — byte-identical to a
     # full refetch at the same step, minus the wire bytes.
     delta_fetch: bool = True
+    # Session resume (docs/ROBUSTNESS.md): when a remote store loses its
+    # session (transient RPC failures outlive the retry budget —
+    # SessionLostError), the worker re-registers, re-fetches at the
+    # restored server step, and reconciles the in-flight gradient instead
+    # of dying. This bounds the whole reconnect window in seconds;
+    # 0 (default) disables resume and keeps the terminal-failure behavior.
+    reconnect_timeout: float = 0.0
+    # First reconnect retry delay; doubles per attempt (capped at 10 s).
+    reconnect_backoff: float = 0.5
 
     def __post_init__(self):
         if self.k_step_mode not in ("faithful", "accumulate"):
@@ -86,6 +95,9 @@ class WorkerResult:
     pushes_accepted: int = 0
     pushes_rejected: int = 0
     heartbeats: int = 0
+    # Session resumes survived (server restarts / network partitions the
+    # reconnect state machine rode through; docs/ROBUSTNESS.md).
+    reconnects: int = 0
     # Client-side wire accounting (RemoteStore.wire_stats); empty for
     # in-process stores, which cross no wire.
     wire: dict = field(default_factory=dict)
@@ -118,6 +130,7 @@ class WorkerResult:
             "batch_size": config.batch_size,
             "learning_rate": learning_rate,
             "num_epochs": config.num_epochs,
+            "reconnects": self.reconnects,
         }
 
 
@@ -169,6 +182,11 @@ class _CommsPipeline:
         self._worker_id = worker_id
         self._item = None
         self._error: Exception | None = None
+        # The (grads, fetched_step) of a PUSH that died on the comms
+        # thread — what the session-resume reconciliation must decide
+        # about. A failed PREFETCH leaves this None: its push already
+        # landed and must not be re-sent.
+        self._failed_push = None
         self._go = threading.Event()
         self._done = threading.Event()
         self._done.set()
@@ -211,8 +229,12 @@ class _CommsPipeline:
                                    worker=self._worker_id,
                                    prefetch=prefetch_current is not None):
                     if grads is not None:
-                        self._worker._push(self._worker_id, grads,
-                                           fetched_step)
+                        try:
+                            self._worker._push(self._worker_id, grads,
+                                               fetched_step)
+                        except Exception:
+                            self._failed_push = (grads, fetched_step)
+                            raise
                     if prefetch_current is not None:
                         result = self._worker._fetch_params(
                             self._worker_id, have_step=fetched_step,
@@ -274,6 +296,13 @@ class _CommsPipeline:
         self._done.wait()
         self._raise_if_failed()
 
+    def take_failed_item(self):
+        """The (grads, fetched_step) of the push that killed this
+        pipeline, if any — consumed once by the session-resume
+        reconciliation (ps/worker.py:_recover_session)."""
+        item, self._failed_push = self._failed_push, None
+        return item
+
     def close(self) -> None:
         # Bounded wait: a comms thread stuck deep in RPC retries must not
         # wedge worker teardown — it is a daemon thread and will observe
@@ -302,6 +331,10 @@ class PSWorker(threading.Thread):
         # Step of the last successful fetch; the heartbeat thread reads it
         # to delta-gate its pings (int read/write is atomic enough).
         self._last_fetched_step: int | None = None
+        # Overlapped comms pipeline (set in _run when overlap=True); an
+        # attribute so the session-resume path can drain and rebuild it.
+        self._pipe: _CommsPipeline | None = None
+        self._tm_reconnect = None  # created at _init_telemetry
         # Shared compiled functions may be passed in to avoid re-tracing per
         # worker; otherwise built here.
         self._grad_step = grad_step or make_grad_step(
@@ -319,20 +352,32 @@ class PSWorker(threading.Thread):
         finally:
             self._done.set()
             if self.result.worker_id >= 0:
-                self.store.job_finished(self.result.worker_id)
+                try:
+                    self.store.job_finished(self.result.worker_id)
+                except Exception as e:  # noqa: BLE001
+                    # A dead server at goodbye time must not erase an
+                    # otherwise-complete run (the result already holds
+                    # the training outcome); the server's liveness reaper
+                    # expires the slot instead.
+                    print(f"JobFinished failed for worker "
+                          f"{self.result.worker_id}: {e!r}", flush=True)
             # After JobFinished so the final RPC is counted too.
             ws = getattr(self.store, "wire_stats", None)
             if callable(ws):
                 self.result.wire = ws()
 
-    def _heartbeat_loop(self, worker_id: int, interval: float) -> None:
+    def _heartbeat_loop(self, interval: float) -> None:
         """Liveness ping: periodic fetch (the reference's intended
         health_check_loop, worker.py:112-119, implemented for real).
         Delta-gated when possible: the ping's payload is discarded anyway,
         so against a store that supports it a ping costs a header whenever
-        the step hasn't advanced past the training thread's last fetch."""
+        the step hasn't advanced past the training thread's last fetch.
+        The worker id is re-read every tick, so after a session resume the
+        same thread keeps the NEW registration alive — heartbeats
+        re-establish themselves with no thread churn."""
         while not self._done.wait(interval):
             try:
+                worker_id = self.result.worker_id
                 have = self._last_fetched_step
                 if (have is not None and self.config.delta_fetch
                         and getattr(self.store, "supports_delta_fetch",
@@ -397,6 +442,13 @@ class PSWorker(threading.Thread):
         # its params and moved ~zero payload bytes.
         self._tm_fetch_nm = reg.counter(
             "dps_worker_fetch_not_modified_total", worker=w)
+        # Session resumes survived (reconnect state machine,
+        # docs/ROBUSTNESS.md). Labeled by the INITIAL registration id —
+        # the logical worker's identity for the whole run, even though a
+        # resume may register under a fresh id (the id is in the resume
+        # log line and the worker.reconnect span attrs).
+        self._tm_reconnect = reg.counter("dps_worker_reconnect_total",
+                                         worker=w)
 
     def _run(self) -> None:
         cfg = self.config
@@ -407,7 +459,7 @@ class PSWorker(threading.Thread):
         if cfg.heartbeat_interval > 0:
             threading.Thread(
                 target=self._heartbeat_loop,
-                args=(worker_id, cfg.heartbeat_interval),
+                args=(cfg.heartbeat_interval,),
                 daemon=True).start()
 
         # Template structure for flat<->pytree conversion.
@@ -427,8 +479,9 @@ class PSWorker(threading.Thread):
         # Overlapped comms: pushes + prefetches ride a bounded single-slot
         # background thread; the RPC sequence is IDENTICAL to the serial
         # loop (see _CommsPipeline), only the training thread stops
-        # blocking on it.
-        pipe = _CommsPipeline(self, worker_id) if cfg.overlap else None
+        # blocking on it. Held as an attribute so the session-resume path
+        # can drain and rebuild it (docs/ROBUSTNESS.md).
+        self._pipe = _CommsPipeline(self, worker_id) if cfg.overlap else None
 
         try:
             for epoch in range(cfg.num_epochs):
@@ -451,18 +504,12 @@ class PSWorker(threading.Thread):
                                 step=self.result.local_steps_completed,
                                 epoch=epoch, epoch_open=True):
                     with trace_span("worker.fetch_wait"):
-                        if pipe is not None and pipe.params_pending():
-                            params, fetched_step = pipe.await_params()
-                        else:
-                            if pipe is not None:
-                                # a fetch must never overtake a push
-                                pipe.flush()
-                            params, fetched_step = self._fetch_params(
-                                worker_id,
-                                have_step=(fetched_step
-                                           if params is not None
-                                           else None),
-                                current=params)
+                        params, fetched_step = self._boundary_fetch(
+                            worker_id, fetched_step, params)
+                # A session resume inside the fetch may have re-registered
+                # under a fresh id; everything downstream (shard, spans,
+                # pushes) must use the CURRENT registration.
+                worker_id = self.result.worker_id
                 # Contiguous shard by worker id (worker.py:166-179); ids
                 # beyond total_workers wrap (vs the reference's skewed
                 # coverage, SURVEY.md quirk 10). Recomputed each epoch: in
@@ -488,22 +535,10 @@ class PSWorker(threading.Thread):
                     with step_span:
                         if boundary and batch_idx > 0:
                             with trace_span("worker.fetch_wait"):
-                                if pipe is not None \
-                                        and pipe.params_pending():
-                                    # The prefetch issued right after the
-                                    # window's push — its latency ran
-                                    # under the window's compute instead
-                                    # of on the critical path.
-                                    params, fetched_step = \
-                                        pipe.await_params()
-                                else:
-                                    if pipe is not None:
-                                        pipe.flush()
-                                    params, fetched_step = \
-                                        self._fetch_params(
-                                            worker_id,
-                                            have_step=fetched_step,
-                                            current=params)
+                                params, fetched_step = \
+                                    self._boundary_fetch(
+                                        worker_id, fetched_step, params)
+                            worker_id = self.result.worker_id
 
                         t_step = _tnow()
                         with trace_span("worker.compute") as _csp:
@@ -534,16 +569,19 @@ class PSWorker(threading.Thread):
                                     lambda a, b: a + b, accum, grads)
                             accum_n += 1
                             if accum_n == k:
-                                self._dispatch_push_mean(
-                                    pipe, worker_id, accum, accum_n,
-                                    fetched_step, params)
+                                params, fetched_step = \
+                                    self._dispatch_push_mean(
+                                        worker_id, accum, accum_n,
+                                        fetched_step, params)
+                                worker_id = self.result.worker_id
                                 accum, accum_n = None, 0
                         elif boundary:
                             # Faithful: push THIS batch's gradients; the
                             # other K-1 batches' gradients are computed
                             # and dropped (quirk 7).
-                            self._dispatch_push(pipe, worker_id, grads,
-                                                fetched_step, params)
+                            params, fetched_step = self._dispatch_push(
+                                worker_id, grads, fetched_step, params)
+                            worker_id = self.result.worker_id
 
                 # An epoch ending mid-window flushes the partial
                 # accumulator, divided by the ACTUAL number of accumulated
@@ -551,16 +589,21 @@ class PSWorker(threading.Thread):
                 # window (which would push a >K-batch sum divided by K,
                 # against stale params).
                 if accum is not None:
-                    self._dispatch_push_mean(pipe, worker_id, accum,
-                                             accum_n, fetched_step, params)
+                    params, fetched_step = self._dispatch_push_mean(
+                        worker_id, accum, accum_n, fetched_step, params)
+                    worker_id = self.result.worker_id
                     accum, accum_n = None, 0
-                if pipe is not None:
+                if self._pipe is not None:
                     # Epoch barrier: the epoch's last push must be ON the
                     # server before the epoch closes, so epoch timings and
                     # sync-round accounting match the serial loop; the
                     # prefetch RESULT survives into the next epoch's
                     # opening fetch.
-                    pipe.flush()
+                    try:
+                        self._pipe.flush()
+                    except Exception as e:
+                        params, fetched_step = self._recover_session(e)
+                        worker_id = self.result.worker_id
 
                 self.result.epoch_times.append(time.time() - t_epoch)
                 self._tm_epochs.inc()
@@ -581,33 +624,232 @@ class PSWorker(threading.Thread):
                       f"time={self.result.epoch_times[-1]:.1f}s{acc}",
                       flush=True)
         finally:
-            if pipe is not None:
-                pipe.close()
+            if self._pipe is not None:
+                self._pipe.close()
 
-    def _dispatch_push(self, pipe, worker_id: int, grads_tree,
-                       fetched_step: int, params) -> None:
+    # -- session resume (docs/ROBUSTNESS.md) ---------------------------------
+
+    @staticmethod
+    def _session_lost(exc):
+        """The SessionLostError behind ``exc`` (direct, or carried as the
+        ``__cause__`` of a comms-pipeline RuntimeError), else None."""
+        from ..comms.client import SessionLostError
+        if isinstance(exc, SessionLostError):
+            return exc
+        cause = getattr(exc, "__cause__", None)
+        if isinstance(cause, SessionLostError):
+            return cause
+        return None
+
+    def _repush_viable(self, old_fetched: int, server_step: int) -> bool:
+        """Worker-side half of the staleness semantics for a gradient
+        stranded by a session loss: never push a gradient whose basis is
+        AHEAD of the restored server (the down-weighting math assumes
+        non-negative staleness), and don't bother re-sending one the async
+        staleness gate would reject anyway. Sync mode accepts any
+        contribution (the no-barrier round model, quirk 2)."""
+        if server_step < old_fetched:
+            return False
+        cfg = getattr(self.store, "config", None)
+        if getattr(cfg, "mode", "sync") == "async":
+            from .semantics import DEFAULT_STALENESS_BOUND
+            bound = getattr(cfg, "staleness_bound",
+                            DEFAULT_STALENESS_BOUND)
+            return server_step - old_fetched <= bound
+        return True
+
+    def _reconcile_inflight(self, worker_id: int, inflight,
+                            server_step: int) -> str:
+        """Decide the fate of the gradient that was mid-push when the
+        session died: discard (stale or rewound basis) or re-push. The
+        re-push prefers the client's recorded request — SAME exactly-once
+        token, so a push the crashed server already applied and journaled
+        replays as a duplicate instead of double-applying."""
+        grads_tree, old_fetched = inflight
+        if not self._repush_viable(old_fetched, server_step):
+            return "discarded"
+        repush = getattr(self.store, "repush_last", None)
+        if callable(repush):
+            accepted = repush(worker_id)
+            if accepted is not None:
+                if accepted:
+                    self.result.pushes_accepted += 1
+                else:
+                    self.result.pushes_rejected += 1
+                return "repushed"
+        # No recorded request to replay (in-process store duck-typing):
+        # fall back to a fresh push with the original basis step.
+        self._push(worker_id, grads_tree, old_fetched)
+        return "repushed"
+
+    def _recover_session(self, exc, inflight=None):
+        """The reconnect state machine: on SessionLostError (server died
+        or restarted), drain the comms pipeline, re-register — under
+        elastic membership the fresh registration takes the lowest free
+        slot, so sync rounds re-size to the post-restart membership
+        instead of wedging — re-fetch params at the restored server step,
+        reconcile the in-flight gradient, and rebuild the pipeline.
+        Bounded by ``reconnect_timeout`` with exponential backoff;
+        disabled (0, the default) re-raises ``exc`` unchanged. Returns the
+        fresh ``(params, fetched_step)`` the training loop adopts."""
+        lost = self._session_lost(exc)
+        cfg = self.config
+        if lost is None or cfg.reconnect_timeout <= 0:
+            raise exc
+        if self._pipe is not None:
+            # Drain/reset: capture the failed push (if that is what died)
+            # for reconciliation, then retire the comms thread. A fresh
+            # pipeline starts once the new session is up.
+            failed = self._pipe.take_failed_item()
+            if inflight is None:
+                inflight = failed
+            try:
+                self._pipe.close()
+            except Exception:  # noqa: BLE001 — teardown must not mask
+                pass
+            self._pipe = None
+        old_id = self.result.worker_id
+        deadline = time.time() + cfg.reconnect_timeout
+        delay = cfg.reconnect_backoff
+        attempts = 0
+        with trace_span("worker.reconnect", root=True,
+                        worker=old_id) as sp:
+            while True:
+                attempts += 1
+                try:
+                    # The WHOLE resume attempt — register, refetch,
+                    # reconcile — retries inside the window: a server
+                    # that flaps again mid-refetch costs one backoff
+                    # turn, not the worker (the reconcile re-push is
+                    # idempotent: same token, journal-deduped).
+                    # A channel that watched its server die can wedge in
+                    # connect backoff even once the replacement listens
+                    # on the same port — start every attempt on a fresh
+                    # channel (RemoteStore.reset_channel; no-op for
+                    # in-process stores).
+                    reset = getattr(self.store, "reset_channel", None)
+                    if callable(reset):
+                        reset()
+                    # Single registration attempt per turn of OUR backoff
+                    # loop (the client's internal x5 backoff would blow
+                    # through the reconnect window in one call).
+                    if hasattr(self.store, "register_retries"):
+                        worker_id, _ = self.store.register_worker(
+                            self.worker_name, retries=1)
+                    else:
+                        worker_id, _ = self.store.register_worker(
+                            self.worker_name)
+                    # Fresh FULL fetch at the restored server step (the
+                    # old session's delta basis is gone with the old
+                    # server).
+                    params, fetched_step = self._fetch_params(worker_id)
+                    outcome = "none"
+                    if inflight is not None:
+                        outcome = self._reconcile_inflight(
+                            worker_id, inflight, fetched_step)
+                    break
+                except ConnectionError as e:
+                    if time.time() + delay > deadline:
+                        sp.attrs["outcome"] = "gave_up"
+                        from ..comms.client import SessionLostError
+                        raise SessionLostError(
+                            f"reconnect window "
+                            f"({cfg.reconnect_timeout:.0f}s) exhausted "
+                            f"after {attempts} attempts: {e}") from lost
+                    time.sleep(delay)
+                    delay = min(delay * 2.0, 10.0)
+            self.result.worker_id = worker_id
+            self.result.reconnects += 1
+            self._tm_reconnect.inc()
+            sp.attrs.update(attempts=attempts, new_worker_id=worker_id,
+                            inflight=outcome)
+            if cfg.overlap:
+                self._pipe = _CommsPipeline(self, worker_id)
+        print(f"RECONNECTED worker={self.worker_name} old_id={old_id} "
+              f"new_id={worker_id} server_step={fetched_step} "
+              f"attempts={attempts} inflight={outcome}", flush=True)
+        return params, fetched_step
+
+    def _boundary_fetch(self, worker_id: int, fetched_step: int, params):
+        """The (pipeline-aware) boundary params fetch, resuming the
+        session on failure. Returns (params pytree, fetched step)."""
+        try:
+            pipe = self._pipe
+            if pipe is not None and pipe.params_pending():
+                # The prefetch issued right after the window's push — its
+                # latency ran under the window's compute instead of on
+                # the critical path.
+                return pipe.await_params()
+            if pipe is not None:
+                pipe.flush()  # a fetch must never overtake a push
+            return self._fetch_params(
+                worker_id,
+                have_step=fetched_step if params is not None else None,
+                current=params)
+        except Exception as e:
+            return self._recover_session(e)
+
+    def _dispatch_push(self, worker_id: int, grads_tree,
+                       fetched_step: int, params):
         """Push now (serial) or hand to the comms pipeline with a prefetch
-        of the next params riding behind it (overlapped).
+        of the next params riding behind it (overlapped). Returns the
+        (params, fetched_step) the loop should continue with — unchanged
+        on the happy path, the restored server state after a session
+        resume.
 
         The push_wait span is the training thread's blocked time either
         way: the full push RPC when serial, the single-slot backpressure
         when overlapped (near zero while the pipeline keeps up — the
         overlap win, visible per step in the trace)."""
         with trace_span("worker.push_wait"):
-            if pipe is None:
-                self._push(worker_id, grads_tree, fetched_step)
-            else:
-                pipe.submit(grads_tree, fetched_step,
-                            prefetch_current=params)
+            try:
+                if self._pipe is None:
+                    self._push(worker_id, grads_tree, fetched_step)
+                else:
+                    self._pipe.submit(grads_tree, fetched_step,
+                                      prefetch_current=params)
+                return params, fetched_step
+            except Exception as e:
+                return self._recover_push(e, grads_tree, fetched_step)
 
-    def _dispatch_push_mean(self, pipe, worker_id: int, accum_tree, n: int,
-                            fetched_step: int, params) -> None:
+    def _dispatch_push_mean(self, worker_id: int, accum_tree, n: int,
+                            fetched_step: int, params):
         with trace_span("worker.push_wait"):
-            if pipe is None:
-                self._push_mean(worker_id, accum_tree, n, fetched_step)
-            else:
-                pipe.submit(_window_mean(accum_tree, n), fetched_step,
-                            prefetch_current=params)
+            mean_tree = None
+            try:
+                if self._pipe is None:
+                    self._push_mean(worker_id, accum_tree, n, fetched_step)
+                else:
+                    mean_tree = _window_mean(accum_tree, n)
+                    self._pipe.submit(mean_tree, fetched_step,
+                                      prefetch_current=params)
+                return params, fetched_step
+            except Exception as e:
+                grads = mean_tree if mean_tree is not None \
+                    else _window_mean(accum_tree, n)
+                return self._recover_push(e, grads, fetched_step)
+
+    def _recover_push(self, exc, grads_tree, fetched_step: int):
+        """Session recovery from a push dispatch. Serial case: THIS push
+        died mid-RPC — it is the in-flight gradient to reconcile.
+        Pipelined case: ``submit`` surfaced a PREVIOUS item's failure
+        (that item is reconciled from the pipeline's failed slot) and
+        this window's gradients never left — send them after the resume
+        if still viable against the restored step."""
+        pipelined = self._pipe is not None
+        inflight = None if pipelined else (grads_tree, fetched_step)
+        params, new_step = self._recover_session(exc, inflight=inflight)
+        if pipelined and self._repush_viable(fetched_step, new_step):
+            try:
+                self._push(self.result.worker_id, grads_tree, fetched_step)
+            except Exception as e2:
+                # The server flapped AGAIN between the resume and this
+                # send: this push is now the in-flight gradient of a new
+                # session loss — recover once more (bounded by its own
+                # reconnect window).
+                params, new_step = self._recover_session(
+                    e2, inflight=(grads_tree, fetched_step))
+        return params, new_step
 
     def _fetch_params(self, worker_id: int, have_step: int | None = None,
                       current=None):

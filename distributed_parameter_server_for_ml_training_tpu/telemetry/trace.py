@@ -83,6 +83,10 @@ SPAN_CATALOG = {
                     "flatten+compress before push / decompress+unflatten "
                     "after fetch)",
     "worker.eval": "per-epoch full test-set eval (root)",
+    "worker.reconnect": "session-resume state machine after a lost "
+                        "server connection (root; attrs attempts, "
+                        "new_worker_id, inflight=repushed|discarded|none, "
+                        "outcome=gave_up on failure)",
     "pipeline.comms": "overlapped comms-thread item: push + prefetch, "
                       "parented under the submitting step",
     "rpc.client": "one client RPC attempt (attr rpc=<name>; failures "

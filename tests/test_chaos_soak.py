@@ -1,0 +1,38 @@
+"""Chaos soak wrapper (slow — outside the tier-1 budget by design).
+
+The full kill+restart drill with real subprocess servers lives in
+``experiments/run_chaos_soak.py``; this runs its quick mode end-to-end and
+asserts the recorded verdicts. Fast, in-process recovery coverage is in
+``tests/test_recovery.py`` (tier-1).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_chaos_soak_quick(tmp_path):
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "experiments", "run_chaos_soak.py"),
+         "--quick", "--out-dir", str(tmp_path)],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        cwd=REPO, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-3000:]
+    with open(tmp_path / "chaos_soak.json") as f:
+        summary = json.load(f)
+    assert summary["ok"], summary["checks"]
+    failed = [c for c in summary["checks"] if not c["ok"]]
+    assert not failed, failed
+    # the headline properties, named explicitly
+    names = {c["name"] for c in summary["checks"] if c["ok"]}
+    assert "A.step_parity" in names
+    assert "A.accuracy_curve_parity" in names
+    assert "A.zero_double_applies_journal_verified" in names
+    assert "B.converges_within_tolerance" in names

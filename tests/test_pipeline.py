@@ -220,3 +220,38 @@ def test_1f1b_training_learns(devices, stage_params):
                                         params, grads)
         losses.append(float(loss))
     assert losses[-1] < losses[0] * 0.7
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_heterogeneous_stage_fn_raises_clear_error(devices, stage_params,
+                                                   schedule):
+    """A stage_fn that changes the microbatch's shape or dtype must fail
+    with a message naming the homogeneous-stage requirement, not an opaque
+    lax.cond branch-shape mismatch at trace time (round-5 ADVICE)."""
+    mesh = make_mesh(S, axis_names=("stage",))
+    stacked = stack_stage_params(stage_params)
+    x = jnp.ones((16, D), jnp.float32)
+    y = jnp.ones((16, D), jnp.float32)
+
+    def widening_stage(params, xb):  # D -> 2D output
+        h = jnp.tanh(xb @ params["w"] + params["b"])
+        return jnp.concatenate([h, h], axis=-1)
+
+    step = make_pipeline_train_step(mesh, widening_stage, _l2_loss, 4,
+                                    schedule=schedule)
+    with pytest.raises(ValueError, match="homogeneous"):
+        step(stacked, x, y)
+
+    def casting_stage(params, xb):  # dtype change, same shape
+        return jnp.tanh(xb @ params["w"] + params["b"]).astype(jnp.bfloat16)
+
+    step2 = make_pipeline_train_step(mesh, casting_stage, _l2_loss, 4,
+                                     schedule=schedule)
+    with pytest.raises(ValueError, match="homogeneous"):
+        step2(stacked, x, y)
+
+    # the valid stage_fn still passes the up-front check and trains
+    ok = make_pipeline_train_step(mesh, stage_fn, _l2_loss, 4,
+                                  schedule=schedule)
+    loss, grads = ok(stacked, x, y)
+    assert np.isfinite(float(loss))
