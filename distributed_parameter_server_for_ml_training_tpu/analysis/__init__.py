@@ -2,7 +2,10 @@
 
 from .parse_logs import (
     aggregate_worker_metrics,
+    alert_timeline,
     build_telemetry_timeseries,
+    cluster_worker_series,
+    parse_cluster_series,
     parse_experiment,
     parse_snapshot_series,
     staleness_series,
@@ -19,9 +22,12 @@ from .traces import (
 )
 from .visualize import ExperimentVisualizer
 
-__all__ = ["aggregate_worker_metrics", "assemble_traces",
-           "build_telemetry_timeseries", "critical_path_report",
+__all__ = ["aggregate_worker_metrics", "alert_timeline",
+           "assemble_traces",
+           "build_telemetry_timeseries", "cluster_worker_series",
+           "critical_path_report",
            "find_trace_dumps", "load_trace_dumps",
+           "parse_cluster_series",
            "parse_experiment", "parse_snapshot_series",
            "save_chrome_trace", "staleness_series", "to_chrome_trace",
            "worker_throughput_series",

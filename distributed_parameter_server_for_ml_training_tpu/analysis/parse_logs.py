@@ -41,6 +41,12 @@ def _is_snapshot(m: dict) -> bool:
     return m.get("kind") == "snapshot"
 
 
+def _is_cluster(m: dict) -> bool:
+    """Cluster-monitor records (telemetry/cluster.py ``"kind": "cluster"``)
+    — same wire convention, same exclusion from the final aggregation."""
+    return m.get("kind") == "cluster"
+
+
 def aggregate_worker_metrics(workers: list[dict]) -> dict:
     """parse_cloudwatch_logs.py:125-177 semantics."""
     if not workers:
@@ -103,7 +109,8 @@ def aggregate_worker_metrics(workers: list[dict]) -> dict:
 def parse_experiment(logs: str | Iterable[str],
                      experiment_name: str = "experiment") -> dict:
     """Full log text (possibly many processes' stdout) -> experiment record."""
-    metrics = [m for m in parse_metrics_lines(logs) if not _is_snapshot(m)]
+    metrics = [m for m in parse_metrics_lines(logs)
+               if not _is_snapshot(m) and not _is_cluster(m)]
     server = next((m for m in metrics
                    if not _is_worker(m) and "mode" in m), None)
     workers = [m for m in metrics if _is_worker(m)]
@@ -314,6 +321,95 @@ def staleness_series(ts_record: dict) -> dict:
                 }
     return {"le": le or [], "counts": counts or [],
             "push_rates": total_series}
+
+
+# ---------------------------------------------------------------------------
+# Cluster-monitor records (telemetry/cluster.py "kind": "cluster") ->
+# health history. The monitor emits one record per evaluation interval:
+# the live worker table + active alerts, plus the EDGE events (fired/
+# refired/resolved) since the previous record. These parsers turn a run's
+# captured stdout into an alert timeline and per-worker health series the
+# visualizer overlays on the training curves.
+# ---------------------------------------------------------------------------
+
+def parse_cluster_series(logs: str | Iterable[str]
+                         ) -> dict[str, list[dict]]:
+    """All ``"kind": "cluster"`` records, grouped by emitting process
+    (``role:pid``), each group sorted by ``seq``."""
+    out: dict[str, list[dict]] = {}
+    for m in parse_metrics_lines(logs):
+        if not _is_cluster(m):
+            continue
+        key = f"{m.get('role', 'server')}:{m.get('pid', 0)}"
+        out.setdefault(key, []).append(m)
+    for recs in out.values():
+        recs.sort(key=lambda r: r.get("seq", 0))
+    return out
+
+
+def alert_timeline(logs: str | Iterable[str]) -> list[dict]:
+    """Flattened alert edge events across every cluster record, ordered by
+    time. Each event: ``{"t" (seconds since the first record), "ts",
+    "state" (fired|refired|resolved), "rule", "severity", "worker",
+    "message", ...}`` — the overlay input for
+    :meth:`.visualize.ExperimentVisualizer.plot_cluster_health`."""
+    series = parse_cluster_series(logs)
+    starts = [float(rec["ts"]) - float(rec.get("uptime_seconds", 0.0))
+              for recs in series.values() for rec in recs
+              if rec.get("ts")]
+    t0 = min(starts) if starts else None
+    events: list[dict] = []
+    for proc_key, recs in series.items():
+        for rec in recs:
+            for ev in rec.get("events", []):
+                if not isinstance(ev, dict):
+                    continue
+                ts = float(ev.get("last_ts") or ev.get("since")
+                           or rec.get("ts") or 0.0)
+                events.append({
+                    "t": round(ts - t0, 3) if t0 is not None else 0.0,
+                    "ts": ts,
+                    "proc": proc_key,
+                    "state": ev.get("state"),
+                    "rule": ev.get("rule"),
+                    "severity": ev.get("severity"),
+                    "worker": ev.get("worker"),
+                    "message": ev.get("message"),
+                    "value": ev.get("value"),
+                    "threshold": ev.get("threshold"),
+                })
+    events.sort(key=lambda e: e["ts"])
+    return events
+
+
+def cluster_worker_series(logs: str | Iterable[str]) -> dict:
+    """Per-worker health time-series from the cluster records: ``t``
+    (relative seconds) plus step/loss/grad-norm/examples-per-second
+    sequences keyed ``worker-N`` — the cluster-eye view of each worker,
+    as opposed to the worker's own snapshot stream."""
+    series = parse_cluster_series(logs)
+    recs = [r for recs in series.values() for r in recs]
+    recs.sort(key=lambda r: float(r.get("ts", 0.0)))
+    if not recs:
+        return {"t": [], "workers": {}}
+    t0 = float(recs[0].get("ts", 0.0)) \
+        - float(recs[0].get("uptime_seconds", 0.0))
+    t = [round(float(r.get("ts", 0.0)) - t0, 3) for r in recs]
+    workers: dict[str, dict] = {}
+    for i, rec in enumerate(recs):
+        for row in rec.get("workers", []):
+            wid = row.get("worker")
+            if wid is None:
+                continue
+            w = workers.setdefault(
+                f"worker-{wid}",
+                {k: [None] * len(recs)
+                 for k in ("step", "loss", "grad_norm",
+                           "examples_per_s", "alive")})
+            for k in ("step", "loss", "grad_norm", "examples_per_s",
+                      "alive"):
+                w[k][i] = row.get(k)
+    return {"t": t, "workers": workers}
 
 
 def parse_log_files(paths: list[str], experiment_name: str,

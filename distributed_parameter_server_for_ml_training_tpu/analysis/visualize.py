@@ -247,6 +247,80 @@ class ExperimentVisualizer:
         fig.savefig(out_path, dpi=120)
         plt.close(fig)
 
+    # -- cluster health (kind=cluster monitor records) -----------------------
+
+    @staticmethod
+    def plot_cluster_health(logs: str, out_path: str) -> dict:
+        """4-panel cluster-health figure from a run's captured stdout
+        (``serve --telemetry`` emits the ``"kind": "cluster"`` records):
+        per-worker step progress and loss curves with ALERT overlays
+        (vertical lines at each fired alert, colored by severity), the
+        alert timeline itself (rule vs time), and per-worker examples/s.
+        Returns ``{"timeline": [...], "workers": [...]}`` so callers (the
+        recorded demo) can assert on what was plotted."""
+        import matplotlib
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        from .parse_logs import alert_timeline, cluster_worker_series
+
+        timeline = alert_timeline(logs)
+        series = cluster_worker_series(logs)
+        sev_color = {"critical": "tab:red", "warning": "tab:orange",
+                     "info": "tab:blue"}
+        fired = [e for e in timeline if e["state"] == "fired"]
+
+        fig, axes = plt.subplots(2, 2, figsize=(13, 9))
+
+        def overlay(ax):
+            for e in fired:
+                ax.axvline(e["t"], color=sev_color.get(e["severity"],
+                                                       "gray"),
+                           ls="--", lw=1, alpha=0.7)
+
+        ax = axes[0, 0]
+        for name, w in sorted(series["workers"].items()):
+            ax.plot(series["t"], w["step"], "o-", ms=3, label=name)
+        overlay(ax)
+        ax.set_title("Worker step progress (cluster view)")
+        ax.set_xlabel("run time (s)")
+        ax.legend(fontsize=7)
+
+        ax = axes[0, 1]
+        for name, w in sorted(series["workers"].items()):
+            ax.plot(series["t"], w["loss"], "o-", ms=3, label=name)
+        overlay(ax)
+        ax.set_title("Worker loss (alert overlays)")
+        ax.set_xlabel("run time (s)")
+        ax.legend(fontsize=7)
+
+        ax = axes[1, 0]
+        rules = sorted({e["rule"] for e in timeline})
+        ridx = {r: i for i, r in enumerate(rules)}
+        marks = {"fired": "o", "refired": "s", "resolved": "x"}
+        for e in timeline:
+            ax.scatter(e["t"], ridx[e["rule"]],
+                       marker=marks.get(e["state"], "."),
+                       color=sev_color.get(e["severity"], "gray"), s=60)
+        ax.set_yticks(range(len(rules)))
+        ax.set_yticklabels(rules, fontsize=8)
+        ax.set_title("Alert timeline (o fired, s refired, x resolved)")
+        ax.set_xlabel("run time (s)")
+
+        ax = axes[1, 1]
+        for name, w in sorted(series["workers"].items()):
+            ax.plot(series["t"], w["examples_per_s"], "o-", ms=3,
+                    label=name)
+        ax.set_title("Worker throughput (examples/s, reported)")
+        ax.set_xlabel("run time (s)")
+        ax.legend(fontsize=7)
+
+        fig.tight_layout()
+        fig.savefig(out_path, dpi=120)
+        plt.close(fig)
+        return {"timeline": timeline,
+                "workers": sorted(series["workers"])}
+
     def summary_table(self) -> str:
         """Console summary (visualize_results.py:278-296)."""
         lines = [f"{'experiment':<28}{'mode':<8}{'workers':>8}"

@@ -13,6 +13,7 @@ Commands::
     python -m ....cli train --mode baseline           # single-chip baseline
     python -m ....cli serve --mode async --workers 8  # gRPC PS (multi-host)
     python -m ....cli worker --server host:8000       # gRPC remote worker
+    python -m ....cli status --url http://host:9400   # cluster health view
 
 The in-process ``train`` command replaces the reference's entire
 terraform/ECS deployment for single-host experiments: what took a Fargate
@@ -265,6 +266,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="deterministic server-side fault injection spec "
                         "(comms/faults.py), e.g. "
                         "'seed=7;push.drop_reply@n=3;any.kill@n=40'")
+    s.add_argument("--no-health-monitor", action="store_true",
+                   help="disable the cluster health monitor (worker health "
+                        "reports, rule engine, /cluster endpoint, /healthz "
+                        "readiness flip — docs/OBSERVABILITY.md); on by "
+                        "default")
+    s.add_argument("--health-interval", type=float,
+                   default=_env("DPS_HEALTH_INTERVAL", 5.0, float),
+                   help="seconds between cluster health evaluations (and "
+                        "'kind=cluster' stream records when --telemetry)")
+    s.add_argument("--dead-after", type=float,
+                   default=_env("DPS_DEAD_AFTER", 30.0, float),
+                   help="seconds of silence before the monitor declares a "
+                        "worker dead (critical alert; independent of "
+                        "--worker-timeout membership expiry)")
     add_platform(s)
     add_telemetry(s)
 
@@ -332,6 +347,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "worker loop into this directory (TensorBoard/"
                         "Perfetto; pairs with --trace span traces)")
     add_common(w)
+
+    st = sub.add_parser(
+        "status",
+        help="cluster health dashboard: render a serve process's "
+             "GET /cluster as a terminal table (docs/OBSERVABILITY.md)")
+    st.add_argument("--url", default=_env("DPS_STATUS_URL", None),
+                    help="base URL of the server's metrics endpoint, e.g. "
+                         "http://host:9400 (env DPS_STATUS_URL); overrides "
+                         "--host/--metrics-port")
+    st.add_argument("--host", default="127.0.0.1",
+                    help="metrics endpoint host (with --metrics-port)")
+    st.add_argument("--metrics-port", type=int,
+                    default=_env("DPS_METRICS_PORT", None, int),
+                    help="the serve process's --metrics-port")
+    st.add_argument("--watch", type=float, default=0.0, metavar="SECONDS",
+                    help="redraw every N seconds until interrupted "
+                         "(0 = one shot)")
+    st.add_argument("--json", action="store_true",
+                    help="print the raw /cluster JSON instead of the table")
 
     return p
 
@@ -555,7 +589,25 @@ def _cmd_serve(args) -> int:
                     push_codec=(None if args.push_codec == "default"
                                 else args.push_codec),
                     fetch_codec=args.fetch_codec))
-    svc = ParameterService(store, faults=getattr(args, "faults", None))
+    monitor = None
+    if not getattr(args, "no_health_monitor", False):
+        # Cluster health monitor (docs/OBSERVABILITY.md): aggregates the
+        # workers' piggybacked health reports with membership state, runs
+        # the rule engine, serves GET /cluster, and flips /healthz to 503
+        # while a critical alert is active. On by default — it is the
+        # observe-only layer; --no-health-monitor opts out (and stops the
+        # capability being advertised to workers at all).
+        from .telemetry import (ClusterMonitor, HealthThresholds,
+                                set_cluster_monitor)
+        monitor = ClusterMonitor(
+            store,
+            HealthThresholds(dead_after_s=getattr(args, "dead_after", 30.0)),
+            interval=getattr(args, "health_interval", 5.0),
+            emit_stream=bool(getattr(args, "telemetry", False)))
+        set_cluster_monitor(monitor)
+        monitor.start()
+    svc = ParameterService(store, faults=getattr(args, "faults", None),
+                           monitor=monitor)
     ckpt_dir = getattr(args, "checkpoint_dir", None)
     ckpt = None
     restored = None
@@ -622,11 +674,19 @@ def _cmd_serve(args) -> int:
             expired = store.expire_stale_workers()
             if expired:
                 print(f"expired silent workers: {expired}", file=sys.stderr)
+                if monitor is not None:
+                    # Dead-worker alerts fire on the very next evaluation
+                    # instead of waiting out the report-age threshold.
+                    monitor.note_expired(expired)
         time.sleep(0.5)
     except KeyboardInterrupt:
         pass
     finally:
         server.stop(grace=2.0)
+        if monitor is not None:
+            from .telemetry import set_cluster_monitor
+            monitor.stop(final=True)
+            set_cluster_monitor(None)
         if ckpt is not None:
             from .telemetry import remove_shutdown_flush
             remove_shutdown_flush(ckpt.flush_now)
@@ -681,6 +741,122 @@ def _cmd_worker(args) -> int:
     return 0
 
 
+def _render_status(view: dict) -> str:
+    """The ``cli status`` terminal dashboard: cluster header, per-worker
+    table, active alerts. Pure text in, text out (tested directly)."""
+    sev_mark = {"critical": "CRIT", "warning": "WARN", "info": "INFO"}
+    totals = view.get("alerts_total", {})
+    header = (f"cluster: mode={view.get('mode', '?')} "
+              f"global_step={view.get('global_step', 0)} "
+              f"workers={len(view.get('workers', []))} "
+              f"alerts: critical={totals.get('critical', 0)} "
+              f"warning={totals.get('warning', 0)} "
+              f"info={totals.get('info', 0)}")
+    cols = [("worker", 7), ("alive", 6), ("step", 8), ("epoch", 6),
+            ("loss", 10), ("grad_norm", 11), ("ex/s", 9), ("pipe", 5),
+            ("reconn", 7), ("hb_err", 7), ("age_s", 7)]
+    lines = [header, "-" * len(header),
+             "".join(f"{name:>{w}}" for name, w in cols)]
+
+    def cell(v, width, fmt=None):
+        if v is None:
+            return f"{'-':>{width}}"
+        try:
+            return f"{(fmt(v) if fmt else v)!s:>{width}}"
+        except (TypeError, ValueError):
+            return f"{'-':>{width}}"
+
+    for row in view.get("workers", []):
+        age = row.get("report_age_s", row.get("last_seen_age_s"))
+        loss = row.get("loss")
+        if loss is None and not row.get("loss_finite", True):
+            loss = "NaN"
+        gn = row.get("grad_norm")
+        if gn is None and not row.get("grad_finite", True):
+            gn = "NaN"
+        lines.append("".join([
+            cell(row.get("worker"), 7),
+            cell("yes" if row.get("alive") else "NO", 6),
+            cell(row.get("step"), 8),
+            cell(row.get("epoch"), 6),
+            cell(loss, 10, lambda v: v if isinstance(v, str)
+                 else f"{v:.4f}"),
+            cell(gn, 11, lambda v: v if isinstance(v, str)
+                 else f"{v:.4g}"),
+            cell(row.get("examples_per_s"), 9,
+                 lambda v: f"{v:.1f}"),
+            cell(row.get("pipeline_depth"), 5),
+            cell(row.get("reconnects"), 7),
+            cell(row.get("heartbeat_errors"), 7),
+            cell(age, 7, lambda v: f"{v:.1f}"),
+        ]))
+    alerts = view.get("alerts", [])
+    if alerts:
+        lines.append("")
+        lines.append("active alerts:")
+        for a in alerts:
+            who = "cluster" if a.get("worker") is None \
+                else f"worker {a['worker']}"
+            lines.append(f"  [{sev_mark.get(a.get('severity'), '????')}] "
+                         f"{a.get('rule')} ({who}): {a.get('message')}")
+    else:
+        lines.append("")
+        lines.append("no active alerts")
+    return "\n".join(lines)
+
+
+def cmd_status(args) -> int:
+    """One-shot (or ``--watch``) render of a serve process's ``/cluster``
+    view. Exit codes: 0 healthy, 2 when a CRITICAL alert is active (so a
+    cron/script can gate on it), 1 when the endpoint is unreachable or has
+    no monitor."""
+    import json as _json
+    import time as _time
+    from urllib.error import HTTPError, URLError
+    from urllib.request import urlopen
+
+    base = args.url
+    if not base:
+        if args.metrics_port is None:
+            print("status: need --url or --metrics-port", file=sys.stderr)
+            return 1
+        base = f"http://{args.host}:{args.metrics_port}"
+    url = base.rstrip("/") + "/cluster"
+
+    def poll() -> tuple[int, dict | None]:
+        try:
+            view = _json.loads(urlopen(url, timeout=5).read())
+        except HTTPError as e:
+            print(f"status: {url} -> HTTP {e.code} "
+                  f"({e.read().decode(errors='replace')[:200]})",
+                  file=sys.stderr)
+            return 1, None
+        except (URLError, OSError, ValueError) as e:
+            print(f"status: cannot reach {url}: {e}", file=sys.stderr)
+            return 1, None
+        if args.json:
+            print(_json.dumps(view, indent=2))
+        else:
+            print(_render_status(view))
+        critical = view.get("alerts_total", {}).get("critical", 0)
+        return (2 if critical else 0), view
+
+    if args.watch <= 0:
+        rc, _ = poll()
+        return rc
+    rc = 0
+    try:
+        while True:
+            print("\x1b[2J\x1b[H", end="")  # clear screen, home cursor
+            rc, _ = poll()
+            print(f"\n(watching {url} every {args.watch:g}s — Ctrl-C to "
+                  f"stop)")
+            _time.sleep(args.watch)
+    except KeyboardInterrupt:
+        pass
+    return rc
+
+
 def cmd_experiments(args) -> int:
     with _telemetry_session(args, "experiments"):
         return _cmd_experiments(args)
@@ -720,7 +896,8 @@ def main(argv=None) -> int:
         import jax
         jax.config.update("jax_platforms", "cpu")
     return {"train": cmd_train, "serve": cmd_serve, "worker": cmd_worker,
-            "experiments": cmd_experiments}[args.command](args)
+            "experiments": cmd_experiments,
+            "status": cmd_status}[args.command](args)
 
 
 if __name__ == "__main__":

@@ -128,6 +128,17 @@ class RemoteStore:
         #: docs/WIRE_PROTOCOL.md): the trace field is only attached to
         #: push frames / fetch meta when the peer said it understands it.
         self.supports_trace_context = False
+        #: True once the server advertises the health-report capability at
+        #: registration (it runs a cluster monitor; docs/OBSERVABILITY.md).
+        self.supports_health_report = False
+        #: Zero-arg callable returning the worker's current health report
+        #: (a small JSON-able dict) or None. PSWorker installs its own
+        #: snapshot builder here after registration; when set AND the
+        #: server advertised the capability, every fetch (incl. heartbeat
+        #: pings) and push carries the report in the envelope meta. Legacy
+        #: combinations — no provider, or a server that never advertised —
+        #: attach nothing, so heartbeats degrade to plain pings.
+        self.health_provider = None
         self.config = _RemoteConfig()
         # Last membership seen on the wire (elastic servers piggyback it on
         # Register/Fetch replies). Workers fetch at least once per K-step
@@ -330,6 +341,8 @@ class RemoteStore:
                     reply.get("delta_fetch", False))
                 self.supports_trace_context = bool(
                     reply.get("trace_context", False))
+                self.supports_health_report = bool(
+                    reply.get("health_report", False))
                 self.config.elastic = bool(reply.get("elastic", False))
                 self.config.mode = reply.get("mode", "sync")
                 self.config.learning_rate = float(
@@ -353,6 +366,20 @@ class RemoteStore:
             f"registration failed after {register_retries} attempts: "
             f"{last_err}")
 
+    def _attach_health(self, meta: dict) -> None:
+        """Piggyback the worker's current health report on an outbound
+        fetch/push envelope (capability-gated; docs/OBSERVABILITY.md).
+        A provider failure degrades to a report-less message — the health
+        layer must never fail the RPC that would have carried it."""
+        if not self.supports_health_report or self.health_provider is None:
+            return
+        try:
+            report = self.health_provider()
+        except Exception:  # noqa: BLE001
+            return
+        if isinstance(report, dict) and report:
+            meta["health"] = report
+
     def fetch(self, worker_id: int | None = None,
               have_step: int | None = None
               ) -> tuple[dict[str, np.ndarray], int]:
@@ -363,6 +390,8 @@ class RemoteStore:
         the round trip costs a header instead of the full model."""
         from .wire import decode_tensor_dict
         meta = {} if worker_id is None else {"worker_id": worker_id}
+        if worker_id is not None:
+            self._attach_health(meta)
         if have_step is not None and self.supports_delta_fetch:
             meta["have_step"] = int(have_step)
         if self.supports_trace_context:
@@ -412,6 +441,7 @@ class RemoteStore:
                 "push_token": token}
         if wt is not None:
             meta["trace"] = wt
+        self._attach_health(meta)
         payload = encode_tensor_dict(gradients, trace=wt)
         # Recorded BEFORE the send: a push that dies mid-RPC is exactly
         # the one the reconnect path must be able to re-send verbatim.

@@ -99,8 +99,15 @@ def unpack_msg(data: bytes) -> tuple[dict, memoryview]:
 class ParameterService:
     """Generic-handler implementation of the 4-RPC lifecycle."""
 
-    def __init__(self, store: ParameterStore, faults=None):
+    def __init__(self, store: ParameterStore, faults=None, monitor=None):
         self.store = store
+        # Cluster health monitor (telemetry/cluster.py): when attached,
+        # registration advertises the health_report capability and the
+        # fetch/push handlers feed piggybacked worker health reports into
+        # it. None = the capability is never advertised and clients stay
+        # silent (docs/OBSERVABILITY.md) — same gating discipline as
+        # delta_fetch / trace_context.
+        self.monitor = monitor
         # Push dedupe: the client retries hot RPCs at-least-once
         # (client.py:_invoke); without this, a push whose reply was lost
         # AFTER it completed a sync round would be re-stashed into the
@@ -188,12 +195,33 @@ class ParameterService:
             # new clients against old servers see no advertisement and
             # stay silent, so mixed versions degrade to untraced.
             "trace_context": True,
+            # Health-report capability (docs/OBSERVABILITY.md): clients may
+            # attach a compact worker health report to fetch/push envelope
+            # meta; this server feeds it to the cluster monitor. Gated on
+            # the monitor actually existing so legacy peers (and monitor-
+            # less servers) degrade to report-less heartbeats.
+            "health_report": self.monitor is not None,
             **self._membership_fields(),
         })
+
+    def _ingest_health(self, worker_id, meta: dict) -> None:
+        """Feed a piggybacked health report to the cluster monitor.
+        Observability only: any failure (garbled report, monitor bug) is
+        swallowed — it must never fail the RPC that carried it."""
+        if self.monitor is None:
+            return
+        health = meta.get("health")
+        if worker_id is None or not isinstance(health, dict):
+            return
+        try:
+            self.monitor.ingest(worker_id, health)
+        except Exception:  # noqa: BLE001
+            pass
 
     def push_gradrients(self, request: bytes, ctx) -> bytes:
         meta, payload = unpack_msg(request)
         wid = int(meta["worker_id"])
+        self._ingest_health(wid, meta)
         token = meta.get("push_token")
         entry = None
         if token is not None:
@@ -319,6 +347,10 @@ class ParameterService:
         meta, _ = unpack_msg(request)
         wid = None if meta.get("worker_id") is None \
             else int(meta["worker_id"])
+        # Heartbeat pings are fetches — the report rides the ping's
+        # envelope meta, so a delta-gated ping (header-only both ways)
+        # still refreshes the cluster monitor's view of this worker.
+        self._ingest_health(wid, meta)
         have = meta.get("have_step")
         if have is not None \
                 and getattr(self.store, "supports_delta_fetch", False):

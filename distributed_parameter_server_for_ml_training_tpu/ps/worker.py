@@ -77,6 +77,13 @@ class WorkerConfig:
     reconnect_timeout: float = 0.0
     # First reconnect retry delay; doubles per attempt (capped at 10 s).
     reconnect_backoff: float = 0.5
+    # Deterministic compute-fault injection (the health demo / tests,
+    # docs/OBSERVABILITY.md): at this 0-based local step, this batch's loss
+    # and gradients are poisoned with NaN — the worker's own health report
+    # must flag them non-finite and the cluster monitor must alert. Env
+    # DPS_NAN_STEP provides the same hook to subprocess workers. None
+    # disables (production default).
+    nan_inject_step: int | None = None
 
     def __post_init__(self):
         if self.k_step_mode not in ("faithful", "accumulate"):
@@ -335,6 +342,21 @@ class PSWorker(threading.Thread):
         # attribute so the session-resume path can drain and rebuild it.
         self._pipe: _CommsPipeline | None = None
         self._tm_reconnect = None  # created at _init_telemetry
+        self._tm_hb_err = None
+        # Worker health report (docs/OBSERVABILITY.md): built at push
+        # boundaries by _note_health, shipped by the RemoteStore on every
+        # fetch/push/heartbeat via the provider installed in _run. The lock
+        # covers training-thread writes vs heartbeat/comms-thread reads.
+        self._health_lock = threading.Lock()
+        self._health: dict = {}
+        self._health_enabled = False
+        self._health_rate: tuple[float, int] | None = None
+        ns = self.config.nan_inject_step
+        if ns is None:
+            import os as _os
+            env = _os.environ.get("DPS_NAN_STEP")
+            ns = int(env) if env else None
+        self._nan_step = ns
         # Shared compiled functions may be passed in to avoid re-tracing per
         # worker; otherwise built here.
         self._grad_step = grad_step or make_grad_step(
@@ -374,7 +396,14 @@ class PSWorker(threading.Thread):
         the step hasn't advanced past the training thread's last fetch.
         The worker id is re-read every tick, so after a session resume the
         same thread keeps the NEW registration alive — heartbeats
-        re-establish themselves with no thread churn."""
+        re-establish themselves with no thread churn.
+
+        Tick failures are COUNTED (dps_worker_heartbeat_errors_total) and
+        logged once per transition into the failing state — previously they
+        were swallowed silently, so a half-dead worker (pings failing,
+        training limping along) was invisible until the server expired it.
+        Transient blips still don't kill the thread; the next tick retries."""
+        failing = False
         while not self._done.wait(interval):
             try:
                 worker_id = self.result.worker_id
@@ -386,8 +415,21 @@ class PSWorker(threading.Thread):
                 else:
                     self.store.fetch(worker_id)
                 self.result.heartbeats += 1
-            except Exception:
-                pass  # transient failures are what registration retry is for
+                if failing:
+                    failing = False
+                    print(f"HEARTBEAT_RECOVERED worker={self.worker_name} "
+                          f"id={self.result.worker_id}", flush=True)
+            except Exception as e:  # noqa: BLE001 — next tick retries
+                if self._tm_hb_err is not None:
+                    self._tm_hb_err.inc()
+                with self._health_lock:
+                    self._health["heartbeat_errors"] = \
+                        self._health.get("heartbeat_errors", 0) + 1
+                if not failing:
+                    failing = True
+                    print(f"HEARTBEAT_FAILING worker={self.worker_name} "
+                          f"id={self.result.worker_id} err={e!r}",
+                          flush=True)
 
     def _compute_shard(self, worker_id: int, total_workers: int):
         """This worker's contiguous data shard.
@@ -449,6 +491,74 @@ class PSWorker(threading.Thread):
         # log line and the worker.reconnect span attrs).
         self._tm_reconnect = reg.counter("dps_worker_reconnect_total",
                                          worker=w)
+        # Heartbeat ticks that failed (satellite: a half-dead worker's
+        # failing pings were previously invisible — no counter, no log).
+        self._tm_hb_err = reg.counter("dps_worker_heartbeat_errors_total",
+                                      worker=w)
+
+    # -- worker health report (docs/OBSERVABILITY.md) ------------------------
+
+    def _health_snapshot(self) -> dict | None:
+        """Provider installed on the RemoteStore: the current report, or
+        None before the first boundary note (a report-less heartbeat is a
+        valid legacy ping, not an error)."""
+        with self._health_lock:
+            return dict(self._health) if self._health else None
+
+    def _note_health(self, loss, grads_tree, epoch: int,
+                     grad_scale: float = 1.0) -> None:
+        """Refresh the health report at a push boundary — the one place the
+        loop already synchronizes with the device, so the float() / norm
+        materializations add no extra sync points. Skipped entirely unless
+        the store advertised the health_report capability (zero cost for
+        unmonitored runs).
+
+        ``grads_tree`` must be (proportional to) what is PUSHED — in
+        accumulate mode that is the window's gradient sum with
+        ``grad_scale=1/n`` (norm of the pushed mean; a NaN from ANY batch
+        in the window is in the sum, so the finite check flags exactly the
+        payload that poisons the server, not just the boundary batch)."""
+        if not self._health_enabled:
+            return
+        import math
+        try:
+            lval = float(loss)
+        except (TypeError, ValueError):
+            lval = float("nan")
+        try:
+            import jax.numpy as jnp
+            sq = sum(jnp.sum(jnp.square(jnp.asarray(g, jnp.float32)))
+                     for g in jax.tree_util.tree_leaves(grads_tree))
+            gval = float(jnp.sqrt(sq)) * float(grad_scale)
+        except (TypeError, ValueError):
+            gval = float("nan")
+        loss_finite = math.isfinite(lval)
+        grad_finite = math.isfinite(gval)
+        now = time.time()
+        steps = self.result.local_steps_completed
+        eps = None
+        prev = self._health_rate
+        if prev is not None and now > prev[0] and steps > prev[1]:
+            eps = (steps - prev[1]) * self.config.batch_size \
+                / (now - prev[0])
+        self._health_rate = (now, steps)
+        pipe = self._pipe
+        depth = 0 if pipe is None or pipe._done.is_set() else 1
+        with self._health_lock:
+            h = self._health
+            h["step"] = steps
+            h["epoch"] = epoch
+            # Non-finite values travel as null + a false finite flag so
+            # NaN never rides a JSON hop (telemetry/cluster.py schema).
+            h["loss"] = round(lval, 6) if loss_finite else None
+            h["loss_finite"] = loss_finite
+            h["grad_norm"] = round(gval, 6) if grad_finite else None
+            h["grad_finite"] = grad_finite
+            if eps is not None:
+                h["examples_per_s"] = round(eps, 3)
+            h["pipeline_depth"] = depth
+            h["reconnects"] = self.result.reconnects
+            h.setdefault("heartbeat_errors", 0)
 
     def _run(self) -> None:
         cfg = self.config
@@ -456,6 +566,14 @@ class PSWorker(threading.Thread):
         self.result.worker_id = worker_id
         self.result.worker_name = self.worker_name
         self._init_telemetry(worker_id)
+        # Health reports ride fetch/push/heartbeat envelopes when the
+        # server advertised the capability at registration; otherwise the
+        # note path stays disabled and costs nothing (the same degradation
+        # discipline as delta-fetch / trace-context).
+        if getattr(self.store, "supports_health_report", False) \
+                and hasattr(self.store, "health_provider"):
+            self.store.health_provider = self._health_snapshot
+            self._health_enabled = True
         if cfg.heartbeat_interval > 0:
             threading.Thread(
                 target=self._heartbeat_loop,
@@ -554,6 +672,21 @@ class PSWorker(threading.Thread):
                                 # device_get would otherwise absorb the
                                 # whole step and poison the attribution).
                                 jax.block_until_ready(grads)
+                        if self._nan_step is not None \
+                                and self.result.local_steps_completed \
+                                == self._nan_step:
+                            # Deterministic compute-fault injection
+                            # (WorkerConfig.nan_inject_step / DPS_NAN_STEP):
+                            # poison THIS batch — the health report must
+                            # flag it and the cluster monitor must alert.
+                            nan = np.float32("nan")
+                            grads = jax.tree_util.tree_map(
+                                lambda a: a * nan, grads)
+                            loss = loss * nan
+                            print(f"fault injection: NaN gradients/loss at "
+                                  f"worker={self.worker_name} local_step="
+                                  f"{self.result.local_steps_completed}",
+                                  flush=True)
                         # Span = dispatch-to-return of the compiled step.
                         # Under jax async dispatch that can undercount
                         # device time on non-boundary batches; boundary
@@ -569,6 +702,8 @@ class PSWorker(threading.Thread):
                                     lambda a, b: a + b, accum, grads)
                             accum_n += 1
                             if accum_n == k:
+                                self._note_health(loss, accum, epoch,
+                                                  grad_scale=1.0 / accum_n)
                                 params, fetched_step = \
                                     self._dispatch_push_mean(
                                         worker_id, accum, accum_n,
@@ -579,6 +714,7 @@ class PSWorker(threading.Thread):
                             # Faithful: push THIS batch's gradients; the
                             # other K-1 batches' gradients are computed
                             # and dropped (quirk 7).
+                            self._note_health(loss, grads, epoch)
                             params, fetched_step = self._dispatch_push(
                                 worker_id, grads, fetched_step, params)
                             worker_id = self.result.worker_id
@@ -589,6 +725,8 @@ class PSWorker(threading.Thread):
                 # window (which would push a >K-batch sum divided by K,
                 # against stale params).
                 if accum is not None:
+                    self._note_health(loss, accum, epoch,
+                                      grad_scale=1.0 / accum_n)
                     params, fetched_step = self._dispatch_push_mean(
                         worker_id, accum, accum_n, fetched_step, params)
                     worker_id = self.result.worker_id

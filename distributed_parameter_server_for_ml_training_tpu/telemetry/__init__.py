@@ -31,8 +31,22 @@ from .registry import (
     LATENCY_BUCKETS_S,
     MetricsRegistry,
     STALENESS_BUCKETS,
+    VALUE_BUCKETS,
     get_registry,
     register_build_info,
+)
+from .cluster import (
+    ClusterMonitor,
+    get_cluster_monitor,
+    set_cluster_monitor,
+)
+from .health import (
+    RULE_CATALOG,
+    Alert,
+    ClusterState,
+    HealthRuleEngine,
+    HealthThresholds,
+    WorkerState,
 )
 from .snapshot import SnapshotEmitter
 from .spans import now, span
@@ -55,22 +69,31 @@ from .trace import (
 )
 
 __all__ = [
+    "Alert",
     "BYTES_BUCKETS",
+    "ClusterMonitor",
+    "ClusterState",
     "Counter",
     "FlightRecorder",
     "Gauge",
+    "HealthRuleEngine",
+    "HealthThresholds",
     "Histogram",
     "LATENCY_BUCKETS_S",
     "MetricsRegistry",
+    "RULE_CATALOG",
     "STALENESS_BUCKETS",
     "SPAN_CATALOG",
     "SnapshotEmitter",
     "TraceContext",
+    "VALUE_BUCKETS",
+    "WorkerState",
     "add_shutdown_flush",
     "current_context",
     "current_wire_trace",
     "disable_tracing",
     "enable_tracing",
+    "get_cluster_monitor",
     "get_recorder",
     "get_registry",
     "install_shutdown_hooks",
@@ -78,6 +101,7 @@ __all__ = [
     "register_build_info",
     "remove_shutdown_flush",
     "render_prometheus",
+    "set_cluster_monitor",
     "span",
     "start_metrics_server",
     "trace_enabled",

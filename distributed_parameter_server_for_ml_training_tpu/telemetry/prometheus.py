@@ -85,11 +85,52 @@ class _MetricsHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):  # noqa: N802 (http.server API)
         path, _, query = self.path.partition("?")
+        status = 200
         if path == "/metrics":
             body = render_prometheus(self.registry).encode()
             ctype = "text/plain; version=0.0.4; charset=utf-8"
         elif path == "/healthz":
-            body = json.dumps({"ok": True}).encode()
+            # Readiness semantics (docs/OBSERVABILITY.md): with a cluster
+            # monitor attached, an active CRITICAL alert flips the probe
+            # to 503 with a body naming the offenders — a k8s/LB can now
+            # rotate a server whose cluster is on fire, not just one whose
+            # HTTP thread died. A broken monitor degrades to 200 (losing
+            # the readiness signal must not take down serving traffic).
+            payload: dict = {"ok": True}
+            from .cluster import get_cluster_monitor
+            monitor = get_cluster_monitor()
+            if monitor is not None:
+                try:
+                    critical = [
+                        {"rule": a["rule"], "worker": a["worker"],
+                         "message": a["message"]}
+                        for a in monitor.active_alerts()
+                        if a["severity"] == "critical"]
+                    if critical:
+                        status = 503
+                        payload = {"ok": False, "critical": critical}
+                except Exception as e:  # noqa: BLE001
+                    payload = {"ok": True, "monitor_error": repr(e)}
+            body = json.dumps(payload).encode()
+            ctype = "application/json"
+        elif path == "/cluster":
+            # Live cluster health view (docs/OBSERVABILITY.md): the
+            # ClusterMonitor's worker table + active alerts, evaluated
+            # fresh per request; `cli status` renders this payload.
+            from .cluster import get_cluster_monitor
+            monitor = get_cluster_monitor()
+            if monitor is None:
+                status = 404
+                body = json.dumps(
+                    {"error": "no cluster monitor in this process "
+                              "(serve runs one unless --no-health-monitor)"}
+                ).encode()
+            else:
+                try:
+                    body = json.dumps(monitor.cluster_view()).encode()
+                except Exception as e:  # noqa: BLE001
+                    status = 500
+                    body = json.dumps({"error": repr(e)}).encode()
             ctype = "application/json"
         elif path == "/debug/trace":
             # On-demand flight-recorder dump (docs/OBSERVABILITY.md): the
@@ -110,7 +151,7 @@ class _MetricsHandler(BaseHTTPRequestHandler):
         else:
             self.send_error(404)
             return
-        self.send_response(200)
+        self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()

@@ -53,6 +53,20 @@ BYTES_BUCKETS = (
 #: bounded region is fully resolved, then doubling to the 32-worker cap.
 STALENESS_BUCKETS = (0, 1, 2, 3, 4, 5, 8, 16, 32)
 
+#: Value-magnitude buckets (dimensionless, log scale): 1e-4 .. 1e2 at
+#: ~1-2.5-5 per decade, then decades to 1e6. The latency/byte schemes above
+#: are wrong for LOSS and GRADIENT-NORM magnitudes — a cross-entropy loss
+#: lives around 1-5, a healthy grad norm anywhere in 1e-2..1e2, and the
+#: interesting excursions (vanishing grads, explosions) are orders of
+#: magnitude in either direction. Used by the cluster health monitor's
+#: report histograms (telemetry/cluster.py); an observation past the last
+#: edge (incl. any finite overflow) lands in the +Inf bucket.
+VALUE_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+    1000.0, 10000.0, 100000.0, 1000000.0,
+)
+
 
 def _label_key(labels: dict) -> str:
     """Stable ``name{k=v,...}`` suffix; '' for an unlabelled instrument."""

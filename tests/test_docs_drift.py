@@ -9,7 +9,12 @@ names; this static check pins it to the code in BOTH directions:
   production debugging session);
 - every span name in ``telemetry.SPAN_CATALOG`` is documented, every
   span-like name the doc mentions exists in the catalog, and every
-  ``trace_span(...)`` call site in the package uses a catalog name.
+  ``trace_span(...)`` call site in the package uses a catalog name;
+- every health rule in ``telemetry.health.RULE_CATALOG`` appears in the
+  doc's rule table WITH its severity, and every rule row the doc carries
+  exists in the catalog (ISSUE 5 satellite: rule names drive alerting,
+  ``dps_alerts_total`` labels, and status rendering — a silently renamed
+  rule would strand every consumer).
 
 Pure text analysis — no training, no jax beyond the package import.
 """
@@ -20,7 +25,7 @@ import re
 from pathlib import Path
 
 from distributed_parameter_server_for_ml_training_tpu.telemetry import (
-    SPAN_CATALOG)
+    RULE_CATALOG, SPAN_CATALOG)
 
 REPO = Path(__file__).resolve().parents[1]
 PKG = REPO / "distributed_parameter_server_for_ml_training_tpu"
@@ -88,6 +93,31 @@ def test_every_trace_span_call_site_uses_a_catalog_name():
     assert not offenders, (
         f"trace_span() call sites with names missing from SPAN_CATALOG "
         f"(add them there AND to docs/OBSERVABILITY.md): {offenders}")
+
+
+#: A rule-table row: ``| `rule_name` | severity | ...``. Metric-table rows
+#: have a kind (counter/gauge/histogram) in column 2, so they can't match.
+_DOC_RULE_RE = re.compile(
+    r"\|\s*`([a-z_]+)`\s*\|\s*(critical|warning|info)\s*\|")
+
+
+def test_every_health_rule_is_documented_with_severity_and_vice_versa():
+    doc_rows = dict(_DOC_RULE_RE.findall(OBS_DOC.read_text()))
+    catalog = {rule: sev for rule, (sev, _) in RULE_CATALOG.items()}
+    assert doc_rows, "no rule-table rows found — table format rotted?"
+    missing_from_doc = sorted(set(catalog) - set(doc_rows))
+    unknown_in_doc = sorted(set(doc_rows) - set(catalog))
+    assert not missing_from_doc, (
+        f"RULE_CATALOG rules absent from docs/OBSERVABILITY.md's rule "
+        f"table: {missing_from_doc}")
+    assert not unknown_in_doc, (
+        f"docs/OBSERVABILITY.md documents rules not in RULE_CATALOG "
+        f"(renamed or removed?): {unknown_in_doc}")
+    mismatched = sorted(r for r in catalog
+                        if doc_rows[r] != catalog[r])
+    assert not mismatched, (
+        f"rule severities disagree between code and doc: "
+        f"{[(r, catalog[r], doc_rows[r]) for r in mismatched]}")
 
 
 def test_catalog_names_are_namespaced_and_lowercase():
