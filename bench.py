@@ -265,11 +265,25 @@ def run_bench(args) -> dict:
 
         images_per_sec = args.scan_steps * args.batch_size / best_dt
         per_chip = images_per_sec / n_chips
+        # Wire attribution (ISSUE 6 satellite): which gradient codec this
+        # number was measured under, and the bytes the gradient exchange
+        # moves per step — 2·(N-1)/N·payload for the ring all-reduce, 0 on
+        # a single chip (no link crossed) — so BENCH_r* rounds can
+        # attribute wire wins instead of conflating codec and kernel
+        # changes.
+        n_params = sum(int(np.prod(l.shape)) for l in
+                       jax.tree_util.tree_leaves(state.params))
+        grad_codec = "bf16" if n_chips > 1 else "none"
+        el_bytes = {"none": 4, "bf16": 2, "fp16": 2, "int8": 1}[grad_codec]
+        ring_bytes = (2 * (n_chips - 1) / n_chips * n_params * el_bytes
+                      if n_chips > 1 else 0)
         result = {
             "metric": "cifar100_resnet18_train_images_per_sec_per_chip",
             "value": round(per_chip, 1),
             "unit": "images/sec/chip",
             "vs_baseline": round(per_chip / REFERENCE_IMAGES_PER_SEC, 2),
+            "push_codec": grad_codec,
+            "push_bytes_per_step": int(ring_bytes),
         }
         if fallback is not None:
             # A fallback number must never be mistaken for a chip number:

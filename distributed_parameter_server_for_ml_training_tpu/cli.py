@@ -228,13 +228,23 @@ def build_parser() -> argparse.ArgumentParser:
                         "remote workers reshard at epoch boundaries")
     s.add_argument("--worker-timeout", type=float, default=None)
     s.add_argument("--push-codec",
-                   choices=["default", "fp16", "int8", "none"],
+                   choices=["default", "fp16", "int8", "int4", "topk",
+                            "adaptive", "none"],
                    default="default",
                    help="wire codec workers apply before push: 'default' "
                         "= backend's choice (fp16 for python/native, none "
                         "for device); int8 (python + native backends) "
-                        "halves fp16's bytes again; explicit values "
-                        "override")
+                        "halves fp16's bytes again; int4 (packed nibbles, "
+                        "~8x under fp32), topk (sparse triples), and "
+                        "adaptive (per-layer int8/int4/topk from link "
+                        "pressure) are python-backend codecs paired with "
+                        "worker-side error feedback "
+                        "(docs/WIRE_PROTOCOL.md)")
+    s.add_argument("--no-compressed-domain", action="store_true",
+                   help="decode every quantized push to fp32 before "
+                        "aggregating (the legacy path) instead of "
+                        "accumulating in the quantized domain and "
+                        "dequantizing once per round")
     s.add_argument("--fetch-codec", choices=["none", "bf16", "fp16"],
                    default="none",
                    help="wire codec for FETCHED parameters (default none = "
@@ -331,6 +341,14 @@ def build_parser() -> argparse.ArgumentParser:
     w.add_argument("--no-delta-fetch", action="store_true",
                    help="disable version-gated delta fetches (full params "
                         "on every fetch, reference parity)")
+    w.add_argument("--no-error-feedback", action="store_true",
+                   help="disable the error-feedback residual carry the "
+                        "quantized push codecs (int8/int4/topk/adaptive) "
+                        "use by default (docs/WIRE_PROTOCOL.md)")
+    w.add_argument("--topk-frac", type=float,
+                   default=_env("DPS_TOPK_FRAC", 0.01, float),
+                   help="fraction of entries a topk push keeps per tensor "
+                        "(largest magnitude)")
     w.add_argument("--reconnect-timeout", type=float,
                    default=_env("DPS_RECONNECT_TIMEOUT", 0.0, float),
                    help="session resume window in seconds "
@@ -573,6 +591,12 @@ def _cmd_serve(args) -> int:
     from .utils.metrics import emit_metrics_json
     from .utils.pytree import flatten_params
 
+    if args.push_codec in ("int4", "topk", "adaptive") \
+            and args.store_backend != "python":
+        raise SystemExit(
+            f"--push-codec {args.push_codec} needs --store-backend python "
+            f"(the {args.store_backend} backend speaks none|fp16|int8)")
+
     model = get_model(args.model, num_classes=args.num_classes,
                       image_size=args.image_size)
     size = args.image_size
@@ -588,7 +612,9 @@ def _cmd_serve(args) -> int:
                     worker_timeout=args.worker_timeout,
                     push_codec=(None if args.push_codec == "default"
                                 else args.push_codec),
-                    fetch_codec=args.fetch_codec))
+                    fetch_codec=args.fetch_codec,
+                    compressed_domain=not getattr(
+                        args, "no_compressed_domain", False)))
     monitor = None
     if not getattr(args, "no_health_monitor", False):
         # Cluster health monitor (docs/OBSERVABILITY.md): aggregates the
@@ -726,7 +752,9 @@ def _cmd_worker(args) -> int:
                        heartbeat_interval=args.heartbeat,
                        overlap=args.overlap,
                        delta_fetch=not args.no_delta_fetch,
-                       reconnect_timeout=args.reconnect_timeout)
+                       reconnect_timeout=args.reconnect_timeout,
+                       error_feedback=not args.no_error_feedback,
+                       topk_frac=args.topk_frac)
     worker = PSWorker(store, model, dataset, cfg,
                       worker_name=args.worker_name)
     with _profiler_session(getattr(args, "profile_dir", None)):
@@ -754,7 +782,7 @@ def _render_status(view: dict) -> str:
               f"info={totals.get('info', 0)}")
     cols = [("worker", 7), ("alive", 6), ("step", 8), ("epoch", 6),
             ("loss", 10), ("grad_norm", 11), ("ex/s", 9), ("pipe", 5),
-            ("reconn", 7), ("hb_err", 7), ("age_s", 7)]
+            ("codec", 19), ("reconn", 7), ("hb_err", 7), ("age_s", 7)]
     lines = [header, "-" * len(header),
              "".join(f"{name:>{w}}" for name, w in cols)]
 
@@ -786,6 +814,7 @@ def _render_status(view: dict) -> str:
             cell(row.get("examples_per_s"), 9,
                  lambda v: f"{v:.1f}"),
             cell(row.get("pipeline_depth"), 5),
+            cell(row.get("push_codec"), 19),
             cell(row.get("reconnects"), 7),
             cell(row.get("heartbeat_errors"), 7),
             cell(age, 7, lambda v: f"{v:.1f}"),

@@ -131,6 +131,17 @@ class RemoteStore:
         #: True once the server advertises the health-report capability at
         #: registration (it runs a cluster monitor; docs/OBSERVABILITY.md).
         self.supports_health_report = False
+        #: True once the server advertises compressed-domain aggregation
+        #: (docs/WIRE_PROTOCOL.md): it accepts quantized payloads
+        #: (int8/int4/topk) without decoding and publishes per-layer
+        #: gradient scales. Same gating discipline as delta_fetch.
+        self.supports_compressed_domain = False
+        #: Server-published per-layer gradient ABSMAX table + version,
+        #: cached from the registration reply and refreshed off fetch
+        #: reply meta (the client sends its version as ``have_qscales``;
+        #: the server attaches the table only when newer).
+        self._qscales: dict[str, float] = {}
+        self._qscale_step = 0
         #: Zero-arg callable returning the worker's current health report
         #: (a small JSON-able dict) or None. PSWorker installs its own
         #: snapshot builder here after registration; when set AND the
@@ -303,6 +314,24 @@ class RemoteStore:
         if m is not None:
             self._membership = [int(w) for w in m]
 
+    def _note_qscales(self, reply_meta: dict) -> None:
+        """Adopt a piggybacked shared-scale table (register/fetch reply
+        meta). A malformed table degrades to the cached one — scales are
+        an optimization hint, never worth failing an RPC over."""
+        qs = reply_meta.get("qscales")
+        if not isinstance(qs, dict):
+            return
+        try:
+            self._qscales = {str(k): float(v) for k, v in qs.items()}
+            self._qscale_step = int(reply_meta.get("qscale_step", 0))
+        except (TypeError, ValueError):
+            pass
+
+    def gradient_scales(self) -> tuple[dict[str, float], int]:
+        """Client-side cache of the server's per-layer gradient absmax
+        table (PSWorker quantizes against it; docs/WIRE_PROTOCOL.md)."""
+        return dict(self._qscales), self._qscale_step
+
     def membership_snapshot(self) -> list[int]:
         """Client-side view of the server's live membership (sorted ids),
         as of the most recent Register/Fetch reply. Empty until the first
@@ -343,6 +372,16 @@ class RemoteStore:
                     reply.get("trace_context", False))
                 self.supports_health_report = bool(
                     reply.get("health_report", False))
+                self.supports_compressed_domain = bool(
+                    reply.get("compressed_domain", False))
+                # Registration is the negotiation point: drop any cached
+                # table before adopting the reply's. A crash-RESTORED
+                # server restarts its scale versions from 0 — a stale
+                # higher version kept across session resume would make
+                # have_qscales suppress every refresh until the new
+                # server's version caught up.
+                self._qscales, self._qscale_step = {}, 0
+                self._note_qscales(reply)
                 self.config.elastic = bool(reply.get("elastic", False))
                 self.config.mode = reply.get("mode", "sync")
                 self.config.learning_rate = float(
@@ -394,6 +433,10 @@ class RemoteStore:
             self._attach_health(meta)
         if have_step is not None and self.supports_delta_fetch:
             meta["have_step"] = int(have_step)
+        if self.supports_compressed_domain:
+            # Scale-table delta handshake: the server attaches qscales to
+            # the reply only when its version is newer than this.
+            meta["have_qscales"] = self._qscale_step
         if self.supports_trace_context:
             # A fetch request carries no tensor frame, so the trace
             # context rides the envelope meta (docs/WIRE_PROTOCOL.md);
@@ -404,6 +447,7 @@ class RemoteStore:
         reply = self._invoke("FetchParameters", pack_msg(meta))
         rmeta, payload = unpack_msg(reply)
         self._note_membership(rmeta)
+        self._note_qscales(rmeta)
         if rmeta.get("not_modified"):
             self._tm_fetch_nm.inc()
             return {}, int(rmeta["global_step"])

@@ -161,6 +161,24 @@ class ParameterService:
             return {}
         return {"active_workers": self.store.membership_snapshot()}
 
+    def _qscale_fields(self, have_step: int | None = None) -> dict:
+        """Shared-scale table fields for a reply (docs/WIRE_PROTOCOL.md):
+        the store's per-layer gradient absmax table + version, attached
+        when the store publishes one AND the client's known version
+        (``have_qscales``) is older. Stores without the capability (native
+        arena, device) contribute nothing."""
+        fn = getattr(self.store, "gradient_scales", None)
+        if not callable(fn):
+            return {}
+        try:
+            have = None if have_step is None else int(have_step)
+        except (TypeError, ValueError):
+            have = None  # garbled version: resend the table, never fail
+        scales, step = fn()
+        if not scales or (have is not None and have >= step):
+            return {}
+        return {"qscales": scales, "qscale_step": step}
+
     def register_worker(self, request: bytes, ctx) -> bytes:
         meta, _ = unpack_msg(request)
         worker_id, total = self.store.register_worker(
@@ -201,6 +219,15 @@ class ParameterService:
             # the monitor actually existing so legacy peers (and monitor-
             # less servers) degrade to report-less heartbeats.
             "health_report": self.monitor is not None,
+            # Compressed-domain capability (docs/WIRE_PROTOCOL.md): this
+            # store aggregates quantized pushes without decoding and
+            # publishes per-layer gradient scales (negotiated here,
+            # refreshed via fetch replies). Same gating discipline as
+            # delta_fetch — legacy clients ignore the field and keep
+            # pushing fp16/int8 with their own scales.
+            "compressed_domain": bool(getattr(
+                self.store, "supports_compressed_domain", False)),
+            **self._qscale_fields(),
             **self._membership_fields(),
         })
 
@@ -352,6 +379,12 @@ class ParameterService:
         # still refreshes the cluster monitor's view of this worker.
         self._ingest_health(wid, meta)
         have = meta.get("have_step")
+        # Scale-table refresh rides the same reply (delta-gated on the
+        # client's known version): new rounds move both the params and
+        # the shared scales, so one fetch refreshes both. Legacy clients
+        # never send have_qscales and never pay for a table they ignore.
+        qfields = self._qscale_fields(meta["have_qscales"]) \
+            if "have_qscales" in meta else {}
         if have is not None \
                 and getattr(self.store, "supports_delta_fetch", False):
             params, step = self.store.fetch(wid, have_step=int(have))
@@ -361,10 +394,11 @@ class ParameterService:
                 # header instead of the full model (the straggler-wait /
                 # polling fetch win; docs/WIRE_PROTOCOL.md).
                 return pack_msg({"global_step": step, "not_modified": True,
-                                 **self._membership_fields()})
+                                 **qfields, **self._membership_fields()})
         else:
             params, step = self.store.fetch(wid)
-        return pack_msg({"global_step": step, **self._membership_fields()},
+        return pack_msg({"global_step": step, **qfields,
+                         **self._membership_fields()},
                         encode_tensor_dict(params))
 
     def job_finished(self, request: bytes, ctx) -> bytes:

@@ -54,6 +54,8 @@ from typing import Callable, Mapping
 import ml_dtypes  # ships with jax; provides the numpy bfloat16 dtype
 import numpy as np
 
+from ..ops.packed import PackedInt4, as_packed_int4, packed_int4_nbytes
+
 #: First byte of every v2+ frame. v1 frames start with the low byte of
 #: their u32 header length instead; decode disambiguates by checking that
 #: a v1 header begins with '{' at offset 4.
@@ -73,6 +75,12 @@ _ALLOWED_DTYPES = {
     "float16", "float32", "float64", "bfloat16",
     "int8", "int16", "int32", "int64",
     "uint8", "uint16", "uint32", "uint64", "bool",
+    # Packed-nibble wire dtype (two signed 4-bit values per byte): the
+    # header shape is the LOGICAL element shape, the buffer holds
+    # ceil(n/2) bytes. numpy has no packed int4, so these tensors travel
+    # as ops/packed.py's PackedInt4 (a uint8 array remembering its logical
+    # shape); the quantization math lives in ops/compression.py.
+    "int4",
 }
 
 # -- copy accounting (tier-1 zero-copy guard) --------------------------------
@@ -110,6 +118,16 @@ def _prepare(tensors: Mapping[str, np.ndarray]) -> tuple[list, list]:
     """Validate + normalize to (metas, contiguous arrays)."""
     metas, arrays = [], []
     for name, arr in tensors.items():
+        if isinstance(arr, PackedInt4):
+            # Wire dtype "int4": header shape is the LOGICAL shape, buffer
+            # is the packed nibbles (as_packed_int4 validated the length).
+            a = arr if arr.flags.c_contiguous else np.ascontiguousarray(arr)
+            if a is not arr:
+                _note_copy(str(name), "make_contiguous")
+            metas.append({"name": str(name), "dtype": "int4",
+                          "shape": list(arr.logical_shape)})
+            arrays.append(np.asarray(a, np.uint8))
+            continue
         a = np.asarray(arr)
         if not a.flags.c_contiguous:
             a = np.ascontiguousarray(a)
@@ -247,16 +265,23 @@ def _parse_frame(payload) -> tuple[dict, memoryview, int]:
     return header, mv[header_end:], flags
 
 
-def _tensor_extent(meta: dict) -> tuple[np.dtype, tuple, int]:
-    """Validated (dtype, shape, nbytes) from one header entry. Rejects
-    NaN/float/negative/bool dims and unknown dtypes before any allocation;
-    the size product is computed in unbounded Python ints, so it cannot
-    overflow into a small bogus value."""
+def _tensor_extent(meta: dict) -> tuple[np.dtype, tuple, int, bool]:
+    """Validated (dtype, shape, nbytes, packed) from one header entry.
+    Rejects NaN/float/negative/bool dims and unknown dtypes before any
+    allocation; the size product is computed in unbounded Python ints, so
+    it cannot overflow into a small bogus value. ``packed`` marks the
+    "int4" wire dtype: ``shape`` is the LOGICAL shape, the buffer holds
+    ``ceil(prod(shape)/2)`` uint8s of packed nibbles."""
     dtype = meta.get("dtype")
     if dtype not in _ALLOWED_DTYPES:
         raise ValueError(f"unsupported dtype {dtype}")
-    dt = np.dtype(ml_dtypes.bfloat16) if dtype == "bfloat16" \
-        else np.dtype(dtype)
+    packed = dtype == "int4"
+    if packed:
+        dt = np.dtype(np.uint8)
+    elif dtype == "bfloat16":
+        dt = np.dtype(ml_dtypes.bfloat16)
+    else:
+        dt = np.dtype(dtype)
     raw_shape = meta.get("shape", [])
     if not isinstance(raw_shape, list):
         raise ValueError(f"bad shape {raw_shape!r} for {meta.get('name')!r}")
@@ -265,7 +290,9 @@ def _tensor_extent(meta: dict) -> tuple[np.dtype, tuple, int]:
             raise ValueError(
                 f"bad shape dim {s!r} for {meta.get('name')!r}")
     shape = tuple(raw_shape)
-    return dt, shape, dt.itemsize * math.prod(shape)
+    nbytes = packed_int4_nbytes(shape) if packed \
+        else dt.itemsize * math.prod(shape)
+    return dt, shape, nbytes, packed
 
 
 def _tensors_from_body(header: dict, body: memoryview,
@@ -276,12 +303,17 @@ def _tensors_from_body(header: dict, body: memoryview,
     out: dict[str, np.ndarray] = {}
     offset = 0
     for meta in metas:
-        dt, shape, nbytes = _tensor_extent(meta)
+        dt, shape, nbytes, packed = _tensor_extent(meta)
         end = offset + nbytes
         if end > len(body):
             raise ValueError(f"truncated buffer for {meta.get('name')!r}")
-        arr = np.frombuffer(body[offset:end], dtype=dt).reshape(shape)
-        out[str(meta.get("name"))] = arr.copy() if copy else arr
+        arr = np.frombuffer(body[offset:end], dtype=dt)
+        if packed:
+            out[str(meta.get("name"))] = as_packed_int4(
+                arr.copy() if copy else arr, shape)
+        else:
+            arr = arr.reshape(shape)
+            out[str(meta.get("name"))] = arr.copy() if copy else arr
         offset = end
     return out
 
@@ -368,7 +400,7 @@ def decode_tensor_dict_chunks(frames, *, copy: bool = False
     pos = 0
     seg_i = 0
     for meta in metas:
-        dt, shape, nbytes = _tensor_extent(meta)
+        dt, shape, nbytes, packed = _tensor_extent(meta)
         end = pos + nbytes
         if end > offset:
             raise ValueError(f"truncated buffer for {meta.get('name')!r}")
@@ -378,8 +410,12 @@ def decode_tensor_dict_chunks(frames, *, copy: bool = False
         seg_start, seg_body = segments[seg_i]
         if end <= seg_start + len(seg_body) or nbytes == 0:
             raw = seg_body[pos - seg_start:end - seg_start]
-            arr = np.frombuffer(raw, dtype=dt).reshape(shape)
-            out[str(meta.get("name"))] = arr.copy() if copy else arr
+            arr = np.frombuffer(raw, dtype=dt)
+            if packed:
+                arr = as_packed_int4(arr.copy() if copy else arr, shape)
+            else:
+                arr = arr.reshape(shape)
+                arr = arr.copy() if copy else arr
         else:  # spans chunks: stitch (the only copying reassembly path)
             buf = bytearray(nbytes)
             filled = 0
@@ -391,7 +427,9 @@ def decode_tensor_dict_chunks(frames, *, copy: bool = False
                 buf[filled:filled + take] = s_body[lo:lo + take]
                 filled += take
                 j += 1
-            out[str(meta.get("name"))] = np.frombuffer(
-                bytes(buf), dtype=dt).reshape(shape)
+            arr = np.frombuffer(bytes(buf), dtype=dt)
+            arr = as_packed_int4(arr, shape) if packed \
+                else arr.reshape(shape)
+        out[str(meta.get("name"))] = arr
         pos = end
     return out

@@ -36,7 +36,17 @@ from typing import Mapping
 
 import numpy as np
 
-from ..ops.compression import fp16_compress, fp16_decompress
+from ..ops.compression import (  # hot-path imports hoisted: no import-lock
+    PUSH_CODECS,                 # checks inside push/fetch
+    QUANTIZED_PUSH_CODECS,
+    bf16_compress,
+    fp16_compress,
+    fp16_decompress,
+    homomorphic_mean,
+    is_quantized_payload,
+    payload_logical_shapes,
+    wire_decompress,
+)
 from ..telemetry import now as _tnow, trace_span
 from .semantics import (
     DEFAULT_STALENESS_BOUND,
@@ -54,14 +64,25 @@ class StoreConfig:
     total_workers: int = 4
     learning_rate: float = 0.1  # server.py:84, 413
     staleness_bound: int = DEFAULT_STALENESS_BOUND
-    # 'none' | 'fp16' | 'int8' | None = backend default ('fp16' for the
-    # wire-crossing python/native stores, matching the reference's
-    # worker-side cast (worker.py:264-268); 'none' for the device store,
-    # which crosses no wire). 'int8' (per-tensor symmetric quantization,
-    # ~half fp16's bytes) decodes on the python store (host numpy) and the
-    # native store (fused C++ dequant+apply). Stores resolve the sentinel
+    # 'none' | 'fp16' | 'int8' | 'int4' | 'topk' | 'adaptive' | None =
+    # backend default ('fp16' for the wire-crossing python/native stores,
+    # matching the reference's worker-side cast (worker.py:264-268);
+    # 'none' for the device store, which crosses no wire). 'int8'
+    # (per-tensor symmetric quantization, ~half fp16's bytes) decodes on
+    # the python store (host numpy) and the native store (fused C++
+    # dequant+apply). 'int4' (packed nibbles, ~1/8 fp32), 'topk' (sparse
+    # triples), and 'adaptive' (worker picks int8/int4/topk per layer from
+    # link pressure) are python-store codecs; workers pair them with
+    # error feedback (docs/WIRE_PROTOCOL.md). Stores resolve the sentinel
     # at construction.
     push_codec: str | None = None
+    # Compressed-domain sync aggregation (THC-style, PAPERS.md): quantized
+    # pushes are held as-is and summed in per-layer int32 accumulators,
+    # dequantized ONCE per round at apply time — the per-push fp32 decode
+    # disappears. False restores decode-per-push (the A/B control in
+    # experiments/run_compression_matrix.py); numerics agree to float
+    # rounding either way.
+    compressed_domain: bool = True
     # Fetch-side wire codec. 'none' (default) = reference parity: fetches
     # are fp32, reproducing its dominant server cost (the ~45 MB re-pickle
     # per fetch, server.py:222). 'bf16'/'fp16' opt in to halving the
@@ -235,6 +256,11 @@ class TelemetryMixin:
         self._tm_step = reg.gauge("dps_store_global_step", backend=b)
         self._tm_rounds = reg.counter("dps_store_sync_rounds_total",
                                       backend=b)
+        # Pushes held in the quantized domain (no per-push fp32 decode;
+        # summed in int32 accumulators at round completion) — the
+        # compressed-domain aggregation fast path, live.
+        self._tm_compressed = reg.counter(
+            "dps_store_compressed_accum_total", backend=b)
 
 
 class AggregationBase(TelemetryMixin, MembershipMixin):
@@ -480,13 +506,21 @@ class ParameterStore(AggregationBase):
         self._push_codec = (self.config.push_codec
                             if self.config.push_codec is not None
                             else "fp16")  # reference default
-        if self._push_codec not in ("none", "fp16", "int8"):
-            raise ValueError(f"push_codec must be none|fp16|int8, got "
-                             f"{self._push_codec!r}")
+        if self._push_codec not in PUSH_CODECS:
+            raise ValueError(
+                f"push_codec must be one of {'|'.join(PUSH_CODECS)}, "
+                f"got {self._push_codec!r}")
         self.parameters: dict[str, np.ndarray] = {
             k: np.array(v, np.float32) for k, v in initial_params.items()
         }
         self.global_step = 0
+        # Per-layer gradient ABSMAX estimates — the shared quantization
+        # basis workers fetch (negotiated at registration, refreshed via
+        # the fetch path) so a round's int8/int4 pushes land in ONE
+        # accumulator group. Guarded by _param_lock; _qscale_step bumps on
+        # every refresh so clients can cheap-check for changes.
+        self._qscales: dict[str, float] = {}
+        self._qscale_step = 0
 
         self._param_lock = threading.Lock()
         self._sync_lock = threading.Lock()
@@ -518,6 +552,44 @@ class ParameterStore(AggregationBase):
     # -- lifecycle (register/finish/expire inherited) ----------------- ps.proto:8
 
     supports_delta_fetch = True
+
+    #: This store can aggregate quantized pushes without decoding them
+    #: (docs/WIRE_PROTOCOL.md) and publishes per-layer gradient scales.
+    #: The gRPC service advertises it at registration, same gating
+    #: discipline as delta-fetch; the native/device backends leave it off.
+    supports_compressed_domain = True
+
+    def gradient_scales(self) -> tuple[dict[str, float], int]:
+        """The server's per-layer gradient ABSMAX table + its version.
+        Workers quantize against these (int8 scale = absmax/127, int4 =
+        absmax/7) so a sync round's pushes share one scale group. Empty
+        until the first round refreshes it — workers fall back to
+        per-push scales, which the aggregation handles as extra groups."""
+        with self._param_lock:
+            return dict(self._qscales), self._qscale_step
+
+    def _refresh_qscales_locked(self, grads: Mapping[str, np.ndarray]
+                                ) -> None:
+        """Update the shared-scale table from an applied aggregate
+        (caller holds ``_param_lock``). EMA toward 2x the aggregate's
+        absmax — individual workers' gradients run hotter than the round
+        mean, and error feedback absorbs what still clips."""
+        if self._push_codec not in QUANTIZED_PUSH_CODECS:
+            return
+        changed = False
+        for name, g in grads.items():
+            g = np.asarray(g)
+            m = float(np.max(np.abs(g))) if g.size else 0.0
+            if not np.isfinite(m) or m <= 0.0:
+                continue
+            target = 2.0 * m
+            old = self._qscales.get(name)
+            new = target if old is None else 0.5 * old + 0.5 * target
+            if old is None or abs(new - old) > 1e-12:
+                self._qscales[name] = new
+                changed = True
+        if changed:
+            self._qscale_step += 1
 
     def fetch(self, worker_id: int | None = None,
               have_step: int | None = None
@@ -556,7 +628,6 @@ class ParameterStore(AggregationBase):
             if self.config.fetch_codec == "fp16":
                 payload = fp16_compress(payload)
             elif self.config.fetch_codec == "bf16":
-                from ..ops.compression import bf16_compress
                 payload = bf16_compress(payload)
             self._tm_fetch_s.observe(_tnow() - t0)
             self._tm_fetches.inc()
@@ -586,29 +657,58 @@ class ParameterStore(AggregationBase):
     def _push_timed(self, worker_id: int,
                     gradients: Mapping[str, np.ndarray],
                     fetched_step: int) -> bool:
-        if self._push_codec == "fp16":
-            gradients = fp16_decompress(gradients)
-        elif self._push_codec == "int8":
-            from ..ops.compression import int8_wire_decompress
-            gradients = int8_wire_decompress(dict(gradients))
-        else:
-            gradients = {k: np.asarray(v, np.float32)
-                         for k, v in gradients.items()}
+        gradients = dict(gradients)
+        quantized = is_quantized_payload(gradients)
+        # Compressed-domain fast path (sync only): hold the quantized
+        # payload AS-IS — no per-push fp32 decode; the round completion
+        # sums int8/int4 entries in int32 accumulators and dequantizes
+        # once (homomorphic_mean). Async, legacy codecs, and
+        # compressed_domain=False decode here as before; async applies
+        # dequantize the single incoming payload with its carried scale.
+        keep_quantized = (quantized and self.config.mode == "sync"
+                          and self.config.compressed_domain)
         self.last_seen[worker_id] = time.time()
 
-        # Reject shape-mismatched pushes up front (e.g. a worker built with a
-        # different head size / image size than the server): the reference
+        # Reject malformed/mismatched pushes up front (e.g. a worker
+        # built with a different head size than the server, a missing
+        # scale companion, an out-of-range sparse index): the reference
         # would crash mid-apply on the broadcast; here the bad push is
-        # refused and the round state stays clean.
-        for name, g in gradients.items():
+        # refused and the round state stays clean. Quantized payloads are
+        # checked on their LOGICAL shapes — carried in the wire headers,
+        # no decode needed — and the sparse/scale validation runs at THIS
+        # push, never deferred into the round completion where it would
+        # fail a different worker's RPC.
+        try:
+            if keep_quantized:
+                shapes = payload_logical_shapes(gradients)
+            else:
+                if quantized:
+                    gradients = wire_decompress(gradients)
+                elif self._push_codec == "fp16":
+                    gradients = fp16_decompress(gradients)
+                else:
+                    gradients = {k: np.asarray(v, np.float32)
+                                 for k, v in gradients.items()}
+                shapes = {k: g.shape for k, g in gradients.items()}
+        except ValueError as e:
+            self.stats.gradients_rejected += 1
+            self._tm_push_rej.inc()
+            print(f"rejecting push from worker {worker_id}: {e}")
+            return False
+        for name, shape in shapes.items():
             p = self.parameters.get(name)
-            if p is not None and p.shape != g.shape:
+            if p is not None and p.shape != tuple(shape):
                 self.stats.gradients_rejected += 1
                 self._tm_push_rej.inc()
                 print(f"rejecting push from worker {worker_id}: {name} "
-                      f"shape {g.shape} != server {p.shape} (model/dataset "
-                      f"mismatch?)")
+                      f"shape {tuple(shape)} != server {p.shape} "
+                      f"(model/dataset mismatch?)")
                 return False
+        if keep_quantized:
+            # Counted only once the push is actually ACCEPTED into the
+            # quantized-domain round (the metric claims int32-accumulated
+            # pushes; a rejected payload never was).
+            self._tm_compressed.inc()
 
         if self.config.mode == "sync":
             self._push_sync(worker_id, gradients)
@@ -619,6 +719,22 @@ class ParameterStore(AggregationBase):
 
     def _mean(self, grad_dicts: list) -> dict:
         return mean_gradients(grad_dicts)
+
+    def _round_update(self, grad_dicts: list, lr: float) -> None:
+        """Sync-round update, compressed-domain aware: quantized payloads
+        aggregate via :func:`homomorphic_mean` (int32 accumulate, one
+        dequantize per layer per round); all-dense rounds keep the
+        reference's :func:`mean_gradients` path. Either way the applied
+        aggregate refreshes the shared scale table under the param lock,
+        so the next fetches publish fresh scales."""
+        if any(is_quantized_payload(g) for g in grad_dicts):
+            mean = homomorphic_mean(grad_dicts)
+        else:
+            mean = self._mean(grad_dicts)
+        with self._param_lock:
+            self._apply(mean, lr)
+            self.global_step += 1
+            self._refresh_qscales_locked(mean)
 
     def _apply(self, grads: dict, lr: float, weight: float = 1.0) -> None:
         sgd_apply(self.parameters, grads, lr, weight=weight)

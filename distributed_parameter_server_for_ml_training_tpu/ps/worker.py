@@ -24,6 +24,13 @@ import jax
 import numpy as np
 
 from ..data.cifar import Dataset, make_batches, shard_range
+from ..ops.compression import (  # hot-path imports hoisted, like ps/store
+    QUANTIZED_PUSH_CODECS,
+    ErrorFeedback,
+    compress_push,
+    fp16_compress,
+    fp16_decompress,
+)
 from ..telemetry import (
     current_wire_trace,
     now as _tnow,
@@ -84,6 +91,15 @@ class WorkerConfig:
     # DPS_NAN_STEP provides the same hook to subprocess workers. None
     # disables (production default).
     nan_inject_step: int | None = None
+    # Error feedback for the quantized push codecs (int8/int4/topk/
+    # adaptive; docs/WIRE_PROTOCOL.md): the quantization residual of each
+    # push is carried into the next step's gradient, so compressed updates
+    # sum to the true gradient over time — what makes int4 and top-k
+    # accuracy-safe. No effect on the none/fp16 codecs.
+    error_feedback: bool = True
+    # Fraction of entries a 'topk' push keeps per tensor (largest
+    # magnitude; int8-quantized values + int32 indices on the wire).
+    topk_frac: float = 0.01
 
     def __post_init__(self):
         if self.k_step_mode not in ("faithful", "accumulate"):
@@ -147,6 +163,76 @@ def _window_mean(accum_tree, n: int):
     cannot drift apart."""
     scale = np.float32(n)
     return jax.tree_util.tree_map(lambda a: a / scale, accum_tree)
+
+
+class _BitwidthController:
+    """Per-layer push-codec chooser for the quantized codec family.
+
+    Fixed codecs (``int8``/``int4``/``topk``) pin the aggressiveness
+    level; ``adaptive`` moves the level with measured LINK PRESSURE — the
+    fraction of wall time the push spends on the wire (push RPC seconds
+    over the window since the previous push, the same signal the
+    ``worker.push_wait`` span and pipeline telemetry already expose).
+    Sustained pressure above ``hi`` escalates int8 -> int4 -> +topk;
+    sustained pressure below ``lo`` de-escalates. ``patience``
+    consecutive windows are required either way, so one slow RPC doesn't
+    whipsaw the codec.
+
+    The plan is per-layer: tiny tensors (biases, norms) stay int8 at any
+    level — their bytes are noise and sparse/packed overhead would exceed
+    the savings; topk only applies above ``min_topk_size``.
+    """
+
+    LEVEL_NAMES = ("int8", "int4", "topk")
+
+    def __init__(self, codec: str, hi: float = 0.25, lo: float = 0.05,
+                 patience: int = 2, min_int4_size: int = 256,
+                 min_topk_size: int = 4096):
+        self.adaptive = codec == "adaptive"
+        self.level = 0 if self.adaptive \
+            else {"int8": 0, "int4": 1, "topk": 2}.get(codec, 0)
+        self.hi, self.lo, self.patience = hi, lo, patience
+        self.min_int4_size = min_int4_size
+        self.min_topk_size = min_topk_size
+        self._hot = self._cold = 0
+
+    def note_push(self, push_seconds: float, window_seconds: float) -> None:
+        """Feed one push's timing (adaptive only): RPC seconds vs the
+        wall-clock window since the previous push completed."""
+        if not self.adaptive or window_seconds <= 0:
+            return
+        pressure = push_seconds / window_seconds
+        if pressure > self.hi:
+            self._hot += 1
+            self._cold = 0
+            if self._hot >= self.patience and self.level < 2:
+                self.level += 1
+                self._hot = 0
+        elif pressure < self.lo:
+            self._cold += 1
+            self._hot = 0
+            if self._cold >= self.patience and self.level > 0:
+                self.level -= 1
+                self._cold = 0
+        else:
+            self._hot = self._cold = 0
+
+    def plan(self, flat: dict) -> dict:
+        """{tensor name: 'int8'|'int4'|'topk'} for this push."""
+        out = {}
+        for name, a in flat.items():
+            size = int(np.asarray(a).size)
+            if self.level >= 2 and size >= self.min_topk_size:
+                out[name] = "topk"
+            elif self.level >= 1 and size >= self.min_int4_size:
+                out[name] = "int4"
+            else:
+                out[name] = "int8"
+        return out
+
+    def describe(self) -> str:
+        name = self.LEVEL_NAMES[self.level]
+        return f"adaptive({name})" if self.adaptive else name
 
 
 class _CommsPipeline:
@@ -351,6 +437,12 @@ class PSWorker(threading.Thread):
         self._health: dict = {}
         self._health_enabled = False
         self._health_rate: tuple[float, int] | None = None
+        # Quantized-codec state (set up after registration, once the
+        # store's negotiated codec is known): error-feedback residuals and
+        # the per-layer bitwidth controller (docs/WIRE_PROTOCOL.md).
+        self._ef: ErrorFeedback | None = None
+        self._bitwidth: _BitwidthController | None = None
+        self._prev_push_done: float | None = None
         ns = self.config.nan_inject_step
         if ns is None:
             import os as _os
@@ -495,6 +587,13 @@ class PSWorker(threading.Thread):
         # failing pings were previously invisible — no counter, no log).
         self._tm_hb_err = reg.counter("dps_worker_heartbeat_errors_total",
                                       worker=w)
+        # Wire bytes the push codec saved vs the fp32 payload (precodec −
+        # wire, cumulative), and the effective bits/value of the LAST push
+        # — the live bitwidth the adaptive controller settled on
+        # (32 = fp32, 8 = int8, ~4 = int4, <1 = topk).
+        self._tm_push_saved = reg.counter(
+            "dps_worker_push_bytes_saved_total", worker=w)
+        self._tm_push_bits = reg.gauge("dps_worker_push_bitwidth", worker=w)
 
     # -- worker health report (docs/OBSERVABILITY.md) ------------------------
 
@@ -558,6 +657,13 @@ class PSWorker(threading.Thread):
                 h["examples_per_s"] = round(eps, 3)
             h["pipeline_depth"] = depth
             h["reconnects"] = self.result.reconnects
+            # Negotiated push codec, live (the adaptive controller's
+            # CURRENT level, '+ef' when error feedback is on) — surfaces
+            # in /cluster and the `cli status` worker table.
+            codec = self._bitwidth.describe() if self._bitwidth \
+                else getattr(self.store, "push_codec", "none")
+            h["push_codec"] = codec + ("+ef" if self._ef is not None
+                                       else "")
             h.setdefault("heartbeat_errors", 0)
 
     def _run(self) -> None:
@@ -566,6 +672,14 @@ class PSWorker(threading.Thread):
         self.result.worker_id = worker_id
         self.result.worker_name = self.worker_name
         self._init_telemetry(worker_id)
+        # Quantized push codec (negotiated: the store advertised it at
+        # registration): error-feedback residuals + the per-layer bitwidth
+        # controller. Legacy servers advertise fp16/none and neither
+        # engages — same degradation discipline as delta-fetch.
+        codec = getattr(self.store, "push_codec", "none")
+        if codec in QUANTIZED_PUSH_CODECS:
+            self._ef = ErrorFeedback() if cfg.error_feedback else None
+            self._bitwidth = _BitwidthController(codec)
         # Health reports ride fetch/push/heartbeat envelopes when the
         # server advertised the capability at registration; otherwise the
         # note path stays disabled and costs nothing (the same degradation
@@ -1016,7 +1130,6 @@ class PSWorker(threading.Thread):
                 # In-process compressed fetch (RemoteStore already
                 # decompressed client-side — casting again would copy the
                 # full parameter set a second time per fetch for nothing).
-                from ..ops.compression import fp16_decompress
                 flat = fp16_decompress(flat)
             if not getattr(self.store, "keeps_device_arrays", False):
                 # Decoded (fp32) payload bytes; the on-the-wire size
@@ -1032,34 +1145,66 @@ class PSWorker(threading.Thread):
         """Push the mean of an accumulated gradient window of n batches."""
         self._push(worker_id, _window_mean(accum_tree, n), fetched_step)
 
+    def _gradient_scales(self) -> dict:
+        """The server-published per-layer absmax table (shared-scale
+        quantization, docs/WIRE_PROTOCOL.md): read directly off in-process
+        stores, from the registration/fetch-refreshed cache on a
+        RemoteStore. Empty ({}) degrades to per-push scales."""
+        fn = getattr(self.store, "gradient_scales", None)
+        if not callable(fn):
+            return {}
+        try:
+            scales, _ = fn()
+            return scales
+        except Exception:  # noqa: BLE001 — scales are an optimization hint
+            return {}
+
     def _push(self, worker_id, grads_tree, fetched_step) -> None:
         with trace_span("worker.codec", stage="encode"):
             if getattr(self.store, "keeps_device_arrays", False):
                 # Device-resident store: hand over the device arrays
                 # untouched — no host round-trip, no wire, no codec.
                 flat = flatten_params(grads_tree, as_numpy=False)
+                pre_bytes = 0
             else:
                 flat = flatten_params(jax.device_get(grads_tree))
                 pre_bytes = sum(int(v.nbytes) for v in flat.values())
                 # Worker-side compression (worker.py:264-268): the store/
                 # service advertises its codec; the encode happens here,
-                # once, before the wire (fp16 = the reference's cast;
-                # int8 = per-tensor symmetric quantization at ~half
-                # fp16's bytes).
+                # once, before the wire (fp16 = the reference's cast; the
+                # quantized family — int8/int4/topk/adaptive — quantizes
+                # per the bitwidth controller's per-layer plan, against
+                # the server's shared scales when published, with error
+                # feedback carrying the residual).
                 codec = getattr(self.store, "push_codec", "none")
                 if codec == "fp16":
-                    from ..ops.compression import fp16_compress
                     flat = fp16_compress(flat)
-                elif codec == "int8":
-                    from ..ops.compression import int8_wire_compress
-                    flat = int8_wire_compress(flat)
+                elif codec in QUANTIZED_PUSH_CODECS:
+                    plan = self._bitwidth.plan(flat) if self._bitwidth \
+                        else None
+                    flat = compress_push(
+                        flat, plan, scales=self._gradient_scales(),
+                        ef=self._ef, topk_frac=self.config.topk_frac)
+                wire_bytes = sum(int(v.nbytes) for v in flat.values())
                 self._tm_push_pre.inc(pre_bytes)
-                self._tm_push_wire.inc(
-                    sum(int(v.nbytes) for v in flat.values()))
+                self._tm_push_wire.inc(wire_bytes)
+                self._tm_push_saved.inc(max(0, pre_bytes - wire_bytes))
+                if pre_bytes:
+                    # Effective bits per gradient VALUE this push (fp32
+                    # payload carries pre_bytes/4 values).
+                    self._tm_push_bits.set(
+                        round(wire_bytes * 32.0 / pre_bytes, 3))
+        t0 = _tnow()
         if self.store.push(worker_id, flat, fetched_step):
             self.result.pushes_accepted += 1
         else:
             self.result.pushes_rejected += 1
+        done = _tnow()
+        if self._bitwidth is not None and self._prev_push_done is not None:
+            # Link pressure = push RPC seconds over the window since the
+            # previous push completed (adaptive codec only).
+            self._bitwidth.note_push(done - t0, done - self._prev_push_done)
+        self._prev_push_done = done
 
     def evaluate(self, params, batch_stats) -> float:
         """Full test-set top-1 (worker.py:313-331)."""

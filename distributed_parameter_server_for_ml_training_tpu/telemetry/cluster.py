@@ -67,6 +67,10 @@ REPORT_FIELDS = {
     "pipeline_depth": int,
     "reconnects": int,
     "heartbeat_errors": int,
+    # Negotiated push codec as the worker currently runs it, e.g.
+    # "int4+ef" or "adaptive(topk)+ef" (docs/WIRE_PROTOCOL.md); length-
+    # capped on ingest so a hostile peer can't balloon the view.
+    "push_codec": str,
 }
 
 
@@ -85,6 +89,10 @@ def sanitize_report(report) -> dict | None:
         try:
             if cast is bool:
                 out[name] = bool(v)
+            elif cast is str:
+                s = str(v)[:32]
+                if s:
+                    out[name] = s
             elif cast is int:
                 if isinstance(v, bool):
                     continue

@@ -814,3 +814,83 @@ class TestGrpcService:
         assert w.result.pushes_accepted == 2
         assert store.global_step == 2
         client.close()
+
+
+class TestCompressedDomainWire:
+    """Compressed-domain negotiation over gRPC (docs/WIRE_PROTOCOL.md):
+    capability + shared-scale table at registration, delta-gated scale
+    refresh on fetch, and quantized payloads riding the wire."""
+
+    def _serve(self, codec="int4", workers=1):
+        store = ParameterStore(
+            {"w": np.ones(64, np.float32)},
+            StoreConfig(mode="sync", total_workers=workers,
+                        learning_rate=0.1, push_codec=codec))
+        server, port = serve(store, port=0)
+        return store, server, port
+
+    def test_registration_advertises_capability_and_codec(self):
+        store, server, port = self._serve("adaptive")
+        try:
+            client = RemoteStore(f"localhost:{port}")
+            client.register_worker("c0")
+            assert client.supports_compressed_domain is True
+            assert client.push_codec == "adaptive"
+            assert client.gradient_scales() == ({}, 0)  # pre-first-round
+            client.close()
+        finally:
+            server.stop(grace=None)
+
+    def test_int4_push_and_scale_refresh_over_wire(self):
+        from distributed_parameter_server_for_ml_training_tpu.ops.compression import (
+            compress_push)
+        store, server, port = self._serve("int4")
+        try:
+            client = RemoteStore(f"localhost:{port}")
+            wid, _ = client.register_worker("c0")
+            g = {"w": np.full(64, 0.5, np.float32)}
+            assert client.push(
+                wid, compress_push(g, {"w": "int4"}), 0) is True
+            assert store.global_step == 1
+            # the homomorphic path engaged server-side
+            assert store._tm_compressed.value >= 1
+            params, step = client.fetch(wid)
+            np.testing.assert_allclose(params["w"], 1.0 - 0.05, atol=0.02)
+            # fetch refreshed the client's shared-scale cache
+            scales, version = client.gradient_scales()
+            assert version == 1 and scales["w"] > 0
+            # a second fetch at the same version does NOT resend the table
+            # (delta idiom) — cheap proxy: cache version is unchanged
+            client.fetch(wid, have_step=step)
+            assert client.gradient_scales()[1] == 1
+            client.close()
+        finally:
+            server.stop(grace=None)
+
+    def test_legacy_client_degrades_to_dense_push(self):
+        """A client that never learned the capability (simulating an old
+        peer) pushes dense fp32 — the server accepts it into the same
+        round as quantized pushes."""
+        from distributed_parameter_server_for_ml_training_tpu.ops.compression import (
+            compress_push)
+        store, server, port = self._serve("int4", workers=2)
+        try:
+            new = RemoteStore(f"localhost:{port}")
+            old = RemoteStore(f"localhost:{port}")
+            wid_new, _ = new.register_worker("new")
+            wid_old, _ = old.register_worker("old")
+            # Strip the negotiated state, like a peer that predates it.
+            old.supports_compressed_domain = False
+            old.push_codec = "none"
+            assert new.push(wid_new, compress_push(
+                {"w": np.full(64, 1.0, np.float32)}, {"w": "int4"}),
+                0) is True
+            assert old.push(wid_old,
+                            {"w": np.full(64, 3.0, np.float32)}, 0) is True
+            assert store.global_step == 1  # mixed round completed
+            np.testing.assert_allclose(store.parameters["w"],
+                                       1.0 - 0.1 * 2.0, atol=0.05)
+            new.close()
+            old.close()
+        finally:
+            server.stop(grace=None)

@@ -14,7 +14,11 @@ names; this static check pins it to the code in BOTH directions:
   doc's rule table WITH its severity, and every rule row the doc carries
   exists in the catalog (ISSUE 5 satellite: rule names drive alerting,
   ``dps_alerts_total`` labels, and status rendering — a silently renamed
-  rule would strand every consumer).
+  rule would strand every consumer);
+- every push/fetch wire codec in ``ops.compression.CODEC_CATALOG``
+  appears in docs/WIRE_PROTOCOL.md's codec table and vice versa (ISSUE 6
+  satellite: codec names ride CLI flags, registration replies, and the
+  health report's ``push_codec`` field).
 
 Pure text analysis — no training, no jax beyond the package import.
 """
@@ -118,6 +122,32 @@ def test_every_health_rule_is_documented_with_severity_and_vice_versa():
     assert not mismatched, (
         f"rule severities disagree between code and doc: "
         f"{[(r, catalog[r], doc_rows[r]) for r in mismatched]}")
+
+
+WIRE_DOC = REPO / "docs" / "WIRE_PROTOCOL.md"
+
+#: A codec-table row: ``| `name` | ...`` inside the "Push codecs" section.
+#: Scoped to the section so metric names elsewhere in the doc can't match.
+_DOC_CODEC_RE = re.compile(r"^\|\s*`([a-z0-9_]+)`\s*\|", re.M)
+
+
+def test_every_codec_is_documented_and_vice_versa():
+    from distributed_parameter_server_for_ml_training_tpu.ops.compression \
+        import CODEC_CATALOG
+
+    text = WIRE_DOC.read_text()
+    assert "## Push codecs" in text, "codec section heading rotted?"
+    section = text.split("## Push codecs", 1)[1].split("\n## ", 1)[0]
+    doc_codecs = set(_DOC_CODEC_RE.findall(section))
+    catalog = set(CODEC_CATALOG)
+    missing_from_doc = sorted(catalog - doc_codecs)
+    unknown_in_doc = sorted(doc_codecs - catalog)
+    assert not missing_from_doc, (
+        f"CODEC_CATALOG codecs absent from docs/WIRE_PROTOCOL.md's codec "
+        f"table: {missing_from_doc}")
+    assert not unknown_in_doc, (
+        f"docs/WIRE_PROTOCOL.md documents codecs not in CODEC_CATALOG "
+        f"(renamed or removed?): {unknown_in_doc}")
 
 
 def test_catalog_names_are_namespaced_and_lowercase():

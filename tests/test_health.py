@@ -589,6 +589,28 @@ class TestHttpSurfaces:
         from distributed_parameter_server_for_ml_training_tpu import cli
         assert cli.main(["status", "--url", "http://127.0.0.1:1"]) == 1
 
+    def test_status_table_shows_negotiated_push_codec(self):
+        """ISSUE 6 satellite: the worker table surfaces each worker's
+        negotiated push codec/bitwidth (the health report's push_codec
+        field, sanitized server-side)."""
+        from distributed_parameter_server_for_ml_training_tpu.cli import (
+            _render_status)
+        from distributed_parameter_server_for_ml_training_tpu.telemetry.cluster import (
+            sanitize_report)
+        report = sanitize_report({"step": 4, "push_codec":
+                                  "adaptive(int4)+ef"})
+        assert report["push_codec"] == "adaptive(int4)+ef"
+        # hostile length is capped on ingest
+        assert len(sanitize_report({"push_codec": "x" * 999})
+                   ["push_codec"]) == 32
+        view = {"mode": "sync", "global_step": 7,
+                "workers": [{"worker": 0, "alive": True, **report}],
+                "alerts": [], "alerts_total": {}}
+        out = _render_status(view)
+        header, row = out.splitlines()[2], out.splitlines()[3]
+        assert "codec" in header
+        assert "adaptive(int4)+ef" in row
+
 
 class TestHeartbeatHardening:
     def _mk_worker(self, store):

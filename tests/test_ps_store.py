@@ -260,3 +260,118 @@ class TestInt8WireCodec:
             int8_quantize(np.array([np.inf, 1.0], np.float32))
         with pytest.raises(ValueError, match="non-finite"):
             int8_quantize(np.array([np.nan], np.float32))
+
+
+class TestCompressedDomainAggregation:
+    """ISSUE 6 tentpole: the server aggregates quantized pushes WITHOUT
+    decompressing — sync rounds sum int8/int4 payloads in int32
+    accumulators and dequantize once at apply time; async applies
+    dequantize the single payload with its carried scale."""
+
+    def _store(self, **kw):
+        from distributed_parameter_server_for_ml_training_tpu.ps import (
+            ParameterStore, StoreConfig)
+        return ParameterStore(
+            {"w": np.ones(64, np.float32), "b": np.zeros(7, np.float32)},
+            StoreConfig(learning_rate=0.1, **kw))
+
+    def _grads(self, seed, v=None):
+        rng = np.random.default_rng(seed)
+        return {"w": (np.full(64, v, np.float32) if v is not None
+                      else rng.normal(size=64).astype(np.float32)),
+                "b": rng.normal(size=7).astype(np.float32)}
+
+    def test_sync_round_matches_decode_per_push_control(self):
+        """Same pushes through the homomorphic path and the legacy
+        decode-per-push control (compressed_domain=False) land the same
+        parameters within float rounding."""
+        from distributed_parameter_server_for_ml_training_tpu.ops.compression import (
+            compress_push)
+        fast = self._store(mode="sync", total_workers=2, push_codec="int8")
+        ctrl = self._store(mode="sync", total_workers=2, push_codec="int8",
+                           compressed_domain=False)
+        before = fast._tm_compressed.value
+        for store in (fast, ctrl):
+            store.push(0, compress_push(self._grads(0)), 0)
+            store.push(1, compress_push(self._grads(1)), 0)
+        assert fast.global_step == ctrl.global_step == 1
+        for k in ("w", "b"):
+            np.testing.assert_allclose(fast.parameters[k],
+                                       ctrl.parameters[k],
+                                       rtol=1e-6, atol=1e-7)
+        # The fast path counted exactly this round's two pushes; the
+        # control (sharing the instrument) added nothing.
+        assert fast._tm_compressed.value - before == 2
+
+    def test_scale_table_refreshes_and_groups_next_round(self):
+        """After the first round the store publishes per-layer absmax
+        scales; workers quantizing against them land in ONE accumulator
+        group (verified behaviorally: the round still matches the
+        control)."""
+        from distributed_parameter_server_for_ml_training_tpu.ops.compression import (
+            compress_push)
+        store = self._store(mode="sync", total_workers=2,
+                            push_codec="int4")
+        assert store.gradient_scales() == ({}, 0)
+        store.push(0, compress_push(self._grads(0), {"w": "int4",
+                                                     "b": "int4"}), 0)
+        store.push(1, compress_push(self._grads(1), {"w": "int4",
+                                                     "b": "int4"}), 0)
+        scales, version = store.gradient_scales()
+        assert version == 1 and set(scales) == {"w", "b"}
+        assert all(v > 0 for v in scales.values())
+        # Round 2 with the shared scales: still aggregates correctly.
+        w_before = store.parameters["w"].copy()
+        plan = {"w": "int4", "b": "int4"}
+        store.push(0, compress_push(self._grads(2, v=0.5), plan,
+                                    scales=scales), 1)
+        store.push(1, compress_push(self._grads(3, v=1.5), plan,
+                                    scales=scales), 1)
+        assert store.global_step == 2
+        # mean of w-grads = 1.0 -> p -= 0.1 (to int4-at-shared-scale
+        # resolution: scale/7 per element, halved by rounding)
+        tol = max(scales["w"] / 7.0, 0.02)
+        np.testing.assert_allclose(store.parameters["w"],
+                                   w_before - 0.1, atol=tol)
+
+    def test_async_apply_dequantizes_with_carried_scale(self):
+        from distributed_parameter_server_for_ml_training_tpu.ops.compression import (
+            compress_push)
+        store = self._store(mode="async", total_workers=1,
+                            push_codec="topk")
+        wid, _ = store.register_worker("t")
+        g = {"w": np.zeros(64, np.float32), "b": np.zeros(7, np.float32)}
+        g["w"][5] = 2.0
+        assert store.push(wid, compress_push(
+            g, {"w": "topk", "b": "int8"}, topk_frac=0.02), 0)
+        assert store.global_step == 1
+        # only the top-k spike moved its parameter
+        np.testing.assert_allclose(store.parameters["w"][5], 1.0 - 0.2,
+                                   rtol=1e-2)
+        np.testing.assert_allclose(store.parameters["w"][:5], 1.0)
+
+    def test_quantized_shape_mismatch_rejected_without_decode(self):
+        """The shape guard runs on the LOGICAL shapes carried in the
+        payload — a mis-sized int4 push is refused up front and the round
+        state stays clean."""
+        from distributed_parameter_server_for_ml_training_tpu.ops.compression import (
+            compress_push)
+        store = self._store(mode="sync", total_workers=1,
+                            push_codec="int4")
+        bad = compress_push({"w": np.ones(32, np.float32)}, {"w": "int4"})
+        assert store.push(0, bad, 0) is False
+        assert store.global_step == 0
+        assert store.stats.gradients_rejected == 1
+
+    def test_quantized_codecs_are_python_store_only(self):
+        from distributed_parameter_server_for_ml_training_tpu.ps import (
+            StoreConfig)
+        from distributed_parameter_server_for_ml_training_tpu.native import (
+            bindings)
+        if not bindings.native_available():
+            pytest.skip("native library not built")
+        from distributed_parameter_server_for_ml_training_tpu.native import (
+            NativeParameterStore)
+        with pytest.raises(ValueError, match="push_codec"):
+            NativeParameterStore({"w": np.ones(4, np.float32)},
+                                 StoreConfig(push_codec="int4"))

@@ -165,6 +165,135 @@ class TestInt8Ring:
         assert stats["int8"]["total"] < 0.7 * stats["bf16"]["total"], stats
 
 
+class TestWireQuantCodecs:
+    """Property tests for the push wire codecs (ops/compression.py;
+    ISSUE 6 satellite): round-trip error bounds per codec, shared-scale
+    int32 accumulation vs dequantize-then-sum, and error-feedback
+    convergence on a quadratic toy problem."""
+
+    def _rand(self, shape, seed=0):
+        return np.random.default_rng(seed).normal(size=shape) \
+            .astype(np.float32)
+
+    def test_int8_roundtrip_error_bound(self):
+        from distributed_parameter_server_for_ml_training_tpu.ops.compression import (
+            int8_dequantize, int8_quantize)
+        x = self._rand((257, 3))
+        q, s = int8_quantize(x)
+        err = np.abs(int8_dequantize(q, s) - x)
+        assert err.max() <= float(s) / 2 + 1e-7
+
+    def test_int4_roundtrip_error_bound(self):
+        from distributed_parameter_server_for_ml_training_tpu.ops.compression import (
+            int4_dequantize, int4_quantize)
+        x = self._rand((33, 7))  # odd element count exercises nibble pad
+        packed, s = int4_quantize(x)
+        y = int4_dequantize(packed, s)
+        assert y.shape == x.shape
+        # scale = absmax/7 -> half-step error bound per element
+        assert np.abs(y - x).max() <= float(s) / 2 + 1e-7
+        # symmetric levels: extremes survive exactly
+        ext = np.asarray([7.0, -7.0, 0.0], np.float32)
+        p2, s2 = int4_quantize(ext)
+        np.testing.assert_allclose(int4_dequantize(p2, s2), ext, rtol=1e-6)
+
+    def test_int4_wire_roundtrip(self):
+        """PackedInt4 survives encode/decode (the wire's int4 dtype) and
+        dequantizes from the zero-copy view."""
+        from distributed_parameter_server_for_ml_training_tpu.comms import (
+            wire)
+        from distributed_parameter_server_for_ml_training_tpu.ops.compression import (
+            compress_push, wire_decompress)
+        x = {"a": self._rand((513,)), "b": self._rand((8, 9))}
+        payload = compress_push(x, plan={"a": "int4", "b": "int4"})
+        out = wire.decode_tensor_dict(wire.encode_tensor_dict(payload))
+        dec = wire_decompress(out)
+        for k in x:
+            assert dec[k].shape == x[k].shape
+            scale = float(payload[k + "::int4scale"][0])
+            assert np.abs(dec[k] - x[k]).max() <= scale / 2 + 1e-7
+
+    def test_topk_keeps_largest_and_bounds_error(self):
+        from distributed_parameter_server_for_ml_training_tpu.ops.compression import (
+            compress_push, wire_decompress)
+        x = np.zeros(1000, np.float32)
+        x[[3, 500, 999]] = [10.0, -20.0, 5.0]
+        x += self._rand(1000, seed=1) * 0.01
+        payload = compress_push({"g": x}, plan={"g": "topk"},
+                                topk_frac=0.003)
+        dec = wire_decompress(payload)["g"]
+        assert np.count_nonzero(dec) == 3
+        # the three spikes survive (to int8 resolution), noise is dropped
+        np.testing.assert_allclose(dec[[3, 500, 999]], x[[3, 500, 999]],
+                                   rtol=0.02, atol=0.2)
+
+    def test_shared_scale_accumulate_matches_dequantize_then_sum(self):
+        """The homomorphic path: int32 accumulation of shared-scale
+        payloads must equal dequantize-then-mean within float rounding."""
+        from distributed_parameter_server_for_ml_training_tpu.ops.compression import (
+            compress_push, homomorphic_mean, wire_decompress)
+        scales = {"w": 3.0, "v": 1.7}
+        dicts = [compress_push(
+            {"w": self._rand((64, 3), seed=i), "v": self._rand(129, seed=i + 9)},
+            plan={"w": "int8", "v": "int4"}, scales=scales)
+            for i in range(4)]
+        got = homomorphic_mean(dicts)
+        want = {k: np.mean([wire_decompress(d)[k] for d in dicts], axis=0)
+                for k in ("w", "v")}
+        for k in want:
+            np.testing.assert_allclose(got[k], want[k], rtol=1e-5,
+                                       atol=1e-6)
+
+    def test_homomorphic_mean_mixed_scales_and_codecs(self):
+        """Entries that DON'T share a scale (or aren't quantized at all)
+        land in separate accumulator groups — same mean either way."""
+        from distributed_parameter_server_for_ml_training_tpu.ops.compression import (
+            compress_push, homomorphic_mean, wire_decompress)
+        g0 = compress_push({"w": self._rand(200, seed=0)},
+                           plan={"w": "int8"}, scales={"w": 2.0})
+        g1 = compress_push({"w": self._rand(200, seed=1)},
+                           plan={"w": "int8"})  # own per-push scale
+        g2 = compress_push({"w": self._rand(200, seed=2)},
+                           plan={"w": "topk"}, topk_frac=0.1)
+        g3 = {"w": self._rand(200, seed=3)}  # dense fp32 (legacy worker)
+        dicts = [g0, g1, g2, g3]
+        got = homomorphic_mean(dicts)["w"]
+        want = np.mean([wire_decompress(d)["w"] for d in dicts], axis=0)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_error_feedback_residual_converges_quadratic(self):
+        """EF-SGD on f(x) = 0.5||x - t||^2 with top-k compression: with
+        error feedback the iterates reach the optimum; without it the
+        dropped coordinates stall (the classic EF property the int4/topk
+        codecs rely on)."""
+        from distributed_parameter_server_for_ml_training_tpu.ops.compression import (
+            ErrorFeedback, compress_push, wire_decompress)
+        rng = np.random.default_rng(0)
+        target = rng.normal(size=16).astype(np.float32)
+
+        def run(ef):
+            # k=1 of 16 coordinates per step -> effective update delay of
+            # ~16 steps; the EF stability bound wants lr·delay < 1.
+            x = np.zeros(16, np.float32)
+            for _ in range(600):
+                g = x - target
+                payload = compress_push({"x": g}, plan={"x": "topk"},
+                                        ef=ef, topk_frac=0.07)  # k=1
+                x = x - 0.05 * wire_decompress(payload)["x"]
+            return float(np.abs(x - target).max())
+
+        with_ef = run(ErrorFeedback())
+        without_ef = run(None)
+        assert with_ef < 1e-3, with_ef
+        assert with_ef < without_ef
+
+    def test_int4_nonfinite_raises(self):
+        from distributed_parameter_server_for_ml_training_tpu.ops.compression import (
+            int4_quantize)
+        with pytest.raises(ValueError, match="non-finite"):
+            int4_quantize(np.asarray([1.0, np.nan], np.float32))
+
+
 def test_int8_sync_allreduce_trains(devices, tiny_model):
     """compression='int8' end-to-end: the quantized all-reduce must stay
     close to fp32 for one step and still learn over a short run."""

@@ -70,6 +70,58 @@ class TestEncodeCopyBudget:
                    for reasons in copy_counts.values()), copy_counts
 
 
+class TestQuantizedPushPath:
+    """Copy budget of the QUANTIZED push path (ISSUE 6 satellite): the
+    compressed payload costs the same one-copy encode, and the
+    decompressor passes already-fp32 entries through WITHOUT copying
+    (``astype(..., copy=False)`` — the old unconditional ``astype``
+    re-copied the zero-copy wire view per push)."""
+
+    def test_int8_compressed_encode_copy_budget(self, copy_counts):
+        from distributed_parameter_server_for_ml_training_tpu.ops.compression import (
+            int8_wire_compress)
+        payload = int8_wire_compress(_payload(n_tensors=4))
+        wire.encode_tensor_dict(payload)
+        assert set(copy_counts) == set(payload)
+        for name, reasons in copy_counts.items():
+            assert reasons == ["frame_write"], (name, reasons)
+
+    def test_int4_topk_encode_copy_budget(self, copy_counts):
+        from distributed_parameter_server_for_ml_training_tpu.ops.compression import (
+            compress_push)
+        tensors = _payload(n_tensors=4)
+        plan = dict(zip(tensors, ["int4", "int4", "topk", "int8"]))
+        payload = compress_push(tensors, plan, topk_frac=0.05)
+        wire.encode_tensor_dict(payload)
+        for name, reasons in copy_counts.items():
+            assert reasons == ["frame_write"], (name, reasons)
+
+    def test_decompress_passes_fp32_entries_through_without_copy(self):
+        from distributed_parameter_server_for_ml_training_tpu.ops.compression import (
+            int8_wire_compress, int8_wire_decompress, wire_decompress)
+        mixed = int8_wire_compress({"q": np.ones(64, np.float32)})
+        mixed["dense"] = np.arange(64, dtype=np.float32)
+        out = wire.decode_tensor_dict(wire.encode_tensor_dict(mixed))
+        assert not out["dense"].flags.owndata  # still the wire view
+        for dec in (int8_wire_decompress(dict(out)),
+                    wire_decompress(out)):
+            assert np.shares_memory(dec["dense"], out["dense"]), \
+                "fp32 passthrough copied the zero-copy wire view"
+            np.testing.assert_allclose(dec["q"], 1.0, atol=0.01)
+
+    def test_int4_decode_is_zero_copy_view(self):
+        from distributed_parameter_server_for_ml_training_tpu.ops.compression import (
+            compress_push)
+        payload = compress_push({"w": np.ones(4096, np.float32)},
+                                {"w": "int4"})
+        blob = wire.encode_tensor_dict(payload)
+        out = wire.decode_tensor_dict(blob)
+        arr = out["w"]
+        assert arr.logical_shape == (4096,)
+        assert arr.nbytes == 2048  # packed nibbles: half a byte per value
+        assert not arr.flags.owndata and arr.base is not None
+
+
 class TestDecodeZeroCopy:
     def test_decoded_arrays_are_views_into_payload(self):
         blob = wire.encode_tensor_dict(_payload(n_tensors=4))
