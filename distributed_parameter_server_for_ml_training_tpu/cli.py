@@ -13,6 +13,8 @@ Commands::
     python -m ....cli train --mode baseline           # single-chip baseline
     python -m ....cli serve --mode async --workers 8  # gRPC PS (multi-host)
     python -m ....cli worker --server host:8000       # gRPC remote worker
+    python -m ....cli supervise --workers 4 -- --server host:8000
+                                                      # self-healing fleet
     python -m ....cli status --url http://host:9400   # cluster health view
 
 The in-process ``train`` command replaces the reference's entire
@@ -276,6 +278,40 @@ def build_parser() -> argparse.ArgumentParser:
                    help="deterministic server-side fault injection spec "
                         "(comms/faults.py), e.g. "
                         "'seed=7;push.drop_reply@n=3;any.kill@n=40'")
+    s.add_argument("--sync-quorum", type=float,
+                   default=_env("DPS_SYNC_QUORUM", None, float),
+                   help="quorum sync rounds (docs/ROBUSTNESS.md): a round "
+                        "completes once this many DISTINCT workers of the "
+                        "live round target have pushed — >= 1 is a count, "
+                        "< 1 a fraction (ceil). Stragglers' late pushes "
+                        "reconcile via staleness semantics. Implies "
+                        "--strict-rounds counting; omit = full barrier")
+    s.add_argument("--round-deadline", type=float,
+                   default=_env("DPS_ROUND_DEADLINE", None, float),
+                   help="per-round deadline in seconds, armed at the "
+                        "round's first push: on expiry the round "
+                        "completes with whatever arrived (composes with "
+                        "--sync-quorum; omit = none)")
+    s.add_argument("--remediate", action="store_true",
+                   default=bool(_env("DPS_REMEDIATE", 0, int)),
+                   help="turn cluster alerts into actions "
+                        "(docs/ROBUSTNESS.md): straggler_lag -> quorum-"
+                        "exclude + rebalance directive, nonfinite loss/"
+                        "grad -> quarantine + refetch directive, "
+                        "dead_worker -> respawn request (executed by "
+                        "cli supervise next to the workers)")
+    s.add_argument("--remediate-dry-run", action="store_true",
+                   help="run the remediation engine but execute nothing: "
+                        "every decision is recorded/counted with outcome "
+                        "dry_run (policy rehearsal)")
+    s.add_argument("--remediation-cooldown", type=float,
+                   default=_env("DPS_REMEDIATION_COOLDOWN", 30.0, float),
+                   help="minimum seconds between repeated remediation "
+                        "actions for the same (action, worker)")
+    s.add_argument("--quarantine-secs", type=float,
+                   default=_env("DPS_QUARANTINE_SECS", 30.0, float),
+                   help="server-side push-refusal window of the "
+                        "quarantine action")
     s.add_argument("--no-health-monitor", action="store_true",
                    help="disable the cluster health monitor (worker health "
                         "reports, rule engine, /cluster endpoint, /healthz "
@@ -290,6 +326,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="seconds of silence before the monitor declares a "
                         "worker dead (critical alert; independent of "
                         "--worker-timeout membership expiry)")
+    s.add_argument("--straggler-lag", type=int,
+                   default=_env("DPS_STRAGGLER_LAG", 100, int),
+                   help="steps behind the fastest reporting worker before "
+                        "the straggler_lag rule fires (the remediation "
+                        "engine's quorum-exclude trigger)")
     add_platform(s)
     add_telemetry(s)
 
@@ -365,6 +406,44 @@ def build_parser() -> argparse.ArgumentParser:
                         "worker loop into this directory (TensorBoard/"
                         "Perfetto; pairs with --trace span traces)")
     add_common(w)
+
+    sv = sub.add_parser(
+        "supervise",
+        help="spawn and babysit N `cli worker` processes: respawn on "
+             "death with exponential backoff + crash-loop latch "
+             "(docs/ROBUSTNESS.md). Everything after `--` is passed to "
+             "every child worker verbatim")
+    sv.add_argument("--workers", type=int,
+                    default=_env("DPS_SUPERVISE_WORKERS", 2, int),
+                    help="worker process slots to run")
+    sv.add_argument("--no-respawn", action="store_true",
+                    help="just run the children once (no self-healing)")
+    sv.add_argument("--respawn-backoff", type=float,
+                    default=_env("DPS_RESPAWN_BACKOFF", 1.0, float),
+                    help="first respawn delay; doubles per consecutive "
+                         "crash up to --respawn-backoff-max")
+    sv.add_argument("--respawn-backoff-max", type=float, default=30.0)
+    sv.add_argument("--healthy-after", type=float, default=5.0,
+                    help="a child alive this long resets its slot's "
+                         "backoff and crash-loop count")
+    sv.add_argument("--crash-loop-after", type=int, default=3,
+                    help="consecutive fast crashes before a slot latches "
+                         "(stops respawning, nonzero exit)")
+    sv.add_argument("--slot-faults", action="append", default=[],
+                    metavar="SLOT:SPEC",
+                    help="fault spec for one slot's FIRST spawn only "
+                         "(chaos drills: respawns run clean), e.g. "
+                         "'0:seed=7;push.kill@n=2'; repeatable")
+    sv.add_argument("--slot-env", action="append", default=[],
+                    metavar="SLOT:KEY=VALUE",
+                    help="env var for one slot's first spawn only, e.g. "
+                         "'1:DPS_NAN_STEP=4'; repeatable")
+    add_platform(sv)
+    add_telemetry(sv)
+    sv.add_argument("worker_args", nargs=argparse.REMAINDER,
+                    help="-- followed by the `cli worker` args every "
+                         "child runs with (--worker-name is added per "
+                         "slot)")
 
     st = sub.add_parser(
         "status",
@@ -596,6 +675,15 @@ def _cmd_serve(args) -> int:
         raise SystemExit(
             f"--push-codec {args.push_codec} needs --store-backend python "
             f"(the {args.store_backend} backend speaks none|fp16|int8)")
+    quorum_flags = (getattr(args, "sync_quorum", None) is not None
+                    or getattr(args, "round_deadline", None) is not None)
+    if quorum_flags and args.mode != "sync":
+        raise SystemExit("--sync-quorum/--round-deadline apply to "
+                         "--mode sync (async has no rounds)")
+    if quorum_flags and args.store_backend == "native":
+        raise SystemExit("--sync-quorum/--round-deadline need "
+                         "--store-backend python|device (the C++ arena "
+                         "runs its own round loop)")
 
     model = get_model(args.model, num_classes=args.num_classes,
                       image_size=args.image_size)
@@ -614,7 +702,9 @@ def _cmd_serve(args) -> int:
                                 else args.push_codec),
                     fetch_codec=args.fetch_codec,
                     compressed_domain=not getattr(
-                        args, "no_compressed_domain", False)))
+                        args, "no_compressed_domain", False),
+                    sync_quorum=getattr(args, "sync_quorum", None),
+                    round_deadline=getattr(args, "round_deadline", None)))
     monitor = None
     if not getattr(args, "no_health_monitor", False):
         # Cluster health monitor (docs/OBSERVABILITY.md): aggregates the
@@ -627,13 +717,41 @@ def _cmd_serve(args) -> int:
                                 set_cluster_monitor)
         monitor = ClusterMonitor(
             store,
-            HealthThresholds(dead_after_s=getattr(args, "dead_after", 30.0)),
+            HealthThresholds(
+                dead_after_s=getattr(args, "dead_after", 30.0),
+                straggler_lag_steps=getattr(args, "straggler_lag", 100)),
             interval=getattr(args, "health_interval", 5.0),
             emit_stream=bool(getattr(args, "telemetry", False)))
         set_cluster_monitor(monitor)
         monitor.start()
     svc = ParameterService(store, faults=getattr(args, "faults", None),
                            monitor=monitor)
+    if getattr(args, "remediate", False) \
+            or getattr(args, "remediate_dry_run", False):
+        # Remediation policy engine (docs/ROBUSTNESS.md): turns the
+        # monitor's alert edges into actions against the store (quorum
+        # exclusion) and the service (quarantine, directives). Opt-in —
+        # detection stays observe-only by default.
+        if monitor is None:
+            raise SystemExit("--remediate needs the health monitor "
+                             "(drop --no-health-monitor)")
+        from .telemetry import RemediationEngine, RemediationPolicy
+        engine = RemediationEngine(
+            store, service=svc,
+            policy=RemediationPolicy(
+                dry_run=bool(getattr(args, "remediate_dry_run", False)),
+                cooldown_s=getattr(args, "remediation_cooldown", 30.0),
+                quarantine_s=getattr(args, "quarantine_secs", 30.0)))
+        monitor.remediation = engine
+        monitor.add_listener(engine.handle_events)
+        # The synchronous half of the quarantine action: a push whose own
+        # health report flags non-finite values is refused before it can
+        # poison the aggregate (the async quarantine would arrive one
+        # apply too late). Dry-run rehearses without it.
+        svc.reject_nonfinite = not engine.policy.dry_run
+        print(f"remediation: engine on "
+              f"(dry_run={engine.policy.dry_run})", file=sys.stderr,
+              flush=True)
     ckpt_dir = getattr(args, "checkpoint_dir", None)
     ckpt = None
     restored = None
@@ -769,6 +887,64 @@ def _cmd_worker(args) -> int:
     return 0
 
 
+def cmd_supervise(args) -> int:
+    with _telemetry_session(args, "supervisor"):
+        return _cmd_supervise(args)
+
+
+def _parse_slot_map(pairs: list[str], what: str) -> dict:
+    out: dict = {}
+    for raw in pairs:
+        slot, sep, rest = raw.partition(":")
+        if not sep or not slot.isdigit():
+            raise SystemExit(f"bad {what} {raw!r} (want SLOT:{what})")
+        out[int(slot)] = rest
+    return out
+
+
+def _cmd_supervise(args) -> int:
+    from .ps.supervisor import (SupervisorConfig, WorkerSupervisor,
+                                build_worker_argv, install_signal_stop)
+
+    worker_args = list(args.worker_args)
+    if worker_args and worker_args[0] == "--":
+        worker_args = worker_args[1:]
+    if not worker_args:
+        raise SystemExit("supervise: pass the child worker args after "
+                         "`--` (at least --server HOST:PORT)")
+    slot_faults = _parse_slot_map(args.slot_faults, "SPEC")
+    slot_env = {}
+    for slot, kv in _parse_slot_map(args.slot_env, "KEY=VALUE").items():
+        key, sep, val = kv.partition("=")
+        if not sep:
+            raise SystemExit(f"bad --slot-env value {kv!r}")
+        slot_env.setdefault(slot, {})[key] = val
+    # Children inherit the CPU pin when the supervisor got one — a
+    # respawned worker must not fight the serve process for the TPU.
+    if getattr(args, "platform", "default") == "cpu" \
+            and "--platform" not in worker_args:
+        worker_args += ["--platform", "cpu"]
+
+    def argv_for(slot: int, attempt: int):
+        return build_worker_argv(worker_args, slot,
+                                 first_spawn_faults=slot_faults,
+                                 first_spawn_env=slot_env,
+                                 attempt=attempt)
+
+    sup = WorkerSupervisor(argv_for, args.workers, SupervisorConfig(
+        respawn=not args.no_respawn,
+        backoff_initial=args.respawn_backoff,
+        backoff_max=args.respawn_backoff_max,
+        healthy_after=args.healthy_after,
+        crash_loop_after=args.crash_loop_after))
+    install_signal_stop(sup)
+    print(f"supervisor: {args.workers} worker slot(s), "
+          f"respawn={'on' if not args.no_respawn else 'off'}",
+          file=sys.stderr, flush=True)
+    sup.start()
+    return sup.run()
+
+
 def _render_status(view: dict) -> str:
     """The ``cli status`` terminal dashboard: cluster header, per-worker
     table, active alerts. Pure text in, text out (tested directly)."""
@@ -783,8 +959,24 @@ def _render_status(view: dict) -> str:
     cols = [("worker", 7), ("alive", 6), ("step", 8), ("epoch", 6),
             ("loss", 10), ("grad_norm", 11), ("ex/s", 9), ("pipe", 5),
             ("codec", 19), ("reconn", 7), ("hb_err", 7), ("age_s", 7)]
-    lines = [header, "-" * len(header),
-             "".join(f"{name:>{w}}" for name, w in cols)]
+    lines = [header, "-" * len(header)]
+    rnd = view.get("round")
+    if rnd:
+        # Quorum-round state (docs/ROBUSTNESS.md): target vs received,
+        # who is excluded, what closed the last round.
+        extras = []
+        if rnd.get("excluded"):
+            extras.append(f"excluded={rnd['excluded']}")
+        if rnd.get("deadline_s"):
+            extras.append(f"deadline={rnd['deadline_s']:g}s"
+                          + ("*" if rnd.get("deadline_armed") else ""))
+        if rnd.get("last_trigger"):
+            extras.append(f"last={rnd['last_trigger']}")
+        lines.append(f"round: received {rnd.get('received', 0)}"
+                     f"/{rnd.get('quorum', '?')} "
+                     f"(target {rnd.get('target', '?')}"
+                     + (", " + ", ".join(extras) if extras else "") + ")")
+    lines.append("".join(f"{name:>{w}}" for name, w in cols))
 
     def cell(v, width, fmt=None):
         if v is None:
@@ -831,14 +1023,36 @@ def _render_status(view: dict) -> str:
     else:
         lines.append("")
         lines.append("no active alerts")
+    rem = view.get("remediation")
+    if rem:
+        active = rem.get("active", [])
+        tag = " (dry-run)" if rem.get("dry_run") else ""
+        lines.append("")
+        if active:
+            lines.append(f"active remediations{tag}:")
+            for r in active:
+                who = "cluster" if r.get("worker") is None \
+                    else f"worker {r['worker']}"
+                lines.append(f"  [{r.get('outcome', '?').upper()}] "
+                             f"{r.get('action')} ({who}) <- "
+                             f"{r.get('rule')}")
+        else:
+            lines.append(f"remediation engine on{tag}: no active actions")
+        q = rem.get("quarantined")
+        if q:
+            lines.append("  quarantined pushes: " + ", ".join(
+                f"worker {w} ({s:.0f}s left)" for w, s in q.items()))
     return "\n".join(lines)
 
 
 def cmd_status(args) -> int:
     """One-shot (or ``--watch``) render of a serve process's ``/cluster``
     view. Exit codes: 0 healthy, 2 when a CRITICAL alert is active (so a
-    cron/script can gate on it), 1 when the endpoint is unreachable or has
-    no monitor."""
+    cron/script can gate on it), 3 when critical alerts are active BUT
+    the remediation engine holds active actions against them — degraded
+    but healing (docs/ROBUSTNESS.md): a restart policy should hold off
+    and let the self-healing run —, 1 when the endpoint is unreachable or
+    has no monitor."""
     import json as _json
     import time as _time
     from urllib.error import HTTPError, URLError
@@ -868,7 +1082,17 @@ def cmd_status(args) -> int:
         else:
             print(_render_status(view))
         critical = view.get("alerts_total", {}).get("critical", 0)
-        return (2 if critical else 0), view
+        if not critical:
+            return 0, view
+        # Degraded-but-healing: critical alerts with a live remediation
+        # working on them exit 3, not 2 — distinguishable for restart
+        # policies that should let the self-healing run its course. A
+        # dry-run engine records decisions but executes NOTHING, so it
+        # must not claim healing (a policy holding off would wait
+        # forever).
+        rem = view.get("remediation", {})
+        healing = bool(rem.get("active")) and not rem.get("dry_run")
+        return (3 if healing else 2), view
 
     if args.watch <= 0:
         rc, _ = poll()
@@ -925,7 +1149,7 @@ def main(argv=None) -> int:
         import jax
         jax.config.update("jax_platforms", "cpu")
     return {"train": cmd_train, "serve": cmd_serve, "worker": cmd_worker,
-            "experiments": cmd_experiments,
+            "experiments": cmd_experiments, "supervise": cmd_supervise,
             "status": cmd_status}[args.command](args)
 
 

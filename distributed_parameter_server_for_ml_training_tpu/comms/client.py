@@ -136,6 +136,17 @@ class RemoteStore:
         #: (int8/int4/topk) without decoding and publishes per-layer
         #: gradient scales. Same gating discipline as delta_fetch.
         self.supports_compressed_domain = False
+        #: True once the server advertises the directive channel
+        #: (docs/ROBUSTNESS.md "Self-healing"): its fetch/push reply meta
+        #: may carry server->worker control directives. This client
+        #: advertises the capability in its register request; either side
+        #: missing it degrades to a directive-less wire.
+        self.supports_directives = False
+        #: Directives received but not yet taken by the worker loop, plus
+        #: the highest seq seen (the dedupe/ack watermark — the server
+        #: re-attaches outstanding directives every reply until acked).
+        self._pending_directives: list[dict] = []
+        self._directive_last_seq = 0
         #: Server-published per-layer gradient ABSMAX table + version,
         #: cached from the registration reply and refreshed off fetch
         #: reply meta (the client sends its version as ``have_qscales``;
@@ -314,6 +325,40 @@ class RemoteStore:
         if m is not None:
             self._membership = [int(w) for w in m]
 
+    def _note_directives(self, reply_meta: dict) -> None:
+        """Collect piggybacked server->worker directives off a reply
+        (capability-gated; docs/ROBUSTNESS.md). Dedupe by seq — the
+        server re-attaches outstanding directives until acked, so the
+        same directive may arrive on several replies. Malformed entries
+        are dropped; directives must never fail the RPC that carried
+        them."""
+        ds = reply_meta.get("directives")
+        if not isinstance(ds, list):
+            return
+        with self._wire_lock:
+            for d in ds:
+                if not isinstance(d, dict):
+                    continue
+                try:
+                    seq = int(d["seq"])
+                except (KeyError, TypeError, ValueError):
+                    continue
+                if seq <= self._directive_last_seq \
+                        or not isinstance(d.get("action"), str):
+                    continue
+                self._directive_last_seq = seq
+                self._pending_directives.append(dict(d))
+
+    def take_directives(self) -> list[dict]:
+        """Drain the pending directives (worker loop, step boundaries)."""
+        with self._wire_lock:
+            out, self._pending_directives = self._pending_directives, []
+            return out
+
+    def _attach_directive_ack(self, meta: dict) -> None:
+        if self.supports_directives:
+            meta["directives_ack"] = self._directive_last_seq
+
     def _note_qscales(self, reply_meta: dict) -> None:
         """Adopt a piggybacked shared-scale table (register/fetch reply
         meta). A malformed table degrades to the cached one — scales are
@@ -353,7 +398,11 @@ class RemoteStore:
         for attempt in range(register_retries):
             t0 = _tnow()
             try:
-                request = pack_msg({"worker_name": worker_name})
+                # ``capabilities`` advertises what THIS client can act on
+                # (directives flow server->worker); an old server ignores
+                # the field (docs/ROBUSTNESS.md).
+                request = pack_msg({"worker_name": worker_name,
+                                    "capabilities": ["directives"]})
                 # Deadline like the hot RPCs: an undeadlined registration
                 # against a half-up server would hang the worker (and the
                 # reconnect state machine) indefinitely.
@@ -374,6 +423,15 @@ class RemoteStore:
                     reply.get("health_report", False))
                 self.supports_compressed_domain = bool(
                     reply.get("compressed_domain", False))
+                self.supports_directives = bool(
+                    reply.get("directives", False))
+                # A fresh registration (incl. session resume against a
+                # restarted server) starts a fresh directive stream: the
+                # new server's seqs restart from 1, so a stale watermark
+                # would suppress every delivery.
+                with self._wire_lock:
+                    self._pending_directives = []
+                    self._directive_last_seq = 0
                 # Registration is the negotiation point: drop any cached
                 # table before adopting the reply's. A crash-RESTORED
                 # server restarts its scale versions from 0 — a stale
@@ -431,6 +489,7 @@ class RemoteStore:
         meta = {} if worker_id is None else {"worker_id": worker_id}
         if worker_id is not None:
             self._attach_health(meta)
+            self._attach_directive_ack(meta)
         if have_step is not None and self.supports_delta_fetch:
             meta["have_step"] = int(have_step)
         if self.supports_compressed_domain:
@@ -448,6 +507,7 @@ class RemoteStore:
         rmeta, payload = unpack_msg(reply)
         self._note_membership(rmeta)
         self._note_qscales(rmeta)
+        self._note_directives(rmeta)
         if rmeta.get("not_modified"):
             self._tm_fetch_nm.inc()
             return {}, int(rmeta["global_step"])
@@ -486,12 +546,14 @@ class RemoteStore:
         if wt is not None:
             meta["trace"] = wt
         self._attach_health(meta)
+        self._attach_directive_ack(meta)
         payload = encode_tensor_dict(gradients, trace=wt)
         # Recorded BEFORE the send: a push that dies mid-RPC is exactly
         # the one the reconnect path must be able to re-send verbatim.
         self._last_push = (token, payload, int(fetched_step))
         reply = self._invoke("PushGradrients", pack_msg(meta, payload))
         rmeta, _ = unpack_msg(reply)
+        self._note_directives(rmeta)
         return bool(rmeta["accepted"])
 
     def repush_last(self, worker_id: int) -> bool | None:

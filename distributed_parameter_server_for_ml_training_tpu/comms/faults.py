@@ -30,8 +30,9 @@ Spec grammar (CLI ``--faults`` / env ``DPS_FAULTS_CLIENT`` /
 
     spec  := [ 'seed=' int ';' ] rule ( ';' rule )*
     rule  := op '.' kind [ '=' float ] '@' when
-    op    := 'push' | 'fetch' | 'register' | 'finish' | 'any'
+    op    := 'push' | 'fetch' | 'register' | 'finish' | 'any' | 'compute'
     kind  := 'unavailable' | 'deadline' | 'delay' | 'drop_reply' | 'kill'
+           | 'delay_compute'
     when  := 'p=' float          # per-call probability (seeded RNG)
            | 'n=' int(,int)*     # specific 1-based call indices for op
            | 'every=' int        # every k-th call
@@ -42,6 +43,10 @@ Examples::
     fetch.delay=0.05@every=3             # every 3rd fetch sleeps 50 ms
     push.drop_reply@n=2,5                # pushes 2 and 5 apply, reply lost
     any.kill@n=40                        # the 40th RPC kills the server
+    compute.delay_compute=0.25@every=1   # every local step +250 ms (a
+                                         # deterministic straggler; the
+                                         # worker loop polls this op once
+                                         # per step — 'any' never matches)
 
 The first matching rule per call wins. ``delay`` composes with nothing —
 it IS the action (the call proceeds after the sleep).
@@ -58,6 +63,7 @@ from dataclasses import dataclass
 import grpc
 
 __all__ = [
+    "COMPUTE_OP",
     "FAULT_KINDS",
     "FAULT_OPS",
     "FaultInjector",
@@ -67,6 +73,12 @@ __all__ = [
     "parse_fault_spec",
 ]
 
+#: The pseudo-op the worker loop polls once per LOCAL STEP for injected
+#: compute slowdowns (``ps/worker.py`` via :meth:`maybe_delay_compute`) —
+#: deliberately not a real RPC name, so RPC-side wrappers never see it
+#: and ``any`` rules (which span the four RPCs) never match it.
+COMPUTE_OP = "__compute__"
+
 #: op name (spec vocabulary) -> RPC method name (None = matches all four).
 FAULT_OPS = {
     "push": "PushGradrients",  # quirk 1 typo is the wire contract
@@ -74,9 +86,11 @@ FAULT_OPS = {
     "register": "RegisterWorker",
     "finish": "JobFinished",
     "any": None,
+    "compute": COMPUTE_OP,  # worker-loop per-step hook, not an RPC
 }
 
-FAULT_KINDS = ("unavailable", "deadline", "delay", "drop_reply", "kill")
+FAULT_KINDS = ("unavailable", "deadline", "delay", "drop_reply", "kill",
+               "delay_compute")
 
 _STATUS = {
     "unavailable": grpc.StatusCode.UNAVAILABLE,
@@ -115,7 +129,11 @@ class FaultRule:
 
     def matches_rpc(self, rpc_name: str) -> bool:
         target = FAULT_OPS[self.op]
-        return target is None or target == rpc_name
+        if target is None:
+            # 'any' spans the four RPCs; the compute pseudo-op is only
+            # ever hit by an explicit 'compute.' rule.
+            return rpc_name != COMPUTE_OP
+        return target == rpc_name
 
 
 def parse_fault_spec(spec: str) -> tuple[int, list[FaultRule]]:
@@ -139,6 +157,14 @@ def parse_fault_spec(spec: str) -> tuple[int, list[FaultRule]]:
                 raise ValueError(f"unknown op {op!r}")
             if kind not in FAULT_KINDS:
                 raise ValueError(f"unknown kind {kind!r}")
+            if (kind == "delay_compute") != (op == "compute"):
+                # delay_compute is the compute pseudo-op's ONLY kind: a
+                # compute slowdown on an RPC op (or an RPC fault on the
+                # compute op) is a typo'd schedule, and a typo'd chaos
+                # schedule must fail at startup.
+                raise ValueError(
+                    "delay_compute pairs with op 'compute' (and "
+                    "'compute' supports only delay_compute)")
             value = float(val) if val else 0.0
             prob = at = every = None
             if when.startswith("p="):
@@ -227,6 +253,19 @@ class FaultInjector:
                     self._tm[(rule.op, rule.kind)].inc()
                     return rule
         return None
+
+    def maybe_delay_compute(self) -> float:
+        """Worker-loop hook (``ps/worker.py``): one decision per local
+        step against the compute pseudo-op; sleeps and returns the
+        injected seconds on a hit, 0.0 otherwise. The deterministic
+        straggler knob — ``compute.delay_compute=0.25@every=1`` slows
+        every step by 250 ms, same seed -> same schedule."""
+        rule = self.decide(COMPUTE_OP)
+        if rule is None or rule.kind != "delay_compute":
+            return 0.0
+        if rule.value > 0:
+            time.sleep(rule.value)
+        return rule.value
 
     def schedule_preview(self, rpc_name: str, calls: int) -> list:
         """The schedule a FRESH injector with this spec would produce for

@@ -24,6 +24,7 @@ from __future__ import annotations
 import json
 import struct
 import threading
+import time
 from collections import OrderedDict
 from concurrent import futures
 
@@ -46,6 +47,28 @@ PUSH_SEEN_CAP = 128
 #: a flat 120 s outlived the client's 60 s rpc_timeout and pinned server
 #: threads (round-5 ADVICE).
 DUP_WAIT_CAP_S = 30.0
+
+#: Server->worker control directives (docs/ROBUSTNESS.md "Self-healing"):
+#: the remediation layer posts these and the fetch/push reply envelope
+#: meta carries them to capable workers, which act at step boundaries.
+#: The names are a wire/doc contract exactly like metric/span/rule names;
+#: ``tests/test_docs_drift.py`` pins this table to the doc both
+#: directions.
+DIRECTIVE_CATALOG = {
+    "refetch_params": "drop the delta-fetch basis and take a full fresh "
+                      "fetch at the next step boundary",
+    "quarantine": "skip gradient pushes for `steps` boundary windows and "
+                  "reset error-feedback residuals (suspected-poisoned "
+                  "local state)",
+    "rebalance_shard": "finish the current epoch early and recompute the "
+                       "data shard from live membership at the next epoch",
+    "drain": "finish cleanly at the next step boundary (flush the pending "
+             "window, then JobFinished)",
+}
+
+#: Outstanding directives kept per worker; older ones are dropped first
+#: (a worker that never fetches must not grow server memory).
+DIRECTIVES_PER_WORKER_CAP = 16
 
 
 def parse_push_token(token) -> tuple[str, int]:
@@ -99,8 +122,18 @@ def unpack_msg(data: bytes) -> tuple[dict, memoryview]:
 class ParameterService:
     """Generic-handler implementation of the 4-RPC lifecycle."""
 
-    def __init__(self, store: ParameterStore, faults=None, monitor=None):
+    def __init__(self, store: ParameterStore, faults=None, monitor=None,
+                 reject_nonfinite: bool = False):
         self.store = store
+        # Self-healing guard (docs/ROBUSTNESS.md): a push whose OWN
+        # piggybacked health report flags a non-finite loss/grad is
+        # refused synchronously. The evidence and the poison ride the
+        # same envelope, so this is the only reaction that can beat the
+        # apply — the monitor's quarantine (async, next evaluation) would
+        # always arrive one poisoned aggregate too late. Off by default
+        # (reference parity: the reference applied NaN); cli serve turns
+        # it on with the remediation engine.
+        self.reject_nonfinite = reject_nonfinite
         # Cluster health monitor (telemetry/cluster.py): when attached,
         # registration advertises the health_report capability and the
         # fetch/push handlers feed piggybacked worker health reports into
@@ -126,6 +159,26 @@ class ParameterService:
         #           worker_id, step_at_completion]; LRU-bounded.
         self._push_seen: OrderedDict[str, list] = OrderedDict()
         self._push_seen_lock = threading.Lock()
+        # Directive channel (docs/ROBUSTNESS.md "Self-healing"): per-worker
+        # outstanding server->worker directives, attached to every fetch/
+        # push reply until the worker acks them (at-least-once delivery;
+        # the client dedupes by seq). Only workers that advertised the
+        # capability at registration ever get them — legacy peers' replies
+        # carry nothing, same degradation discipline as health reports.
+        self._directive_lock = threading.Lock()
+        self._directives: dict[int, list[dict]] = {}
+        self._directive_seq = 0
+        self._directive_capable: set[int] = set()
+        # Server-side push quarantine (remediation action): worker id ->
+        # wall-clock ts until which its pushes are refused (acknowledged,
+        # never applied). Belt-and-braces beside the quarantine directive:
+        # a legacy worker that can't hear the directive still can't poison
+        # the aggregate.
+        self._quarantined: dict[int, float] = {}
+        # Activity-coupled membership expiry (satellite: a stalled elastic
+        # round unsticks on the next push/registration instead of waiting
+        # for the serve loop's next timer tick).
+        self._last_expire_check = 0.0
         # Deterministic fault injection (comms/faults.py): wraps the RPC
         # handler bodies in handlers(); None = no faults.
         from .faults import FaultInjector
@@ -148,6 +201,116 @@ class ParameterService:
             for name in ["RegisterWorker", "PushGradrients",
                          "FetchParameters", "JobFinished"]
         }
+        # Pushes refused while their worker was quarantined (remediation
+        # action; docs/ROBUSTNESS.md).
+        self._tm_quarantined = reg.counter(
+            "dps_service_quarantined_pushes_total")
+
+    # -- directive channel (docs/ROBUSTNESS.md "Self-healing") ---------------
+
+    def post_directive(self, worker_id: int, action: str,
+                       **params) -> int | None:
+        """Queue a server->worker directive; returns its seq, or None when
+        the worker never advertised the capability (legacy peer — the
+        caller records the remediation as skipped, training untouched).
+        Delivery is at-least-once: the directive rides every fetch/push
+        reply to that worker until acked; the client dedupes by seq."""
+        if action not in DIRECTIVE_CATALOG:
+            raise ValueError(f"unknown directive {action!r} (catalog: "
+                             f"{sorted(DIRECTIVE_CATALOG)})")
+        wid = int(worker_id)
+        with self._directive_lock:
+            if wid not in self._directive_capable:
+                return None
+            self._directive_seq += 1
+            seq = self._directive_seq
+            box = self._directives.setdefault(wid, [])
+            box.append({"seq": seq, "action": action, **params})
+            del box[:-DIRECTIVES_PER_WORKER_CAP]
+        return seq
+
+    def directives_for(self, worker_id) -> list[dict]:
+        with self._directive_lock:
+            return [dict(d) for d in self._directives.get(worker_id, [])]
+
+    def _note_ack(self, worker_id, meta: dict) -> None:
+        ack = meta.get("directives_ack")
+        if ack is None:
+            return
+        try:
+            ack = int(ack)
+        except (TypeError, ValueError):
+            return
+        with self._directive_lock:
+            box = self._directives.get(worker_id)
+            if box:
+                box[:] = [d for d in box if d["seq"] > ack]
+
+    def _directive_fields(self, worker_id, meta: dict) -> dict:
+        """Reply-meta fields for the directive channel: process the
+        request's ack, then attach whatever is still outstanding."""
+        if worker_id is None:
+            return {}
+        self._note_ack(worker_id, meta)
+        out = self.directives_for(worker_id)
+        return {"directives": out} if out else {}
+
+    # -- server-side push quarantine (remediation action) --------------------
+
+    def quarantine(self, worker_id: int, seconds: float) -> None:
+        """Refuse this worker's pushes (acknowledged, never applied) for
+        ``seconds`` — the server-side half of the quarantine remediation;
+        works even against legacy workers that can't hear the directive."""
+        with self._directive_lock:
+            self._quarantined[int(worker_id)] = time.time() + float(seconds)
+
+    def unquarantine(self, worker_id: int) -> None:
+        with self._directive_lock:
+            self._quarantined.pop(int(worker_id), None)
+
+    def is_quarantined(self, worker_id) -> bool:
+        with self._directive_lock:
+            until = self._quarantined.get(worker_id)
+            if until is None:
+                return False
+            if time.time() >= until:
+                del self._quarantined[worker_id]
+                return False
+            return True
+
+    def quarantine_view(self) -> dict[int, float]:
+        """worker id -> seconds remaining (for /cluster)."""
+        now = time.time()
+        with self._directive_lock:
+            return {w: round(until - now, 3)
+                    for w, until in self._quarantined.items()
+                    if until > now}
+
+    # -- activity-coupled membership expiry ----------------------------------
+
+    def _expire_tick(self) -> None:
+        """Run membership expiry on push/registration activity, throttled,
+        so an elastic round stalled on a dead worker unsticks as soon as a
+        LIVE worker shows up — not a full serve-loop/timer interval later.
+        The reaped ids feed the monitor exactly like the serve loop's."""
+        timeout = getattr(self.store.config, "worker_timeout", None)
+        if not timeout:
+            return
+        now = time.time()
+        if now - self._last_expire_check < min(1.0, timeout / 4.0):
+            return
+        self._last_expire_check = now
+        try:
+            expired = self.store.expire_stale_workers()
+        except Exception:  # noqa: BLE001 — expiry must not fail the RPC
+            return
+        if expired:
+            print(f"expired silent workers: {expired}", flush=True)
+            if self.monitor is not None:
+                try:
+                    self.monitor.note_expired(expired)
+                except Exception:  # noqa: BLE001
+                    pass
 
     # -- RPC bodies (request bytes -> reply bytes) --------------------------
 
@@ -181,8 +344,27 @@ class ParameterService:
 
     def register_worker(self, request: bytes, ctx) -> bytes:
         meta, _ = unpack_msg(request)
+        self._expire_tick()
         worker_id, total = self.store.register_worker(
             meta.get("worker_name", ""))
+        # Directive capability is advertised by the WORKER (the directives
+        # flow server->worker, so the server must know the peer can act on
+        # them): legacy clients send no capabilities list and their
+        # replies never carry directives — training untouched.
+        caps = meta.get("capabilities")
+        capable = isinstance(caps, (list, tuple)) and "directives" in caps
+        with self._directive_lock:
+            # A reused id slot (elastic respawn) must not inherit its
+            # predecessor's undelivered directives, quarantine, or
+            # capability — unconditionally: a LEGACY replacement must not
+            # stay quarantined for its predecessor's sins, nor keep
+            # accepting posts it will never hear.
+            self._directives.pop(worker_id, None)
+            self._quarantined.pop(worker_id, None)
+            if capable:
+                self._directive_capable.add(worker_id)
+            else:
+                self._directive_capable.discard(worker_id)
         return pack_msg({
             "worker_id": worker_id,
             "total_workers": total,
@@ -227,6 +409,12 @@ class ParameterService:
             # pushing fp16/int8 with their own scales.
             "compressed_domain": bool(getattr(
                 self.store, "supports_compressed_domain", False)),
+            # Directive-channel capability (docs/ROBUSTNESS.md): this
+            # server may attach control directives to fetch/push reply
+            # meta. Clients that advertised the capability above attach
+            # acks and act on them; every other pairing degrades to a
+            # directive-less wire.
+            "directives": True,
             **self._qscale_fields(),
             **self._membership_fields(),
         })
@@ -249,6 +437,20 @@ class ParameterService:
         meta, payload = unpack_msg(request)
         wid = int(meta["worker_id"])
         self._ingest_health(wid, meta)
+        self._expire_tick()
+        health = meta.get("health")
+        nonfinite = (self.reject_nonfinite and isinstance(health, dict)
+                     and (health.get("loss_finite") is False
+                          or health.get("grad_finite") is False))
+        # Remediation quarantine (plus its synchronous nonfinite half: a
+        # push whose OWN report flags poison). Evaluated here but gated
+        # AFTER the dedupe lookup below — a retry of a token whose
+        # original was already APPLIED must replay the journaled outcome
+        # even while its worker is quarantined, or the exactly-once reply
+        # contract lies to the reconcile path. Only NEW pushes are
+        # refused, and without recording an entry, so the same token
+        # retried after the quarantine lifts applies normally.
+        blocked = nonfinite or self.is_quarantined(wid)
         token = meta.get("push_token")
         entry = None
         if token is not None:
@@ -259,14 +461,17 @@ class ParameterService:
                     dup, stale = prev, count < prev[0]
                 else:
                     # New push (or the first with a HIGHER count): record
-                    # it. A lower count never replaces a higher one — the
-                    # branch above already routed it away.
+                    # it — unless quarantine refuses it below. A lower
+                    # count never replaces a higher one — the branch
+                    # above already routed it away.
                     dup, stale = None, False
-                    entry = [count, None, threading.Event(), wid, None]
-                    self._push_seen[nonce] = entry
-                    self._push_seen.move_to_end(nonce)
-                    while len(self._push_seen) > PUSH_SEEN_CAP:
-                        self._push_seen.popitem(last=False)
+                    if not blocked:
+                        entry = [count, None, threading.Event(), wid,
+                                 None]
+                        self._push_seen[nonce] = entry
+                        self._push_seen.move_to_end(nonce)
+                        while len(self._push_seen) > PUSH_SEEN_CAP:
+                            self._push_seen.popitem(last=False)
             if dup is not None:
                 if stale:
                     # ZOMBIE: a deadline-expired attempt executing after
@@ -309,6 +514,16 @@ class ParameterService:
                     "received": True, "accepted": bool(dup[1]),
                     "duplicate": True,
                     "global_step": self.store.global_step})
+        if blocked:
+            # Quarantine refusal for a NEW push: acknowledge (the worker
+            # must not die retrying) but never apply — a suspected-
+            # poisoned worker's gradients stay out of the aggregate even
+            # when the peer is too old to hear the quarantine directive.
+            self._tm_quarantined.inc()
+            return pack_msg({"received": True, "accepted": False,
+                             "quarantined": True,
+                             "global_step": self.store.global_step,
+                             **self._directive_fields(wid, meta)})
         grads = decode_tensor_dict(payload)
         accepted = False
         try:
@@ -323,7 +538,8 @@ class ParameterService:
                 entry[4] = self.store.global_step
                 entry[2].set()
         return pack_msg({"received": True, "accepted": accepted,
-                         "global_step": self.store.global_step})
+                         "global_step": self.store.global_step,
+                         **self._directive_fields(wid, meta)})
 
     # -- durable push-token journal (docs/ROBUSTNESS.md) ---------------------
 
@@ -385,6 +601,7 @@ class ParameterService:
         # never send have_qscales and never pay for a table they ignore.
         qfields = self._qscale_fields(meta["have_qscales"]) \
             if "have_qscales" in meta else {}
+        dfields = self._directive_fields(wid, meta)
         if have is not None \
                 and getattr(self.store, "supports_delta_fetch", False):
             params, step = self.store.fetch(wid, have_step=int(have))
@@ -394,10 +611,11 @@ class ParameterService:
                 # header instead of the full model (the straggler-wait /
                 # polling fetch win; docs/WIRE_PROTOCOL.md).
                 return pack_msg({"global_step": step, "not_modified": True,
-                                 **qfields, **self._membership_fields()})
+                                 **qfields, **dfields,
+                                 **self._membership_fields()})
         else:
             params, step = self.store.fetch(wid)
-        return pack_msg({"global_step": step, **qfields,
+        return pack_msg({"global_step": step, **qfields, **dfields,
                          **self._membership_fields()},
                         encode_tensor_dict(params))
 

@@ -16,6 +16,7 @@ from .semantics import (
 )
 from .device_store import DeviceParameterStore
 from .store import ParameterStore, StoreConfig
+from .supervisor import SupervisorConfig, WorkerSupervisor
 from .worker import PSWorker, WorkerConfig, WorkerResult, run_workers
 
 
@@ -37,6 +38,8 @@ __all__ = [
     "DeviceParameterStore",
     "make_store",
     "StoreConfig",
+    "SupervisorConfig",
+    "WorkerSupervisor",
     "PSWorker",
     "WorkerConfig",
     "WorkerResult",

@@ -129,6 +129,7 @@ class DeviceParameterStore(AggregationBase):
         self.stats = _Stats()
         self._finished_event = threading.Event()
         self._init_telemetry()
+        self._init_round_state()
 
     # -- hot path ------------------------------------------------------------
 
@@ -174,9 +175,10 @@ class DeviceParameterStore(AggregationBase):
             with trace_span("store.push",
                             backend=self.store_backend) as sp:
                 if self.config.mode == "sync":
-                    self._push_sync(worker_id, dict(gradients))
-                    sp.attrs["accepted"] = True
-                    return True
+                    accepted = self._push_sync(worker_id, dict(gradients),
+                                               fetched_step)
+                    sp.attrs["accepted"] = accepted
+                    return accepted
                 accepted = self._push_async(worker_id, dict(gradients),
                                             fetched_step)
                 sp.attrs["accepted"] = accepted

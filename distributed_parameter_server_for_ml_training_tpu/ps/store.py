@@ -28,6 +28,7 @@ fetch: server.py:222 pickles fp32).
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from collections import deque
@@ -104,6 +105,20 @@ class StoreConfig:
     #   - expiry purges the dead worker's pending gradients and completes
     #     the round if the survivors already cover it.
     elastic: bool = False
+    # Quorum rounds (self-healing, docs/ROBUSTNESS.md): a sync round
+    # completes once this many DISTINCT workers of the live round target
+    # have pushed — an int >= 1 is an absolute count, 0 < f < 1 a fraction
+    # of the target (ceil) — instead of waiting for every worker. One
+    # slow-but-alive straggler then costs the round nothing; its late
+    # push reconciles through the async staleness semantics (weighted
+    # apply, bounded) rather than blocking the barrier or polluting the
+    # next round. None keeps the full barrier (reference behavior).
+    sync_quorum: float | None = None
+    # Per-round deadline in seconds, armed when the round's FIRST gradient
+    # lands: when it fires, the round completes with whatever has arrived
+    # (>= 1 contribution). Composable with sync_quorum (whichever trips
+    # first); None disables.
+    round_deadline: float | None = None
 
     def __post_init__(self):
         if self.mode not in ("sync", "async"):
@@ -115,6 +130,27 @@ class StoreConfig:
         if self.fetch_codec not in ("none", "fp16", "bf16"):
             raise ValueError(f"fetch_codec must be none|fp16|bf16, got "
                              f"{self.fetch_codec!r}")
+        if self.sync_quorum is not None:
+            q = float(self.sync_quorum)
+            if q <= 0:
+                raise ValueError(f"sync_quorum must be > 0, got {q}")
+            if q >= 1.0 and q != int(q):
+                raise ValueError(
+                    f"sync_quorum >= 1 is a worker COUNT and must be "
+                    f"whole, got {q} (use a value < 1 for a fraction)")
+        if self.round_deadline is not None and self.round_deadline <= 0:
+            raise ValueError(
+                f"round_deadline must be > 0 seconds, got "
+                f"{self.round_deadline}")
+        if self.sync_quorum is not None or self.round_deadline is not None:
+            # Quorum counting must count DISTINCT workers — under the
+            # faithful quirk-3 semantics (overwrite the entry, increment
+            # the counter anyway) ONE worker double-pushing could satisfy
+            # a 2-worker quorum alone and the round would aggregate a
+            # single contribution. Quorum therefore implies the corrected
+            # strict_rounds accounting (regression-pinned in
+            # tests/test_selfheal.py).
+            self.strict_rounds = True
 
 
 @dataclass
@@ -186,10 +222,17 @@ class MembershipMixin:
         registration lock — callers hold only the sync lock, and a racing
         register/expire must not yield a torn count; lock order sync ->
         registration is safe because no path takes them the other way
-        round)."""
+        round). Workers quorum-EXCLUDED by the remediation layer
+        (``exclude_worker``) leave the target either way — rounds stop
+        waiting for them, their own pushes still land."""
+        excluded = getattr(self, "_excluded", None)
         if getattr(self.config, "elastic", False):
             with self._registration_lock:
+                if excluded:
+                    return max(1, len(self.active_workers - excluded))
                 return max(1, len(self.active_workers))
+        if excluded:
+            return max(1, self.config.total_workers - len(excluded))
         return self.config.total_workers
 
     def _on_workers_expired(self, stale: list[int]) -> None:
@@ -261,6 +304,19 @@ class TelemetryMixin:
         # compressed-domain aggregation fast path, live.
         self._tm_compressed = reg.counter(
             "dps_store_compressed_accum_total", backend=b)
+        # Self-healing round surface (docs/ROBUSTNESS.md): what closed
+        # each sync round (full barrier / quorum / deadline expiry),
+        # stragglers' late pushes reconciled via the staleness path, and
+        # the live quorum-exclusion set size.
+        self._tm_round_trigger = {
+            trig: reg.counter("dps_store_round_completions_total",
+                              backend=b, trigger=trig)
+            for trig in ("full", "quorum", "deadline")
+        }
+        self._tm_late = reg.counter("dps_store_late_pushes_total",
+                                    backend=b)
+        self._tm_excluded = reg.gauge("dps_store_excluded_workers",
+                                      backend=b)
 
 
 class AggregationBase(TelemetryMixin, MembershipMixin):
@@ -287,6 +343,16 @@ class AggregationBase(TelemetryMixin, MembershipMixin):
         """Apply p -= lr*weight*g to self.parameters (no locking here)."""
         raise NotImplementedError
 
+    def _init_round_state(self) -> None:
+        """Quorum-round bookkeeping (called from each concrete __init__
+        alongside ``_init_telemetry``): the exclusion set the remediation
+        layer edits, the round serial that fences stale deadline timers,
+        and the armed timer itself."""
+        self._excluded: set[int] = set()
+        self._round_serial = 0
+        self._deadline_timer: threading.Timer | None = None
+        self._last_round_trigger: str | None = None
+
     def _after_apply(self):
         """Hook after an update is issued. Return contract: anything but
         ``False`` means the hook synchronized with (or is) the real
@@ -304,9 +370,32 @@ class AggregationBase(TelemetryMixin, MembershipMixin):
             self._apply(mean, lr)
             self.global_step += 1
 
-    def _push_sync(self, worker_id: int, grads: dict) -> None:
-        """server.py:264-288: stash under sync_lock; when the round is full,
-        mean + apply + reset. No barrier — returns immediately."""
+    def _quorum_mode(self) -> bool:
+        return (getattr(self.config, "sync_quorum", None) is not None
+                or getattr(self.config, "round_deadline", None) is not None)
+
+    def _quorum_target(self, full: int) -> int:
+        """Contributions that complete a round: the full target, or the
+        configured quorum (count, or ceil of a fraction of the target),
+        clamped to [1, full]."""
+        q = getattr(self.config, "sync_quorum", None)
+        if q is None:
+            return full
+        q = float(q)
+        n = math.ceil(q * full - 1e-9) if q < 1.0 else int(q)
+        return max(1, min(full, n))
+
+    def _push_sync(self, worker_id: int, grads: dict,
+                   fetched_step: int | None = None) -> bool:
+        """server.py:264-288: stash under sync_lock; when the round hits
+        its (quorum) target, mean + apply + reset. No barrier — returns
+        immediately. In quorum mode a LATE push — one whose basis round
+        already closed under quorum/deadline — reconciles through the
+        async staleness semantics instead of being stashed against a
+        stale basis (docs/ROBUSTNESS.md)."""
+        if self._quorum_mode() and fetched_step is not None \
+                and fetched_step < self.global_step:
+            return self._push_late(worker_id, grads, fetched_step)
         with self._sync_lock:
             if self.config.strict_rounds:
                 # Corrected semantics: count distinct workers.
@@ -317,59 +406,184 @@ class AggregationBase(TelemetryMixin, MembershipMixin):
                 # increment the count anyway.
                 self._pending[worker_id] = grads
                 self._gradients_received += 1
+            self._arm_deadline_locked()
             finish = self._maybe_complete_round_locked()
             self.stats.gradients_processed += 1
         self._tm_push_ok.inc()
         if finish is not None:
             finish()
+        return True
+
+    def _push_late(self, worker_id: int, grads: dict,
+                   fetched_step: int) -> bool:
+        """A straggler's push that missed its round (quorum/deadline
+        completed it): apply it through the existing async staleness
+        semantics — down-weighted immediate apply, rejected past the
+        staleness bound — so the contribution is neither double-counted
+        into the next round nor silently dropped."""
+        self._tm_late.inc()
+        if is_quantized_payload(grads):
+            # The compressed-domain hold-as-is path is a round
+            # optimization; a late single-payload apply needs fp32.
+            grads = wire_decompress(grads)
+        return self._push_async(worker_id, grads, fetched_step)
+
+    def _arm_deadline_locked(self) -> None:
+        """Arm the per-round deadline timer on the round's first gradient
+        (caller holds ``_sync_lock``). The timer captures the round
+        serial, so a stale timer firing after its round completed is a
+        no-op."""
+        deadline = getattr(self.config, "round_deadline", None)
+        if not deadline or self._deadline_timer is not None \
+                or not self._gradients_received:
+            return
+        t = threading.Timer(deadline, self._round_deadline_fired,
+                            args=(self._round_serial,))
+        t.daemon = True
+        self._deadline_timer = t
+        t.start()
+
+    def _round_deadline_fired(self, serial: int) -> None:
+        """Deadline expiry: complete the round with whatever arrived.
+        Fenced by the round serial — if the round already completed (and
+        reset the serial forward), this timer is stale and does nothing."""
+        with self._sync_lock:
+            if serial != self._round_serial:
+                return
+            self._deadline_timer = None
+            finish = (self._complete_round_locked("deadline")
+                      if self._gradients_received else None)
+        if finish is not None:
+            finish()
+
+    def _cancel_deadline_locked(self) -> None:
+        t, self._deadline_timer = self._deadline_timer, None
+        if t is not None:
+            t.cancel()
 
     def _maybe_complete_round_locked(self):
-        """Aggregate + apply + reset if the round reached its target
-        (caller holds ``_sync_lock``). Returns None, or a completion
-        callable the CALLER must invoke AFTER releasing the sync lock —
-        it waits for the device (``_after_apply``) and records the update
-        time. Waiting under the lock convoyed every other worker's push
-        behind the ~100 ms device round trip each round (round-2 VERDICT
-        weak item 3); the update itself (dispatch + step bump) stays
-        inside, so ordering and staleness accounting are unchanged."""
-        if self._gradients_received >= self._round_target():
-            t0 = time.time()
-            try:
-                # The apply span parents on the handler/worker span of the
-                # push that COMPLETED the round — the causally responsible
-                # step (trace context is thread-local; the last pusher's
-                # thread runs the aggregation).
-                with trace_span("store.apply", backend=self.store_backend,
-                                mode="sync",
-                                n_grads=self._gradients_received):
-                    self._round_update(list(self._pending.values()),
-                                       self.config.learning_rate)
-                self.stats.total_parameter_updates += 1
-            finally:
-                # The round MUST reset even if aggregation raises —
-                # otherwise every later push re-triggers the failure and
-                # the server is wedged permanently.
-                self._pending.clear()
-                self._gradients_received = 0
-            self._tm_rounds.inc()
-            self._tm_step.set(self.global_step)
-
-            def finish() -> None:
-                # _after_apply may decline to sync (sampled waits on the
-                # device store) — only record a timing that measured real
-                # completion, not async dispatch. The telemetry histogram
-                # mirrors the same honesty rule.
-                if self._after_apply() is not False:
-                    dt = time.time() - t0
-                    self.stats.update_times.append(dt)
-                    self._tm_apply_s.observe(dt)
-
-            return finish
+        """Complete the round if it reached its (quorum) target (caller
+        holds ``_sync_lock``); see :meth:`_complete_round_locked` for the
+        returned completion callable."""
+        full = self._round_target()
+        if self._gradients_received >= self._quorum_target(full):
+            trigger = ("full" if self._gradients_received >= full
+                       else "quorum")
+            return self._complete_round_locked(trigger)
         return None
+
+    def _complete_round_locked(self, trigger: str):
+        """Aggregate + apply + reset (caller holds ``_sync_lock``).
+        Returns a completion callable the CALLER must invoke AFTER
+        releasing the sync lock — it waits for the device
+        (``_after_apply``) and records the update time. Waiting under the
+        lock convoyed every other worker's push behind the ~100 ms device
+        round trip each round (round-2 VERDICT weak item 3); the update
+        itself (dispatch + step bump) stays inside, so ordering and
+        staleness accounting are unchanged."""
+        t0 = time.time()
+        try:
+            # The apply span parents on the handler/worker span of the
+            # push that COMPLETED the round — the causally responsible
+            # step (trace context is thread-local; the last pusher's
+            # thread runs the aggregation).
+            with trace_span("store.apply", backend=self.store_backend,
+                            mode="sync",
+                            n_grads=self._gradients_received):
+                self._round_update(list(self._pending.values()),
+                                   self.config.learning_rate)
+            self.stats.total_parameter_updates += 1
+        finally:
+            # The round MUST reset even if aggregation raises —
+            # otherwise every later push re-triggers the failure and
+            # the server is wedged permanently.
+            self._pending.clear()
+            self._gradients_received = 0
+            self._round_serial += 1
+            self._cancel_deadline_locked()
+            self._last_round_trigger = trigger
+        self._tm_rounds.inc()
+        tm = self._tm_round_trigger.get(trigger)
+        if tm is not None:
+            tm.inc()
+        self._tm_step.set(self.global_step)
+
+        def finish() -> None:
+            # _after_apply may decline to sync (sampled waits on the
+            # device store) — only record a timing that measured real
+            # completion, not async dispatch. The telemetry histogram
+            # mirrors the same honesty rule.
+            if self._after_apply() is not False:
+                dt = time.time() - t0
+                self.stats.update_times.append(dt)
+                self._tm_apply_s.observe(dt)
+
+        return finish
+
+    # -- remediation hooks (telemetry/remediation.py) ------------------------
+
+    def exclude_worker(self, worker_id: int) -> None:
+        """Quorum-exclude a worker (straggler remediation): rounds stop
+        waiting for it — it leaves the round target and the quorum
+        denominator — while its own pushes still land (on-time ones count
+        toward the round, late ones reconcile via staleness). Re-evaluates
+        the pending round, since shrinking the target may complete it."""
+        with self._registration_lock:
+            self._excluded.add(int(worker_id))
+            n = len(self._excluded)
+        self._tm_excluded.set(n)
+        with self._sync_lock:
+            finish = (self._maybe_complete_round_locked()
+                      if self._gradients_received else None)
+        if finish is not None:
+            finish()
+
+    def include_worker(self, worker_id: int) -> None:
+        """Lift a quorum exclusion (the straggler caught up / its alert
+        resolved): the worker counts toward round targets again."""
+        with self._registration_lock:
+            self._excluded.discard(int(worker_id))
+            n = len(self._excluded)
+        self._tm_excluded.set(n)
+
+    def excluded_workers(self) -> list[int]:
+        with self._registration_lock:
+            return sorted(self._excluded)
+
+    def round_status(self) -> dict:
+        """Live sync-round/quorum state for ``GET /cluster`` and
+        ``cli status`` (docs/ROBUSTNESS.md): target vs received, who has
+        pushed, who is excluded, and what closed the last round."""
+        with self._sync_lock:
+            received = self._gradients_received
+            pending = sorted(self._pending)
+            serial = self._round_serial
+            armed = self._deadline_timer is not None
+            trigger = self._last_round_trigger
+        full = self._round_target()
+        return {
+            "mode": self.config.mode,
+            "target": full,
+            "quorum": self._quorum_target(full),
+            "received": received,
+            "pushed_workers": pending,
+            "excluded": self.excluded_workers(),
+            "round_serial": serial,
+            "deadline_s": getattr(self.config, "round_deadline", None),
+            "deadline_armed": armed,
+            "last_trigger": trigger,
+        }
 
     def _on_workers_expired(self, stale: list[int]) -> None:
         """Elastic: purge DEAD workers' pending gradients and complete the
-        round if the survivors already cover the reduced target."""
+        round if the survivors already cover the reduced target. An
+        expired worker also leaves the exclusion set — if it returns
+        (respawn reuses its slot), the replacement starts unexcluded."""
+        if self._excluded:
+            with self._registration_lock:
+                self._excluded.difference_update(stale)
+                n = len(self._excluded)
+            self._tm_excluded.set(n)
         if not getattr(self.config, "elastic", False):
             return
         with self._sync_lock:
@@ -385,6 +599,8 @@ class AggregationBase(TelemetryMixin, MembershipMixin):
     def _on_worker_departed(self, worker_id: int) -> None:
         """Elastic: a clean departure only shrinks the round target — its
         own final push (if any) stays in the round."""
+        if self._excluded:
+            self.include_worker(worker_id)
         if not getattr(self.config, "elastic", False):
             return
         with self._sync_lock:
@@ -536,6 +752,7 @@ class ParameterStore(AggregationBase):
         self.stats = _Stats()
         self._finished_event = threading.Event()
         self._init_telemetry()
+        self._init_round_state()
 
     @property
     def push_codec(self) -> str:
@@ -711,8 +928,7 @@ class ParameterStore(AggregationBase):
             self._tm_compressed.inc()
 
         if self.config.mode == "sync":
-            self._push_sync(worker_id, gradients)
-            return True
+            return self._push_sync(worker_id, gradients, fetched_step)
         return self._push_async(worker_id, gradients, fetched_step)
 
     # -- aggregation kernels (orchestration in AggregationBase) --------------

@@ -1,0 +1,309 @@
+"""Worker process supervisor: spawn N workers, respawn the ones that die.
+
+The missing rung of the self-healing ladder (docs/ROBUSTNESS.md): PR 4
+made a *surviving* worker ride through a server restart (session resume),
+and the cluster monitor can *detect* a dead one — but nothing brought a
+dead worker back. ``cli supervise`` runs this supervisor next to the
+worker processes (the place a process can actually be restarted):
+
+- spawns N ``cli worker`` children from one argv template, each with its
+  own ``--worker-name`` slot;
+- watches them; a child that exits 0 is done, a child that dies is
+  **respawned after exponential backoff** (``backoff_initial`` doubling to
+  ``backoff_max``; a child that stayed alive ``healthy_after`` seconds
+  resets its slot's backoff);
+- **crash-loop latch**: ``crash_loop_after`` consecutive fast deaths
+  (lived < ``healthy_after``) latch the slot — a worker that can never
+  come up stops burning respawns and the latch is visible in the status
+  and the ``crash_loop`` outcome counter;
+- each respawn (and latch) lands in
+  ``dps_remediation_actions_total{action="respawn",outcome}`` — the same
+  metric the server-side remediation engine uses, so the healing loop
+  reads as one system across processes — plus greppable
+  ``SUPERVISOR_RESPAWN`` / ``SUPERVISOR_CRASH_LOOP`` log lines.
+
+The respawned process re-registers through the ordinary lifecycle: under
+``--elastic`` + ``--worker-timeout`` it takes the dead session's freed id
+slot (and therefore its data shard), and the PR 4 push-token journal
+dedupes any pre-death push retry — the supervisor needs no protocol of its
+own. Chaos drills use per-slot **first-spawn-only** fault specs/env
+(``first_spawn_faults``/``first_spawn_env``): the injected ``push.kill``
+that proves the respawn path runs once, and the replacement runs clean.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+
+__all__ = ["SupervisorConfig", "WorkerSupervisor"]
+
+
+@dataclass
+class SupervisorConfig:
+    """Respawn discipline knobs (documented in docs/ROBUSTNESS.md)."""
+
+    respawn: bool = True
+    backoff_initial: float = 1.0
+    backoff_max: float = 30.0
+    #: A child alive at least this long counts as having come up: its
+    #: slot's backoff and crash-loop count reset.
+    healthy_after: float = 5.0
+    #: Consecutive fast deaths (lived < healthy_after) before the slot
+    #: latches as crash-looping and stops respawning.
+    crash_loop_after: int = 3
+    poll_interval: float = 0.2
+    #: SIGTERM -> SIGKILL grace when stopping children.
+    graceful_timeout: float = 10.0
+
+
+@dataclass
+class _Slot:
+    index: int
+    proc: subprocess.Popen | None = None
+    attempt: int = 0              # spawns so far (0 before the first)
+    started_ts: float = 0.0
+    backoff: float = 0.0
+    fast_crashes: int = 0
+    respawns: int = 0
+    last_rc: int | None = None
+    next_spawn_ts: float = 0.0    # backoff gate
+    done: bool = False            # exited 0 (or latched)
+    latched: bool = False
+
+
+class WorkerSupervisor:
+    """Spawn-and-babysit loop over N worker subprocess slots.
+
+    ``argv_for(slot_index, attempt)`` returns ``(argv, env_overrides)``
+    for one spawn — ``env_overrides`` (or None) is merged over
+    ``os.environ``. The builder sees the attempt number, so chaos drills
+    can inject faults into the first spawn only.
+    """
+
+    def __init__(self, argv_for, n_workers: int,
+                 config: SupervisorConfig | None = None,
+                 clock=time.monotonic, spawn=None,
+                 log=print):
+        if n_workers < 1:
+            raise ValueError("n_workers must be >= 1")
+        self.argv_for = argv_for
+        self.config = config or SupervisorConfig()
+        self.clock = clock
+        self.log = log
+        self._spawn_fn = spawn or self._default_spawn
+        self.slots = [_Slot(index=i) for i in range(n_workers)]
+        self._stop = threading.Event()
+        from ..telemetry import get_registry
+        reg = get_registry()
+        self._tm_children = reg.gauge("dps_supervisor_children")
+        # The respawn half of dps_remediation_actions_total lives here —
+        # the supervisor is the process that can actually restart one.
+        from ..telemetry.remediation import note_action
+        self._note_action = note_action
+
+    @staticmethod
+    def _default_spawn(argv, env):
+        full_env = dict(os.environ)
+        if env:
+            full_env.update({k: str(v) for k, v in env.items()})
+        return subprocess.Popen(argv, env=full_env)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def start(self) -> None:
+        """Initial spawn of every slot."""
+        for slot in self.slots:
+            self._spawn(slot)
+        self._tm_children.set(self.running_count())
+
+    def _spawn(self, slot: _Slot) -> None:
+        argv, env = self._normalize(self.argv_for(slot.index, slot.attempt))
+        slot.proc = self._spawn_fn(argv, env)
+        slot.started_ts = self.clock()
+        slot.attempt += 1
+        self.log(f"SUPERVISOR_SPAWN slot={slot.index} "
+                 f"attempt={slot.attempt} pid={getattr(slot.proc, 'pid', '?')}",
+                 flush=True)
+
+    @staticmethod
+    def _normalize(built):
+        if isinstance(built, tuple):
+            argv, env = built
+            return list(argv), env
+        return list(built), None
+
+    def poll_once(self) -> None:
+        """One supervision pass: reap exits, schedule/execute respawns."""
+        now = self.clock()
+        cfg = self.config
+        for slot in self.slots:
+            if slot.done:
+                continue
+            if slot.proc is not None:
+                rc = slot.proc.poll()
+                if rc is None:
+                    if slot.fast_crashes \
+                            and now - slot.started_ts >= cfg.healthy_after:
+                        # Came up for real: the slot earned its reset.
+                        slot.fast_crashes = 0
+                        slot.backoff = 0.0
+                    continue
+                # Child exited.
+                lived = now - slot.started_ts
+                slot.last_rc = rc
+                slot.proc = None
+                if rc == 0:
+                    slot.done = True
+                    self.log(f"SUPERVISOR_DONE slot={slot.index} rc=0",
+                             flush=True)
+                    continue
+                if not cfg.respawn:
+                    slot.done = True
+                    self.log(f"SUPERVISOR_EXIT slot={slot.index} rc={rc} "
+                             f"(respawn disabled)", flush=True)
+                    continue
+                if lived < cfg.healthy_after:
+                    slot.fast_crashes += 1
+                    # Latch AT crash_loop_after consecutive fast crashes
+                    # (what the flag help and docs promise — not one
+                    # extra).
+                    if slot.fast_crashes >= cfg.crash_loop_after:
+                        slot.latched = True
+                        slot.done = True
+                        self._note_action("respawn", "crash_loop")
+                        self.log(f"SUPERVISOR_CRASH_LOOP slot={slot.index} "
+                                 f"rc={rc} fast_crashes={slot.fast_crashes}"
+                                 f" (latched, no further respawns)",
+                                 flush=True)
+                        continue
+                else:
+                    slot.fast_crashes = 0
+                    slot.backoff = 0.0
+                slot.backoff = (cfg.backoff_initial if slot.backoff <= 0
+                                else min(slot.backoff * 2.0,
+                                         cfg.backoff_max))
+                slot.next_spawn_ts = now + slot.backoff
+                self.log(f"SUPERVISOR_CHILD_DIED slot={slot.index} rc={rc} "
+                         f"lived={lived:.1f}s respawn_in={slot.backoff:.1f}s",
+                         flush=True)
+                continue
+            # No process: a respawn is pending its backoff.
+            if now >= slot.next_spawn_ts:
+                slot.respawns += 1
+                self._spawn(slot)
+                self._note_action("respawn", "ok")
+                self.log(f"SUPERVISOR_RESPAWN slot={slot.index} "
+                         f"attempt={slot.attempt} "
+                         f"after_rc={slot.last_rc}", flush=True)
+        self._tm_children.set(self.running_count())
+
+    def run(self) -> int:
+        """Supervise until every slot is done. Exit code: 0 when all
+        slots finished cleanly, 1 when any latched as crash-looping or
+        ended on a nonzero rc with respawn disabled."""
+        try:
+            while not self._stop.is_set():
+                self.poll_once()
+                if all(s.done for s in self.slots):
+                    break
+                self._stop.wait(self.config.poll_interval)
+        finally:
+            self.stop()
+        # A slot only ends on a nonzero rc by latching (respawn on) or by
+        # dying with respawn disabled — either way the run is degraded.
+        bad = [s for s in self.slots
+               if s.latched or (s.done and s.last_rc not in (0, None))]
+        latched = [s.index for s in self.slots if s.latched]
+        if latched:
+            self.log(f"SUPERVISOR_EXIT latched_slots={latched}",
+                     flush=True)
+        return 1 if bad else 0
+
+    def stop(self) -> None:
+        """Terminate every running child (SIGTERM, then SIGKILL after the
+        grace window)."""
+        self._stop.set()
+        procs = [s.proc for s in self.slots if s.proc is not None]
+        for p in procs:
+            try:
+                p.terminate()
+            except OSError:
+                pass
+        deadline = time.time() + self.config.graceful_timeout
+        for p in procs:
+            try:
+                p.wait(timeout=max(0.1, deadline - time.time()))
+            except subprocess.TimeoutExpired:
+                try:
+                    p.kill()
+                    p.wait(timeout=5)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+        self._tm_children.set(0)
+
+    # -- read side ------------------------------------------------------------
+
+    def running_count(self) -> int:
+        return sum(1 for s in self.slots
+                   if s.proc is not None and s.proc.poll() is None)
+
+    def status(self) -> dict:
+        return {
+            "slots": [{
+                "slot": s.index,
+                "running": s.proc is not None and s.proc.poll() is None,
+                "pid": getattr(s.proc, "pid", None) if s.proc else None,
+                "attempt": s.attempt,
+                "respawns": s.respawns,
+                "fast_crashes": s.fast_crashes,
+                "last_rc": s.last_rc,
+                "latched": s.latched,
+                "done": s.done,
+            } for s in self.slots],
+            "running": self.running_count(),
+        }
+
+
+def install_signal_stop(supervisor: WorkerSupervisor) -> None:
+    """SIGTERM/SIGINT -> stop children then exit (cli supervise).
+    Installed only on the main thread; no-op elsewhere."""
+    if threading.current_thread() is not threading.main_thread():
+        return
+
+    def _handler(signum, frame):  # noqa: ARG001
+        supervisor.stop()
+        raise SystemExit(128 + signum)
+
+    signal.signal(signal.SIGTERM, _handler)
+    signal.signal(signal.SIGINT, _handler)
+
+
+def build_worker_argv(base_args: list[str], slot: int,
+                      first_spawn_faults: dict[int, str] | None = None,
+                      first_spawn_env: dict[int, dict] | None = None,
+                      attempt: int = 0,
+                      python: str | None = None) -> tuple[list, dict | None]:
+    """cli supervise's argv builder: one ``cli worker`` command line per
+    (slot, attempt). ``base_args`` is everything the operator wrote after
+    ``--``, passed to every child verbatim; the slot's ``--worker-name``
+    is appended unless already present. First-spawn-only fault specs and
+    env vars implement the chaos drills (the respawned replacement runs
+    clean)."""
+    pkg = __name__.rsplit(".", 2)[0]
+    argv = [python or sys.executable, "-m", f"{pkg}.cli", "worker"]
+    argv += list(base_args)
+    if "--worker-name" not in base_args:
+        argv += ["--worker-name", f"sup-w{slot}"]
+    env = None
+    if attempt == 0:
+        spec = (first_spawn_faults or {}).get(slot)
+        if spec:
+            argv += ["--faults", spec]
+        env = (first_spawn_env or {}).get(slot)
+    return argv, env

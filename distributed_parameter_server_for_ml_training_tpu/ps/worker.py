@@ -121,6 +121,11 @@ class WorkerResult:
     # Session resumes survived (server restarts / network partitions the
     # reconnect state machine rode through; docs/ROBUSTNESS.md).
     reconnects: int = 0
+    # Server->worker control directives acted on, by action name
+    # (docs/ROBUSTNESS.md "Self-healing"); empty when none arrived.
+    directives_applied: dict = field(default_factory=dict)
+    # Push windows skipped under a quarantine directive.
+    pushes_quarantined: int = 0
     # Client-side wire accounting (RemoteStore.wire_stats); empty for
     # in-process stores, which cross no wire.
     wire: dict = field(default_factory=dict)
@@ -130,10 +135,14 @@ class WorkerResult:
                 config: WorkerConfig) -> dict:
         """METRICS_JSON field parity with worker.py:421-434 (+ wire
         accounting when the store is remote)."""
+        out = self._base_metrics(total_workers, learning_rate, config)
+        if self.directives_applied:
+            out["directives_applied"] = dict(self.directives_applied)
+        if self.pushes_quarantined:
+            out["pushes_quarantined"] = self.pushes_quarantined
         if self.wire:
-            return {**self._base_metrics(total_workers, learning_rate,
-                                         config), **self.wire}
-        return self._base_metrics(total_workers, learning_rate, config)
+            out.update(self.wire)
+        return out
 
     def _base_metrics(self, total_workers: int, learning_rate: float,
                       config: WorkerConfig) -> dict:
@@ -443,6 +452,16 @@ class PSWorker(threading.Thread):
         self._ef: ErrorFeedback | None = None
         self._bitwidth: _BitwidthController | None = None
         self._prev_push_done: float | None = None
+        # Directive-channel state (docs/ROBUSTNESS.md "Self-healing"):
+        # server->worker directives arrive on fetch/push reply meta and
+        # are acted on at step boundaries by the training thread.
+        self._force_full_fetch = False     # refetch_params
+        self._quarantine_windows = 0       # quarantine: windows to skip
+        self._epoch_break = False          # rebalance_shard
+        self._draining = False             # drain
+        # Injected per-step compute slowdown (comms/faults.py COMPUTE_OP):
+        # set in _run from the store's fault injector, if any.
+        self._compute_faults = None
         ns = self.config.nan_inject_step
         if ns is None:
             import os as _os
@@ -594,6 +613,14 @@ class PSWorker(threading.Thread):
         self._tm_push_saved = reg.counter(
             "dps_worker_push_bytes_saved_total", worker=w)
         self._tm_push_bits = reg.gauge("dps_worker_push_bitwidth", worker=w)
+        # Server->worker directives acted on, one series per catalog
+        # action (docs/ROBUSTNESS.md "Self-healing").
+        from ..comms.service import DIRECTIVE_CATALOG
+        self._tm_directives = {
+            a: reg.counter("dps_worker_directives_total", worker=w,
+                           action=a)
+            for a in DIRECTIVE_CATALOG
+        }
 
     # -- worker health report (docs/OBSERVABILITY.md) ------------------------
 
@@ -666,6 +693,57 @@ class PSWorker(threading.Thread):
                                        else "")
             h.setdefault("heartbeat_errors", 0)
 
+    # -- directive channel (docs/ROBUSTNESS.md "Self-healing") ---------------
+
+    def _poll_directives(self) -> None:
+        """Drain and act on server->worker directives (step boundaries —
+        the places the loop already talks to the server). No-op against
+        stores without the channel (in-process, legacy servers)."""
+        take = getattr(self.store, "take_directives", None)
+        if not callable(take):
+            return
+        try:
+            directives = take()
+        except Exception:  # noqa: BLE001 — directives must not kill a run
+            return
+        for d in directives:
+            self._apply_directive(d)
+
+    def _apply_directive(self, d: dict) -> None:
+        action = d.get("action")
+        if action == "refetch_params":
+            # Drop the delta basis: the next boundary fetch is a full
+            # fresh fetch even if the step did not advance.
+            self._force_full_fetch = True
+        elif action == "quarantine":
+            try:
+                steps = max(1, int(d.get("steps", 3)))
+            except (TypeError, ValueError):
+                steps = 3
+            self._quarantine_windows = max(self._quarantine_windows, steps)
+            if self._ef is not None:
+                # The residual carry may hold the same poison the server
+                # quarantined us for — restart it clean.
+                self._ef = ErrorFeedback()
+            self._force_full_fetch = True
+        elif action == "rebalance_shard":
+            # Finish the current epoch early; the next epoch recomputes
+            # the shard from live membership (the per-epoch reshard the
+            # loop already does).
+            self._epoch_break = True
+        elif action == "drain":
+            self._draining = True
+        else:
+            return  # unknown directive from a newer server: ignore
+        self.result.directives_applied[action] = \
+            self.result.directives_applied.get(action, 0) + 1
+        tm = getattr(self, "_tm_directives", None)
+        if tm and action in tm:
+            tm[action].inc()
+        print(f"DIRECTIVE worker={self.worker_name} "
+              f"id={self.result.worker_id} action={action} "
+              f"seq={d.get('seq')}", flush=True)
+
     def _run(self) -> None:
         cfg = self.config
         worker_id, total_workers = self.store.register_worker(self.worker_name)
@@ -688,6 +766,13 @@ class PSWorker(threading.Thread):
                 and hasattr(self.store, "health_provider"):
             self.store.health_provider = self._health_snapshot
             self._health_enabled = True
+        # Injected compute slowdown (comms/faults.py 'compute' pseudo-op):
+        # the same --faults spec that drives RPC chaos can make THIS
+        # worker a deterministic straggler.
+        injector = getattr(self.store, "faults", None)
+        if injector is not None and hasattr(injector,
+                                            "maybe_delay_compute"):
+            self._compute_faults = injector
         if cfg.heartbeat_interval > 0:
             threading.Thread(
                 target=self._heartbeat_loop,
@@ -718,6 +803,7 @@ class PSWorker(threading.Thread):
         try:
             for epoch in range(cfg.num_epochs):
                 t_epoch = time.time()
+                self._epoch_break = False
                 # The epoch's first fetch happens BEFORE the shard
                 # computation: batch 0 is always a fetch boundary anyway
                 # (batch_idx % K == 0), and hoisting it means a REMOTE
@@ -801,6 +887,12 @@ class PSWorker(threading.Thread):
                                   f"worker={self.worker_name} local_step="
                                   f"{self.result.local_steps_completed}",
                                   flush=True)
+                        if self._compute_faults is not None:
+                            # Deterministic straggler injection: the sleep
+                            # lands inside the step timing, so the health
+                            # report's throughput and the straggler_lag
+                            # rule see it like real slow compute.
+                            self._compute_faults.maybe_delay_compute()
                         # Span = dispatch-to-return of the compiled step.
                         # Under jax async dispatch that can undercount
                         # device time on non-boundary batches; boundary
@@ -832,6 +924,13 @@ class PSWorker(threading.Thread):
                             params, fetched_step = self._dispatch_push(
                                 worker_id, grads, fetched_step, params)
                             worker_id = self.result.worker_id
+
+                    if self._draining or self._epoch_break:
+                        # Directive: stop this epoch's batch loop at the
+                        # step boundary (rebalance_shard resumes at the
+                        # next epoch with a fresh shard; drain exits the
+                        # run after the epoch bookkeeping below).
+                        break
 
                 # An epoch ending mid-window flushes the partial
                 # accumulator, divided by the ACTUAL number of accumulated
@@ -875,6 +974,10 @@ class PSWorker(threading.Thread):
                       f"epoch={epoch + 1}/{cfg.num_epochs} "
                       f"time={self.result.epoch_times[-1]:.1f}s{acc}",
                       flush=True)
+                if self._draining:
+                    print(f"DRAINED worker={self.worker_name} "
+                          f"id={worker_id} epoch={epoch + 1}", flush=True)
+                    break
         finally:
             if self._pipe is not None:
                 self._pipe.close()
@@ -1024,20 +1127,32 @@ class PSWorker(threading.Thread):
 
     def _boundary_fetch(self, worker_id: int, fetched_step: int, params):
         """The (pipeline-aware) boundary params fetch, resuming the
-        session on failure. Returns (params pytree, fetched step)."""
+        session on failure. Returns (params pytree, fetched step).
+        A pending ``refetch_params`` directive bypasses the delta basis
+        (and any prefetched result) with a full fresh fetch."""
         try:
             pipe = self._pipe
             if pipe is not None and pipe.params_pending():
                 # The prefetch issued right after the window's push — its
                 # latency ran under the window's compute instead of on
                 # the critical path.
-                return pipe.await_params()
-            if pipe is not None:
+                result = pipe.await_params()
+                if not self._force_full_fetch:
+                    self._poll_directives()
+                    if not self._force_full_fetch:
+                        return result
+            elif pipe is not None:
                 pipe.flush()  # a fetch must never overtake a push
-            return self._fetch_params(
-                worker_id,
-                have_step=fetched_step if params is not None else None,
-                current=params)
+            if self._force_full_fetch:
+                self._force_full_fetch = False
+                result = self._fetch_params(worker_id)
+            else:
+                result = self._fetch_params(
+                    worker_id,
+                    have_step=fetched_step if params is not None else None,
+                    current=params)
+            self._poll_directives()
+            return result
         except Exception as e:
             return self._recover_session(e)
 
@@ -1053,6 +1168,8 @@ class PSWorker(threading.Thread):
         way: the full push RPC when serial, the single-slot backpressure
         when overlapped (near zero while the pipeline keeps up — the
         overlap win, visible per step in the trace)."""
+        if self._skip_quarantined_push():
+            return params, fetched_step
         with trace_span("worker.push_wait"):
             try:
                 if self._pipe is None:
@@ -1060,12 +1177,15 @@ class PSWorker(threading.Thread):
                 else:
                     self._pipe.submit(grads_tree, fetched_step,
                                       prefetch_current=params)
+                self._poll_directives()
                 return params, fetched_step
             except Exception as e:
                 return self._recover_push(e, grads_tree, fetched_step)
 
     def _dispatch_push_mean(self, worker_id: int, accum_tree, n: int,
                             fetched_step: int, params):
+        if self._skip_quarantined_push():
+            return params, fetched_step
         with trace_span("worker.push_wait"):
             mean_tree = None
             try:
@@ -1075,11 +1195,22 @@ class PSWorker(threading.Thread):
                     mean_tree = _window_mean(accum_tree, n)
                     self._pipe.submit(mean_tree, fetched_step,
                                       prefetch_current=params)
+                self._poll_directives()
                 return params, fetched_step
             except Exception as e:
                 grads = mean_tree if mean_tree is not None \
                     else _window_mean(accum_tree, n)
                 return self._recover_push(e, grads, fetched_step)
+
+    def _skip_quarantined_push(self) -> bool:
+        """Quarantine directive: this window's push stays local (the
+        server refuses it anyway); the window counts down so training
+        resumes pushing automatically."""
+        if self._quarantine_windows <= 0:
+            return False
+        self._quarantine_windows -= 1
+        self.result.pushes_quarantined += 1
+        return True
 
     def _recover_push(self, exc, grads_tree, fetched_step: int):
         """Session recovery from a push dispatch. Serial case: THIS push

@@ -48,6 +48,12 @@ from .health import (
     HealthThresholds,
     WorkerState,
 )
+from .remediation import (
+    ACTION_CATALOG,
+    RemediationEngine,
+    RemediationPolicy,
+    note_action,
+)
 from .snapshot import SnapshotEmitter
 from .spans import now, span
 from .prometheus import render_prometheus, start_metrics_server
@@ -69,6 +75,7 @@ from .trace import (
 )
 
 __all__ = [
+    "ACTION_CATALOG",
     "Alert",
     "BYTES_BUCKETS",
     "ClusterMonitor",
@@ -82,6 +89,8 @@ __all__ = [
     "LATENCY_BUCKETS_S",
     "MetricsRegistry",
     "RULE_CATALOG",
+    "RemediationEngine",
+    "RemediationPolicy",
     "STALENESS_BUCKETS",
     "SPAN_CATALOG",
     "SnapshotEmitter",
@@ -97,6 +106,7 @@ __all__ = [
     "get_recorder",
     "get_registry",
     "install_shutdown_hooks",
+    "note_action",
     "now",
     "register_build_info",
     "remove_shutdown_flush",

@@ -157,6 +157,14 @@ class ClusterMonitor:
             (clock(), *self._push_totals())
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        # Alert edge-event listeners (the remediation engine attaches
+        # here, docs/ROBUSTNESS.md): called with each non-empty batch of
+        # events after an evaluation pass. Listener failures are
+        # swallowed — acting on alerts must not break detecting them.
+        self._listeners: list = []
+        #: Optional RemediationEngine; when set, cluster_view() carries
+        #: its state under "remediation" (cli serve --remediate wires it).
+        self.remediation = None
 
         reg = registry or get_registry()
         # Alert counters pre-created for every rule so a scrape shows the
@@ -290,8 +298,19 @@ class ClusterMonitor:
             if events:
                 with self._lock:
                     self._last_events.extend(events)
+                for fn in list(self._listeners):
+                    try:
+                        fn(events)
+                    except Exception:  # noqa: BLE001
+                        pass
             self._state_cache = state
             return events
+
+    def add_listener(self, fn) -> None:
+        """Subscribe to alert edge events: ``fn(events)`` is called after
+        every evaluation pass that produced any (the remediation engine's
+        intake; docs/ROBUSTNESS.md)."""
+        self._listeners.append(fn)
 
     def _record_event(self, ev: dict) -> None:
         """Drop the alert event into the flight recorder, span-shaped so
@@ -352,7 +371,7 @@ class ClusterMonitor:
                 row["last_seen_age_s"] = round(max(0.0, now - ws.last_seen),
                                                3)
             rows.append(row)
-        return {
+        out = {
             "ts": round(now, 3),
             "role": self.role,
             "pid": os.getpid(),
@@ -364,6 +383,22 @@ class ClusterMonitor:
             "alerts": alerts,
             "alerts_total": totals,
         }
+        # Self-healing surfaces (docs/ROBUSTNESS.md): live quorum-round
+        # state from the store and the remediation engine's active/recent
+        # actions. Both best-effort — the health view must render even if
+        # the healing layer breaks.
+        rs = getattr(self.store, "round_status", None)
+        if callable(rs) and state.mode == "sync":
+            try:
+                out["round"] = rs()
+            except Exception:  # noqa: BLE001
+                pass
+        if self.remediation is not None:
+            try:
+                out["remediation"] = self.remediation.view()
+            except Exception:  # noqa: BLE001
+                pass
+        return out
 
     # -- snapshot-stream record ---------------------------------------------
 

@@ -150,6 +150,85 @@ def test_every_codec_is_documented_and_vice_versa():
         f"(renamed or removed?): {unknown_in_doc}")
 
 
+ROB_DOC = REPO / "docs" / "ROBUSTNESS.md"
+
+#: A directive/action-table row: ``| `name` | meaning |``; scoped to the
+#: relevant section below so other tables can't match.
+_DOC_NAME_ROW_RE = re.compile(r"^\|\s*`([a-z0-9_]+)`\s*\|", re.M)
+
+
+def _doc_section(text: str, heading: str) -> str:
+    assert heading in text, f"section {heading!r} rotted?"
+    return text.split(heading, 1)[1].split("\n#", 1)[0]
+
+
+def test_every_directive_is_documented_and_vice_versa():
+    """ISSUE 7 satellite: directive names ride the wire (reply meta),
+    label ``dps_worker_directives_total``, and drive worker behavior — a
+    silent rename would strand the remediation engine and the doc."""
+    from distributed_parameter_server_for_ml_training_tpu.comms.service \
+        import DIRECTIVE_CATALOG
+
+    section = _doc_section(ROB_DOC.read_text(), "#### Directive catalog")
+    doc_names = set(_DOC_NAME_ROW_RE.findall(section))
+    catalog = set(DIRECTIVE_CATALOG)
+    missing_from_doc = sorted(catalog - doc_names)
+    unknown_in_doc = sorted(doc_names - catalog)
+    assert not missing_from_doc, (
+        f"DIRECTIVE_CATALOG entries absent from docs/ROBUSTNESS.md's "
+        f"directive table: {missing_from_doc}")
+    assert not unknown_in_doc, (
+        f"docs/ROBUSTNESS.md documents directives not in "
+        f"DIRECTIVE_CATALOG (renamed or removed?): {unknown_in_doc}")
+
+
+def test_every_remediation_action_is_documented_and_vice_versa():
+    """ISSUE 7 satellite: action names label
+    ``dps_remediation_actions_total`` and the policy table — pinned to
+    docs/ROBUSTNESS.md's action catalog both directions."""
+    from distributed_parameter_server_for_ml_training_tpu.telemetry \
+        import ACTION_CATALOG
+
+    section = _doc_section(ROB_DOC.read_text(), "#### Action catalog")
+    doc_names = set(_DOC_NAME_ROW_RE.findall(section))
+    catalog = set(ACTION_CATALOG)
+    missing_from_doc = sorted(catalog - doc_names)
+    unknown_in_doc = sorted(doc_names - catalog)
+    assert not missing_from_doc, (
+        f"ACTION_CATALOG entries absent from docs/ROBUSTNESS.md's action "
+        f"table: {missing_from_doc}")
+    assert not unknown_in_doc, (
+        f"docs/ROBUSTNESS.md documents remediation actions not in "
+        f"ACTION_CATALOG (renamed or removed?): {unknown_in_doc}")
+
+
+def test_policy_table_rules_and_actions_exist():
+    """Every rule in the doc's policy table is a real health rule, and
+    every action it maps to is in the action catalog AND the engine's
+    default policy matches the documented rows."""
+    from distributed_parameter_server_for_ml_training_tpu.telemetry \
+        import ACTION_CATALOG
+    from distributed_parameter_server_for_ml_training_tpu.telemetry \
+        .remediation import DEFAULT_POLICY_RULES
+
+    section = _doc_section(ROB_DOC.read_text(),
+                           "#### Policy table (defaults)")
+    rows = re.findall(r"^\|\s*`([a-z_]+)`\s*\|\s*(.+?)\s*\|", section,
+                      re.M)
+    doc_policy = {}
+    for rule, actions_cell in rows:
+        doc_policy[rule] = tuple(re.findall(r"`([a-z_]+)`", actions_cell))
+    assert doc_policy, "policy table rotted?"
+    for rule, actions in doc_policy.items():
+        assert rule in RULE_CATALOG, f"unknown rule {rule!r} in doc"
+        for a in actions:
+            assert a in ACTION_CATALOG, f"unknown action {a!r} in doc"
+    code_policy = {r: tuple(a) for r, a in DEFAULT_POLICY_RULES.items()}
+    assert doc_policy == code_policy, (
+        f"policy table disagrees with DEFAULT_POLICY_RULES: doc="
+        f"{doc_policy} code={code_policy}")
+
+
 def test_catalog_names_are_namespaced_and_lowercase():
     for name in SPAN_CATALOG:
         assert re.fullmatch(r"[a-z]+\.[a-z_]+", name), name

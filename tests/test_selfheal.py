@@ -1,0 +1,790 @@
+"""Self-healing cluster tests (ISSUE 7, tier-1).
+
+Covers the four seams of docs/ROBUSTNESS.md "Self-healing":
+
+- quorum/deadline sync rounds in the store (distinct-worker counting,
+  late-push reconciliation via staleness, exclusion, regression pin on
+  the quirk-3 interaction);
+- the server->worker directive channel (wire round trip over real gRPC,
+  at-least-once/ack delivery, the legacy-peer degradation matrix, the
+  server-side quarantine, a worker acting on ``drain``);
+- the worker process supervisor (respawn through a REAL subprocess kill,
+  crash-loop latch);
+- the remediation policy engine (fake-clock units: policy mapping, rate
+  limit, dry run, lift-on-resolve).
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_parameter_server_for_ml_training_tpu.comms.faults import (
+    COMPUTE_OP, FaultInjector, parse_fault_spec)
+from distributed_parameter_server_for_ml_training_tpu.comms.service import (
+    DIRECTIVE_CATALOG, ParameterService, pack_msg, serve, unpack_msg)
+from distributed_parameter_server_for_ml_training_tpu.comms.wire import (
+    encode_tensor_dict)
+from distributed_parameter_server_for_ml_training_tpu.ps.store import (
+    ParameterStore, StoreConfig)
+
+
+def _store(mode="sync", n=4, **kw):
+    return ParameterStore({"w": np.zeros(8, np.float32)},
+                          StoreConfig(mode=mode, total_workers=n,
+                                      push_codec="none", **kw))
+
+
+G = {"w": np.ones(8, np.float32)}
+
+
+class TestQuorumRounds:
+    def test_quorum_count_completes_early(self):
+        st = _store(n=4, sync_quorum=2)
+        st.push(0, G, 0)
+        assert st.global_step == 0
+        st.push(1, G, 0)
+        assert st.global_step == 1
+        rs = st.round_status()
+        assert rs["received"] == 0 and rs["last_trigger"] == "quorum"
+        assert rs["quorum"] == 2 and rs["target"] == 4
+
+    def test_quorum_fraction_ceils_over_live_target(self):
+        st = _store(n=4, sync_quorum=0.5)
+        assert st._quorum_target(4) == 2
+        assert st._quorum_target(3) == 2  # ceil(1.5)
+        assert st._quorum_target(1) == 1
+
+    def test_quorum_implies_strict_rounds_regression(self):
+        """Satellite pin (quirk-3 interaction): under the faithful
+        overwrite-increments-counter semantics ONE worker's double push
+        would satisfy a 2-worker quorum alone. Quorum must force
+        distinct-worker counting."""
+        cfg = StoreConfig(mode="sync", total_workers=4, sync_quorum=2)
+        assert cfg.strict_rounds is True
+        cfg2 = StoreConfig(mode="sync", total_workers=4,
+                           round_deadline=5.0)
+        assert cfg2.strict_rounds is True
+        st = _store(n=4, sync_quorum=2)
+        st.push(0, G, 0)
+        st.push(0, G, 0)  # same worker again: still 1 distinct
+        assert st.global_step == 0
+        assert st.round_status()["received"] == 1
+
+    def test_quorum_validation(self):
+        with pytest.raises(ValueError):
+            StoreConfig(mode="sync", sync_quorum=0)
+        with pytest.raises(ValueError):
+            StoreConfig(mode="sync", sync_quorum=2.5)
+        with pytest.raises(ValueError):
+            StoreConfig(mode="sync", round_deadline=-1)
+
+    def test_round_deadline_completes_partial_round(self):
+        """An injected straggler never pushes; the deadline closes the
+        round with the one contribution that arrived, within bounded
+        wall time."""
+        st = _store(n=4, round_deadline=0.15)
+        t0 = time.time()
+        st.push(0, G, 0)
+        assert st.global_step == 0  # not yet — deadline armed, 1/4
+        deadline = time.time() + 5.0
+        while st.global_step == 0 and time.time() < deadline:
+            time.sleep(0.02)
+        wall = time.time() - t0
+        assert st.global_step == 1
+        assert wall < 2.0, f"round took {wall:.2f}s against a 0.15s deadline"
+        assert st.round_status()["last_trigger"] == "deadline"
+
+    def test_stale_deadline_timer_is_fenced(self):
+        """A round that completes by quorum before its deadline fires
+        must not have the stale timer complete the NEXT round early."""
+        st = _store(n=2, sync_quorum=2, round_deadline=0.2)
+        st.push(0, G, 0)
+        st.push(1, G, 0)  # full/quorum completion cancels the timer
+        assert st.global_step == 1
+        st.push(0, G, 1)  # next round: 1 of 2
+        time.sleep(0.5)   # old timer's serial is stale; new timer fires
+        # the NEW round's own deadline legitimately completes it:
+        assert st.global_step == 2
+        assert st.round_status()["last_trigger"] == "deadline"
+
+    def test_late_push_reconciles_via_staleness_no_double_apply(self):
+        """The straggler's push lands AFTER its round closed: it must
+        apply exactly once through the async staleness path (weighted,
+        step bump) and never be stashed into the next round."""
+        st = _store(n=3, sync_quorum=2)
+        st.push(0, G, 0)
+        st.push(1, G, 0)
+        assert st.global_step == 1
+        before = st.parameters["w"].copy()
+        accepted = st.push(2, G, 0)  # basis 0 < step 1: late
+        assert accepted is True
+        assert st.global_step == 2          # staleness-weighted apply
+        assert st.round_status()["received"] == 0  # NOT in the next round
+        from distributed_parameter_server_for_ml_training_tpu.ps.semantics \
+            import staleness_weight
+        expect = before - np.float32(
+            st.config.learning_rate * staleness_weight(1)) * G["w"]
+        np.testing.assert_allclose(st.parameters["w"], expect, rtol=1e-6)
+        # exactly once: one late counter, one extra update
+        assert st.stats.total_parameter_updates == 2
+
+    def test_late_push_beyond_staleness_bound_rejected(self):
+        st = _store(n=2, sync_quorum=1, staleness_bound=2)
+        for step in range(4):
+            assert st.push(0, G, step) is True
+        assert st.global_step == 4
+        assert st.push(1, G, 0) is False  # staleness 4 > bound 2
+        assert st.global_step == 4
+        assert st.stats.gradients_rejected == 1
+
+    def test_exclusion_shrinks_target_and_lift_restores(self):
+        st = _store(n=3)
+        st.push(0, G, 0)
+        st.push(1, G, 0)
+        assert st.global_step == 0  # full barrier waits for worker 2
+        st.exclude_worker(2)
+        assert st.global_step == 1  # target shrank to 2: round closed
+        assert st.round_status()["excluded"] == [2]
+        st.include_worker(2)
+        assert st.round_status()["excluded"] == []
+        assert st.round_status()["target"] == 3
+
+    def test_full_barrier_unchanged_without_quorum_flags(self):
+        st = _store(n=2)
+        assert st.push(0, G, 0) is True
+        assert st.global_step == 0
+        st.push(1, G, 0)
+        assert st.global_step == 1
+        assert st.round_status()["last_trigger"] == "full"
+
+
+class TestDelayComputeFault:
+    def test_parse_and_pairing_validation(self):
+        seed, rules = parse_fault_spec("compute.delay_compute=0.05@every=2")
+        assert rules[0].op == "compute" and rules[0].value == 0.05
+        with pytest.raises(ValueError):
+            parse_fault_spec("any.delay_compute=1@p=1")
+        with pytest.raises(ValueError):
+            parse_fault_spec("compute.unavailable@p=1")
+
+    def test_deterministic_schedule_and_rpc_isolation(self):
+        inj = FaultInjector("compute.delay_compute=0.01@n=2",
+                            _telemetry=False)
+        assert inj.maybe_delay_compute() == 0.0
+        assert inj.maybe_delay_compute() == 0.01
+        assert inj.maybe_delay_compute() == 0.0
+        # 'any' rules span the four RPCs, never the compute pseudo-op...
+        inj2 = FaultInjector("any.unavailable@p=1", _telemetry=False)
+        assert inj2.decide(COMPUTE_OP) is None
+        # ...and compute rules never fire on RPCs.
+        inj3 = FaultInjector("compute.delay_compute=9@every=1",
+                             _telemetry=False)
+        assert inj3.decide("PushGradrients") is None
+
+    def test_worker_loop_polls_injector_per_step(self, tiny_model):
+        """The worker consults the store's injector once per local step
+        (wiring pin — the demo relies on it for the injected straggler)."""
+        import jax
+
+        from distributed_parameter_server_for_ml_training_tpu.data import (
+            synthetic_cifar100)
+        from distributed_parameter_server_for_ml_training_tpu.ps import (
+            PSWorker, WorkerConfig)
+        from distributed_parameter_server_for_ml_training_tpu.utils.pytree \
+            import flatten_params
+
+        class CountingInjector:
+            calls = 0
+
+            def maybe_delay_compute(self):
+                CountingInjector.calls += 1
+                return 0.0
+
+        model = tiny_model()
+        variables = model.init(jax.random.PRNGKey(0),
+                               np.zeros((1, 32, 32, 3), np.float32),
+                               train=False)
+        flat = flatten_params(variables["params"])
+        store = ParameterStore(
+            {k: np.array(v) for k, v in flat.items()},
+            StoreConfig(mode="async", total_workers=1, push_codec="none"))
+        store.faults = CountingInjector()
+        ds = synthetic_cifar100(n_train=64, n_test=16, num_classes=10)
+        w = PSWorker(store, model, ds,
+                     WorkerConfig(batch_size=16, num_epochs=1,
+                                  augment=False, eval_each_epoch=False))
+        w.start()
+        w.join(180)
+        assert w.result.error is None
+        assert CountingInjector.calls == w.result.local_steps_completed > 0
+
+
+class TestDirectiveChannel:
+    def _svc(self, **kw):
+        store = _store(mode="async", n=2, **kw)
+        return store, ParameterService(store)
+
+    def _register(self, svc, caps=("directives",), name="w"):
+        meta = {"worker_name": name}
+        if caps:
+            meta["capabilities"] = list(caps)
+        reply, _ = unpack_msg(svc.register_worker(pack_msg(meta), None))
+        return reply
+
+    def test_post_attach_ack_lifecycle(self):
+        store, svc = self._svc()
+        wid = self._register(svc)["worker_id"]
+        assert svc.post_directive(wid, "refetch_params") == 1
+        assert svc.post_directive(wid, "quarantine", steps=5) == 2
+        rm, _ = unpack_msg(
+            svc.fetch_parameters(pack_msg({"worker_id": wid}), None))
+        assert [d["action"] for d in rm["directives"]] == \
+            ["refetch_params", "quarantine"]
+        assert rm["directives"][1]["steps"] == 5
+        # re-attached until acked (at-least-once)
+        rm, _ = unpack_msg(
+            svc.fetch_parameters(pack_msg({"worker_id": wid}), None))
+        assert len(rm["directives"]) == 2
+        # ack prunes up to the watermark
+        rm, _ = unpack_msg(svc.fetch_parameters(
+            pack_msg({"worker_id": wid, "directives_ack": 2}), None))
+        assert "directives" not in rm
+
+    def test_unknown_directive_refused_at_post(self):
+        store, svc = self._svc()
+        wid = self._register(svc)["worker_id"]
+        with pytest.raises(ValueError):
+            svc.post_directive(wid, "reboot_the_moon")
+
+    def test_legacy_worker_never_sees_directives(self):
+        """Degradation matrix, old worker vs new server: no capability
+        advertised -> post returns None, replies carry nothing, pushes
+        keep applying."""
+        store, svc = self._svc()
+        wid = self._register(svc, caps=None)["worker_id"]
+        assert svc.post_directive(wid, "refetch_params") is None
+        payload = encode_tensor_dict(G)
+        pm, _ = unpack_msg(svc.push_gradrients(
+            pack_msg({"worker_id": wid, "fetched_step": 0,
+                      "push_token": "legacy:1"}, payload), None))
+        assert pm["accepted"] is True
+        rm, _ = unpack_msg(
+            svc.fetch_parameters(pack_msg({"worker_id": wid}), None))
+        assert "directives" not in rm
+        assert store.global_step == 1
+
+    def test_new_client_against_legacy_server_stays_silent(self):
+        """Degradation matrix, new worker vs old server: no advertisement
+        in the register reply -> the client attaches no acks and training
+        runs untouched."""
+        from distributed_parameter_server_for_ml_training_tpu.comms.client \
+            import RemoteStore
+
+        class LegacyService(ParameterService):
+            def register_worker(self, request, ctx):
+                reply = super().register_worker(request, ctx)
+                meta, payload = unpack_msg(reply)
+                meta.pop("directives", None)
+                return pack_msg(dict(meta), bytes(payload))
+
+        store = _store(mode="async", n=2)
+        svc = LegacyService(store)
+        server, port = serve(store, port=0, service=svc)
+        try:
+            client = RemoteStore(f"localhost:{port}", rpc_timeout=10.0)
+            wid, _ = client.register_worker("legacy-pair")
+            assert client.supports_directives is False
+            assert client.push(wid, G, 0) is True
+            client.fetch(wid)
+            assert client.take_directives() == []
+            assert store.global_step == 1
+        finally:
+            server.stop(grace=0.2)
+
+    def test_grpc_round_trip_with_dedupe_and_ack(self):
+        """Full wire round trip: directive posted server-side arrives via
+        RemoteStore exactly once (seq dedupe across re-attached replies)
+        and the ack clears the server's box."""
+        from distributed_parameter_server_for_ml_training_tpu.comms.client \
+            import RemoteStore
+
+        store = _store(mode="async", n=2)
+        svc = ParameterService(store)
+        server, port = serve(store, port=0, service=svc)
+        try:
+            client = RemoteStore(f"localhost:{port}", rpc_timeout=10.0)
+            wid, _ = client.register_worker("dw")
+            assert client.supports_directives is True
+            svc.post_directive(wid, "rebalance_shard")
+            client.fetch(wid)   # carries the directive down
+            client.fetch(wid)   # re-attached (not yet acked) — must dedupe
+            got = client.take_directives()
+            assert [d["action"] for d in got] == ["rebalance_shard"]
+            client.fetch(wid)   # this request acks seq 1
+            assert svc.directives_for(wid) == []
+            assert client.take_directives() == []
+        finally:
+            server.stop(grace=0.2)
+
+    def test_quarantine_refuses_then_readmits(self):
+        store, svc = self._svc()
+        wid = self._register(svc)["worker_id"]
+        svc.quarantine(wid, seconds=30.0)
+        payload = encode_tensor_dict(G)
+        pm, _ = unpack_msg(svc.push_gradrients(
+            pack_msg({"worker_id": wid, "fetched_step": 0,
+                      "push_token": "q:1"}, payload), None))
+        assert pm["accepted"] is False and pm["quarantined"] is True
+        assert store.global_step == 0
+        assert wid in svc.quarantine_view()
+        svc.unquarantine(wid)
+        pm, _ = unpack_msg(svc.push_gradrients(
+            pack_msg({"worker_id": wid, "fetched_step": 0,
+                      "push_token": "q:2"}, payload), None))
+        assert pm["accepted"] is True
+        assert store.global_step == 1
+
+    def test_reject_nonfinite_refuses_the_carrying_push(self):
+        """The synchronous quarantine half: a push whose OWN health
+        report flags non-finite values never touches the aggregate; the
+        next (finite-report) push applies normally."""
+        store = _store(mode="async", n=2)
+        svc = ParameterService(store, reject_nonfinite=True)
+        wid = self._register2(svc)
+        payload = encode_tensor_dict(G)
+        pm, _ = unpack_msg(svc.push_gradrients(pack_msg(
+            {"worker_id": wid, "fetched_step": 0, "push_token": "nf:1",
+             "health": {"step": 6, "loss": None, "loss_finite": False,
+                        "grad_norm": None, "grad_finite": False}},
+            payload), None))
+        assert pm["accepted"] is False and pm["quarantined"] is True
+        assert store.global_step == 0
+        pm, _ = unpack_msg(svc.push_gradrients(pack_msg(
+            {"worker_id": wid, "fetched_step": 0, "push_token": "nf:2",
+             "health": {"step": 7, "loss": 2.0, "loss_finite": True,
+                        "grad_norm": 1.0, "grad_finite": True}},
+            payload), None))
+        assert pm["accepted"] is True and store.global_step == 1
+        # Default-off: reference parity applies the NaN-reported push.
+        store2 = _store(mode="async", n=2)
+        svc2 = ParameterService(store2)
+        wid2 = self._register2(svc2)
+        pm, _ = unpack_msg(svc2.push_gradrients(pack_msg(
+            {"worker_id": wid2, "fetched_step": 0, "push_token": "nf:3",
+             "health": {"loss_finite": False}}, payload), None))
+        assert pm["accepted"] is True
+
+    def _register2(self, svc):
+        reply, _ = unpack_msg(svc.register_worker(
+            pack_msg({"capabilities": ["directives"]}), None))
+        return reply["worker_id"]
+
+    def test_quarantine_expires_by_time(self):
+        store, svc = self._svc()
+        wid = self._register(svc)["worker_id"]
+        svc.quarantine(wid, seconds=0.05)
+        time.sleep(0.1)
+        assert svc.is_quarantined(wid) is False
+
+    def test_reregistration_clears_stale_directives(self):
+        store, svc = self._svc(elastic=True, worker_timeout=60.0)
+        wid = self._register(svc)["worker_id"]
+        svc.post_directive(wid, "drain")
+        svc.quarantine(wid, 60)
+        store.job_finished(wid)
+        wid2 = self._register(svc, name="respawn")["worker_id"]
+        assert wid2 == wid  # elastic slot reuse
+        assert svc.directives_for(wid2) == []
+        assert svc.is_quarantined(wid2) is False
+
+    def test_legacy_replacement_inherits_nothing(self):
+        """Regression: a LEGACY worker (no capability) reusing a
+        quarantined predecessor's id slot must start clean — not stay
+        quarantined, and not keep accepting directive posts it will
+        never hear."""
+        store, svc = self._svc(elastic=True, worker_timeout=60.0)
+        wid = self._register(svc)["worker_id"]
+        svc.quarantine(wid, 60)
+        svc.post_directive(wid, "refetch_params")
+        store.job_finished(wid)
+        wid2 = self._register(svc, caps=None, name="legacy")["worker_id"]
+        assert wid2 == wid
+        assert svc.is_quarantined(wid2) is False
+        assert svc.post_directive(wid2, "refetch_params") is None
+        payload = encode_tensor_dict(G)
+        pm, _ = unpack_msg(svc.push_gradrients(
+            pack_msg({"worker_id": wid2, "fetched_step": 0,
+                      "push_token": "lr:1"}, payload), None))
+        assert pm["accepted"] is True and "directives" not in pm
+
+    def test_quarantine_duplicate_replays_journaled_outcome(self):
+        """Regression: a retry of a token whose original WAS applied
+        must replay the journaled accepted=True even while its worker is
+        quarantined (the exactly-once reply contract); a NEW push is
+        refused without recording an entry, so the same token applies
+        after the quarantine lifts."""
+        store, svc = self._svc()
+        wid = self._register(svc)["worker_id"]
+        payload = encode_tensor_dict(G)
+
+        def push(token):
+            reply, _ = unpack_msg(svc.push_gradrients(pack_msg(
+                {"worker_id": wid, "fetched_step": store.global_step,
+                 "push_token": token}, payload), None))
+            return reply
+
+        assert push("dupq:1")["accepted"] is True  # applied + journaled
+        svc.quarantine(wid, 60)
+        dup = push("dupq:1")  # retry of the APPLIED push
+        assert dup["accepted"] is True and dup["duplicate"] is True
+        fresh = push("dupq:2")  # new push: refused, no entry recorded
+        assert fresh["accepted"] is False and fresh["quarantined"] is True
+        step_before = store.global_step
+        svc.unquarantine(wid)
+        again = push("dupq:2")  # same token after the lift: applies
+        assert again["accepted"] is True and "duplicate" not in again
+        assert store.global_step == step_before + 1
+
+    def test_expire_on_push_activity_unsticks_round(self):
+        """Satellite: a sync round stalled on a DEAD worker completes as
+        soon as a live worker pushes — the handler runs expiry itself
+        instead of waiting for the serve loop's timer."""
+        store = _store(mode="sync", n=2, elastic=True,
+                       worker_timeout=0.2)
+        svc = ParameterService(store)
+        dead = self._register(svc, name="dead")["worker_id"]
+        live = self._register(svc, name="live")["worker_id"]
+        payload = encode_tensor_dict(G)
+        svc.push_gradrients(pack_msg(
+            {"worker_id": live, "fetched_step": 0,
+             "push_token": "l:1"}, payload), None)
+        assert store.global_step == 0  # waiting on `dead`
+        time.sleep(0.4)  # `dead` exceeds worker_timeout
+        svc._last_expire_check = 0.0   # defeat the throttle for the test
+        svc.push_gradrients(pack_msg(
+            {"worker_id": live, "fetched_step": 0,
+             "push_token": "l:2"}, payload), None)
+        # expiry shrank the live round target to 1 -> the stalled round
+        # (with the live worker's pending gradient) completed
+        assert dead not in store.membership_snapshot()
+        assert store.global_step >= 1
+
+
+class TestWorkerActsOnDirectives:
+    def test_drain_and_refetch_via_real_wire(self, tiny_model):
+        """A worker told to drain finishes cleanly ahead of schedule (and
+        a refetch directive forces a full fetch) — the end-to-end proof
+        that directives posted server-side change worker behavior."""
+        import jax
+
+        from distributed_parameter_server_for_ml_training_tpu.comms.client \
+            import RemoteStore
+        from distributed_parameter_server_for_ml_training_tpu.data import (
+            synthetic_cifar100)
+        from distributed_parameter_server_for_ml_training_tpu.ps import (
+            PSWorker, WorkerConfig)
+        from distributed_parameter_server_for_ml_training_tpu.utils.pytree \
+            import flatten_params
+
+        model = tiny_model()
+        variables = model.init(jax.random.PRNGKey(0),
+                               np.zeros((1, 32, 32, 3), np.float32),
+                               train=False)
+        flat = flatten_params(variables["params"])
+        store = ParameterStore(
+            {k: np.array(v) for k, v in flat.items()},
+            StoreConfig(mode="async", total_workers=1, push_codec="none"))
+        svc = ParameterService(store)
+        server, port = serve(store, port=0, service=svc)
+        try:
+            client = RemoteStore(f"localhost:{port}", rpc_timeout=10.0)
+            ds = synthetic_cifar100(n_train=128, n_test=16, num_classes=10)
+            w = PSWorker(client, model, ds,
+                         WorkerConfig(batch_size=16, num_epochs=50,
+                                      augment=False,
+                                      eval_each_epoch=False))
+            posted = threading.Event()
+
+            def post_soon():
+                deadline = time.time() + 120
+                while time.time() < deadline:
+                    if store.global_step >= 1 and store.active_workers:
+                        wid = next(iter(store.active_workers))
+                        svc.post_directive(wid, "refetch_params")
+                        svc.post_directive(wid, "drain")
+                        posted.set()
+                        return
+                    time.sleep(0.02)
+
+            threading.Thread(target=post_soon, daemon=True).start()
+            w.start()
+            w.join(300)
+            assert posted.is_set()
+            assert w.result.error is None
+            # Drained: far fewer than the configured 50 epochs ran.
+            assert len(w.result.epoch_times) < 50
+            assert w.result.directives_applied.get("drain") == 1
+            assert w.result.directives_applied.get("refetch_params") == 1
+            # Clean departure: JobFinished ran, membership is empty.
+            assert store.membership_snapshot() == []
+        finally:
+            server.stop(grace=0.2)
+
+
+class TestSupervisor:
+    def _config(self, **kw):
+        from distributed_parameter_server_for_ml_training_tpu.ps.supervisor \
+            import SupervisorConfig
+        defaults = dict(backoff_initial=0.05, backoff_max=0.2,
+                        healthy_after=0.01, poll_interval=0.02)
+        defaults.update(kw)
+        return SupervisorConfig(**defaults)
+
+    def test_respawn_through_real_kill(self, tmp_path):
+        """A child that dies (nonzero exit — the subprocess analog of the
+        chaos kill) is respawned and the replacement finishes: rc 0, one
+        respawn recorded, respawn counter incremented."""
+        from distributed_parameter_server_for_ml_training_tpu.ps.supervisor \
+            import WorkerSupervisor
+        from distributed_parameter_server_for_ml_training_tpu.telemetry \
+            import get_registry
+
+        sentinel = tmp_path / "came_up_once"
+        script = (f"import os, sys\n"
+                  f"p = {str(sentinel)!r}\n"
+                  f"if os.path.exists(p):\n"
+                  f"    sys.exit(0)\n"
+                  f"open(p, 'w').close()\n"
+                  f"os.kill(os.getpid(), 9)\n")
+
+        def argv_for(slot, attempt):
+            return [sys.executable, "-c", script], None
+
+        before = self._respawn_ok_count()
+        sup = WorkerSupervisor(argv_for, 1, self._config())
+        sup.start()
+        rc = sup.run()
+        slot = sup.status()["slots"][0]
+        assert rc == 0
+        assert slot["respawns"] == 1 and slot["last_rc"] == 0
+        assert not slot["latched"]
+        assert self._respawn_ok_count() == before + 1
+
+    @staticmethod
+    def _respawn_ok_count() -> float:
+        from distributed_parameter_server_for_ml_training_tpu.telemetry \
+            import get_registry
+        return get_registry().counter("dps_remediation_actions_total",
+                                      action="respawn",
+                                      outcome="ok").value
+
+    def test_crash_loop_latches(self):
+        from distributed_parameter_server_for_ml_training_tpu.ps.supervisor \
+            import WorkerSupervisor
+
+        def argv_for(slot, attempt):
+            return [sys.executable, "-c", "import sys; sys.exit(3)"], None
+
+        sup = WorkerSupervisor(argv_for, 1,
+                               self._config(healthy_after=5.0,
+                                            crash_loop_after=2))
+        sup.start()
+        rc = sup.run()
+        slot = sup.status()["slots"][0]
+        assert rc == 1 and slot["latched"]
+        # Latch AT crash_loop_after consecutive fast crashes: 1 spawn +
+        # (crash_loop_after - 1) respawns — not one extra burned.
+        assert slot["attempt"] == 2
+
+    def test_healthy_uptime_resets_crash_count(self):
+        """A child that comes up for real (lives past healthy_after)
+        resets the consecutive-crash count — distinct crashes spread over
+        healthy runs never latch."""
+        from distributed_parameter_server_for_ml_training_tpu.ps.supervisor \
+            import WorkerSupervisor
+
+        calls = []
+
+        def argv_for(slot, attempt):
+            calls.append(attempt)
+            # odd attempts crash instantly, even ones live 0.3 s then exit
+            if attempt % 2 == 0:
+                code = "import time,sys; time.sleep(0.3); sys.exit(1)" \
+                    if attempt < 4 else "import sys; sys.exit(0)"
+            else:
+                code = "import sys; sys.exit(1)"
+            return [sys.executable, "-c", code], None
+
+        sup = WorkerSupervisor(
+            argv_for, 1, self._config(healthy_after=0.15,
+                                      crash_loop_after=2))
+        sup.start()
+        rc = sup.run()
+        slot = sup.status()["slots"][0]
+        assert rc == 0 and not slot["latched"], (rc, slot, calls)
+
+    def test_first_spawn_only_fault_args(self):
+        from distributed_parameter_server_for_ml_training_tpu.ps.supervisor \
+            import build_worker_argv
+
+        argv0, env0 = build_worker_argv(
+            ["--server", "h:1"], 0,
+            first_spawn_faults={0: "seed=7;push.kill@n=2"},
+            first_spawn_env={0: {"DPS_NAN_STEP": "4"}}, attempt=0)
+        assert "--faults" in argv0 and env0 == {"DPS_NAN_STEP": "4"}
+        assert "--worker-name" in argv0
+        argv1, env1 = build_worker_argv(
+            ["--server", "h:1"], 0,
+            first_spawn_faults={0: "seed=7;push.kill@n=2"},
+            first_spawn_env={0: {"DPS_NAN_STEP": "4"}}, attempt=1)
+        assert "--faults" not in argv1 and env1 is None
+
+
+class TestRemediationEngine:
+    def _rig(self, dry_run=False, cooldown=30.0):
+        from distributed_parameter_server_for_ml_training_tpu.telemetry \
+            import RemediationEngine, RemediationPolicy
+        store = _store(mode="sync", n=3, sync_quorum=2)
+        svc = ParameterService(store)
+        reply, _ = unpack_msg(svc.register_worker(
+            pack_msg({"capabilities": ["directives"]}), None))
+        wid = reply["worker_id"]
+        clock = [1000.0]
+        eng = RemediationEngine(
+            store, service=svc,
+            policy=RemediationPolicy(dry_run=dry_run, cooldown_s=cooldown),
+            clock=lambda: clock[0])
+        return store, svc, eng, wid, clock
+
+    @staticmethod
+    def _ev(state, rule, worker):
+        return {"state": state, "rule": rule, "worker": worker}
+
+    def test_policy_mapping_straggler(self):
+        store, svc, eng, wid, clock = self._rig()
+        recs = eng.handle_events([self._ev("fired", "straggler_lag", wid)])
+        assert [(r["action"], r["outcome"]) for r in recs] == \
+            [("quorum_exclude", "ok"), ("rebalance", "ok")]
+        assert store.excluded_workers() == [wid]
+        assert [d["action"] for d in svc.directives_for(wid)] == \
+            ["rebalance_shard"]
+
+    def test_policy_mapping_nonfinite_and_lift(self):
+        store, svc, eng, wid, clock = self._rig()
+        recs = eng.handle_events([self._ev("fired", "nonfinite_loss", wid)])
+        assert {r["action"] for r in recs} == {"quarantine", "refetch"}
+        assert svc.is_quarantined(wid)
+        actions = [d["action"] for d in svc.directives_for(wid)]
+        assert actions == ["quarantine", "refetch_params"]
+        assert eng.view()["active"]
+        clock[0] += 120
+        recs2 = eng.handle_events(
+            [self._ev("resolved", "nonfinite_loss", wid)])
+        assert all(r["outcome"] == "lifted" for r in recs2)
+        assert not svc.is_quarantined(wid)
+        assert eng.view()["active"] == []
+
+    def test_dead_worker_respawn_delegated(self):
+        store, svc, eng, wid, clock = self._rig()
+        recs = eng.handle_events([self._ev("fired", "dead_worker", 7)])
+        assert recs[0]["action"] == "respawn"
+        assert recs[0]["outcome"] == "delegated"
+
+    def test_rate_limit_per_action_worker_with_fake_clock(self):
+        store, svc, eng, wid, clock = self._rig(cooldown=30.0)
+        eng.handle_events([self._ev("fired", "straggler_lag", wid)])
+        recs = eng.handle_events([self._ev("refired", "straggler_lag",
+                                           wid)])
+        assert all(r["outcome"] == "rate_limited" for r in recs)
+        clock[0] += 31.0
+        recs2 = eng.handle_events([self._ev("refired", "straggler_lag",
+                                            wid)])
+        assert all(r["outcome"] == "ok" for r in recs2)
+
+    def test_dry_run_records_but_touches_nothing(self):
+        store, svc, eng, wid, clock = self._rig(dry_run=True)
+        recs = eng.handle_events([
+            self._ev("fired", "straggler_lag", wid),
+            self._ev("fired", "nonfinite_grad", wid)])
+        assert recs and all(r["outcome"] == "dry_run" for r in recs)
+        assert store.excluded_workers() == []
+        assert not svc.is_quarantined(wid)
+        assert svc.directives_for(wid) == []
+        view = eng.view()
+        assert view["dry_run"] is True and view["active"]
+
+    def test_listener_wiring_and_cluster_view_surfaces(self):
+        """Monitor -> engine wiring plus the /cluster payload carrying
+        round + remediation state (satellite 4)."""
+        from distributed_parameter_server_for_ml_training_tpu.telemetry \
+            import ClusterMonitor, HealthThresholds, RemediationEngine, \
+            RemediationPolicy
+
+        clock = [1000.0]
+        store = _store(mode="sync", n=3, sync_quorum=2,
+                       worker_timeout=60.0)
+        svc = ParameterService(store)
+        monitor = ClusterMonitor(store, HealthThresholds(dead_after_s=5.0),
+                                 interval=1.0, clock=lambda: clock[0])
+        svc.monitor = monitor
+        eng = RemediationEngine(store, service=svc,
+                                policy=RemediationPolicy(cooldown_s=1.0),
+                                clock=lambda: clock[0])
+        monitor.remediation = eng
+        monitor.add_listener(eng.handle_events)
+        wid, _ = store.register_worker("w0")
+        monitor.ingest(wid, {"step": 1, "loss": 2.0, "loss_finite": False,
+                             "grad_norm": 1.0, "grad_finite": True})
+        monitor.evaluate()
+        assert svc.is_quarantined(wid)  # alert edge drove the action
+        view = monitor.cluster_view(evaluate=False)
+        assert view["round"]["quorum"] == 2
+        assert view["remediation"]["active"]
+        assert any(r["action"] == "quarantine"
+                   for r in view["remediation"]["recent"])
+
+    def test_status_renderer_and_healing_exit_code(self, capsys):
+        """cli status renders round + remediation lines and exits 3 for
+        critical-but-healing (satellite 4)."""
+        from distributed_parameter_server_for_ml_training_tpu.cli import (
+            _render_status)
+
+        view = {
+            "mode": "sync", "global_step": 7,
+            "workers": [{"worker": 0, "alive": True, "step": 7}],
+            "alerts": [{"rule": "nonfinite_loss", "severity": "critical",
+                        "worker": 0, "message": "NaN"}],
+            "alerts_total": {"critical": 1, "warning": 0, "info": 0},
+            "round": {"received": 1, "quorum": 2, "target": 3,
+                      "excluded": [1], "deadline_s": 2.0,
+                      "deadline_armed": True, "last_trigger": "quorum"},
+            "remediation": {"dry_run": False, "active": [
+                {"action": "quarantine", "rule": "nonfinite_loss",
+                 "worker": 0, "outcome": "ok"}],
+                "quarantined": {"0": 12.0}},
+        }
+        text = _render_status(view)
+        assert "round: received 1/2 (target 3" in text
+        assert "excluded=[1]" in text
+        assert "active remediations" in text
+        assert "quarantine (worker 0) <- nonfinite_loss" in text
+        # exit-code logic: critical + active (non-dry-run) remediation
+        # -> 3; a dry-run engine executes nothing, so it must not claim
+        # healing (a restart policy holding off would wait forever).
+        def code(v):
+            critical = v["alerts_total"]["critical"]
+            if not critical:
+                return 0
+            rem = v.get("remediation", {})
+            healing = bool(rem.get("active")) and not rem.get("dry_run")
+            return 3 if healing else 2
+        assert code(view) == 3
+        dry = dict(view, remediation=dict(view["remediation"],
+                                          dry_run=True))
+        assert code(dry) == 2
+        assert code(dict(view, remediation={})) == 2
