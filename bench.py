@@ -162,6 +162,43 @@ def emit_diagnostic(stage: str, err: Exception) -> None:
     }))
 
 
+def fetch_qps_probe(duration_s: float = 1.0, concurrency: int = 2):
+    """Serve-path companion number: QPS of an in-process gRPC fetch loop
+    against a small parameter store (full-model fetches, no tensor decode
+    client-side). Single primary, no replicas — the matching
+    ``shard_count``/``replica_count`` fields say so, and the sharded
+    scale-out numbers live in experiments/results/sharding/ where the
+    topology is real. Returns None on any failure: the serve-path probe
+    must never cost the training-throughput record."""
+    import numpy as np
+
+    from distributed_parameter_server_for_ml_training_tpu.comms.loadgen \
+        import run_loadgen
+    from distributed_parameter_server_for_ml_training_tpu.comms.service \
+        import ParameterService, serve
+    from distributed_parameter_server_for_ml_training_tpu.ps.store import (
+        ParameterStore, StoreConfig)
+
+    try:
+        params = {f"layer{i}/kernel": np.zeros((256, 64), np.float32)
+                  for i in range(8)}
+        store = ParameterStore(
+            params, StoreConfig(mode="async", total_workers=1))
+        server, port = serve(store, port=0,
+                             service=ParameterService(store))
+        try:
+            res = run_loadgen([f"localhost:{port}"],
+                              duration_s=duration_s,
+                              concurrency=concurrency, mode="full")
+            return res["qps"]
+        finally:
+            server.stop(grace=0.2)
+    except Exception as e:  # noqa: BLE001 — probe is best-effort
+        print(f"fetch-qps probe failed (recording null): {e}",
+              file=sys.stderr)
+        return None
+
+
 def run_bench(args) -> dict:
     stage = "backend_init"
     try:
@@ -277,6 +314,12 @@ def run_bench(args) -> dict:
         el_bytes = {"none": 4, "bf16": 2, "fp16": 2, "int8": 1}[grad_codec]
         ring_bytes = (2 * (n_chips - 1) / n_chips * n_params * el_bytes
                       if n_chips > 1 else 0)
+        stage = "fetch_probe"
+        fetch_qps = None
+        if not getattr(args, "no_fetch_probe", False):
+            fetch_qps = fetch_qps_probe(
+                duration_s=getattr(args, "fetch_probe_secs", 1.0))
+
         result = {
             "metric": "cifar100_resnet18_train_images_per_sec_per_chip",
             "value": round(per_chip, 1),
@@ -284,6 +327,13 @@ def run_bench(args) -> dict:
             "vs_baseline": round(per_chip / REFERENCE_IMAGES_PER_SEC, 2),
             "push_codec": grad_codec,
             "push_bytes_per_step": int(ring_bytes),
+            # Serve-path attribution (docs/SHARDING.md): the topology the
+            # fetch_qps probe ran against — here always one in-process
+            # primary, zero replicas; the sharded numbers live in
+            # experiments/results/sharding/.
+            "shard_count": 1,
+            "replica_count": 0,
+            "fetch_qps": fetch_qps,
         }
         if fallback is not None:
             # A fallback number must never be mistaken for a chip number:
@@ -314,6 +364,12 @@ def main() -> int:
     parser.add_argument("--init-backoff", type=float,
                         default=INIT_BACKOFF_S,
                         help="first retry delay (doubles per attempt)")
+    parser.add_argument("--fetch-probe-secs", type=float, default=1.0,
+                        help="duration of the serve-path fetch-QPS probe "
+                             "recorded as fetch_qps")
+    parser.add_argument("--no-fetch-probe", action="store_true",
+                        help="skip the serve-path probe (fetch_qps "
+                             "recorded as null)")
     parser.add_argument("--no-cpu-fallback", action="store_true",
                         help="fail instead of falling back to "
                              "JAX_PLATFORMS=cpu when the configured "

@@ -130,6 +130,14 @@ def save_store(store: ParameterStore, directory: str,
             "fetch_codec": getattr(store, "fetch_codec", "none"),
         },
         "push_journal": journal,
+        # Shard identity (docs/SHARDING.md): each shard primary runs its
+        # own checkpointer over its own key subset, so a snapshot is only
+        # valid for the SAME slot of the SAME partition — restore refuses
+        # anything else. Absent in pre-sharding records (== 0-of-1).
+        "shard": {
+            "shard_index": int(getattr(cfg, "shard_index", 0)),
+            "shard_count": int(getattr(cfg, "shard_count", 1)),
+        },
         "saved_at": time.time(),
     }
     # Unique temp names per call: concurrent snapshots (periodic thread +
@@ -178,6 +186,7 @@ def restore_store(store: ParameterStore, directory: str,
     restored global step (also published as the ``dps_store_restore_step``
     gauge, so telemetry streams show where a restarted server resumed)."""
     params, meta = load_store_record(directory, step)
+    check_shard_identity(store, meta)
     store.load_snapshot(params, int(meta["global_step"]))
     from ..telemetry import get_registry
     get_registry().gauge(
@@ -185,6 +194,26 @@ def restore_store(store: ParameterStore, directory: str,
         backend=getattr(store, "store_backend", "python"),
     ).set(store.global_step)
     return store.global_step
+
+
+def check_shard_identity(store: ParameterStore, meta: dict) -> None:
+    """Refuse restoring a snapshot into the wrong shard slot or into a
+    differently-partitioned topology (docs/SHARDING.md): each shard's
+    checkpoint holds only its own key subset, so a mismatched restore
+    would silently serve another shard's tensors — or a partial model as
+    the whole one. Pre-sharding records carry no block and count as
+    shard 0 of 1."""
+    rec = meta.get("shard") or {}
+    rec_idx = int(rec.get("shard_index", 0))
+    rec_cnt = int(rec.get("shard_count", 1))
+    cfg = store.config
+    cur_idx = int(getattr(cfg, "shard_index", 0))
+    cur_cnt = int(getattr(cfg, "shard_count", 1))
+    if (rec_idx, rec_cnt) != (cur_idx, cur_cnt):
+        raise ValueError(
+            f"snapshot belongs to shard {rec_idx}/{rec_cnt} but this "
+            f"server is shard {cur_idx}/{cur_cnt} — refusing a "
+            f"cross-shard restore")
 
 
 def restore_server_state(store: ParameterStore, service, directory: str,
@@ -199,6 +228,7 @@ def restore_server_state(store: ParameterStore, service, directory: str,
     directory could pick up a newer snapshot published in between."""
     params, meta = record if record is not None \
         else load_store_record(directory, step)
+    check_shard_identity(store, meta)
     store.load_snapshot(params, int(meta["global_step"]))
     from ..telemetry import get_registry
     get_registry().gauge(

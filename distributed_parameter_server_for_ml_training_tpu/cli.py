@@ -16,6 +16,8 @@ Commands::
     python -m ....cli supervise --workers 4 -- --server host:8000
                                                       # self-healing fleet
     python -m ....cli status --url http://host:9400   # cluster health view
+    python -m ....cli replica --primary host:8000     # read-only fetch replica
+    python -m ....cli loadgen --targets host:8000     # fetch-path QPS probe
 
 The in-process ``train`` command replaces the reference's entire
 terraform/ECS deployment for single-host experiments: what took a Fargate
@@ -331,6 +333,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="steps behind the fastest reporting worker before "
                         "the straggler_lag rule fires (the remediation "
                         "engine's quorum-exclude trigger)")
+    s.add_argument("--shard-index", type=int,
+                   default=_env("DPS_SHARD_INDEX", 0, int),
+                   help="this server's slot in a sharded deployment "
+                        "(docs/SHARDING.md): it owns the consistent-hash "
+                        "key range slot_range(index, count) and holds only "
+                        "those parameters")
+    s.add_argument("--shard-count", type=int,
+                   default=_env("DPS_SHARD_COUNT", 1, int),
+                   help="total shard primaries in the deployment; 1 = "
+                        "unsharded (default, reference parity)")
+    s.add_argument("--shard-peers",
+                   default=_env("DPS_SHARD_PEERS", None),
+                   help="comma list of ALL shard primary addresses in "
+                        "shard order (host:port, length --shard-count); "
+                        "published to workers as the shard map at "
+                        "registration. Required when --shard-count > 1")
     add_platform(s)
     add_telemetry(s)
 
@@ -366,6 +384,12 @@ def build_parser() -> argparse.ArgumentParser:
                    default=_env("PARAMETER_SERVER_ADDRESS",
                                 "localhost:8000"),
                    help="PS address (worker.py:457-459)")
+    w.add_argument("--shards", default=_env("DPS_SHARDS", None),
+                   help="sharded deployment: comma list of shard primary "
+                        "addresses (or just the shard-0 seed — the rest "
+                        "are adopted from its shard map). Pushes/fetches "
+                        "fan out per shard and reassemble "
+                        "(docs/SHARDING.md); overrides --server")
     w.add_argument("--worker-name", default=_env("WORKER_NAME", ""))
     w.add_argument("--sync-steps", type=int,
                    default=_env("SYNC_STEPS", 1, int))
@@ -444,6 +468,54 @@ def build_parser() -> argparse.ArgumentParser:
                     help="-- followed by the `cli worker` args every "
                          "child runs with (--worker-name is added per "
                          "slot)")
+
+    r = sub.add_parser(
+        "replica",
+        help="read-only fetch replica behind one shard primary "
+             "(docs/SHARDING.md): subscribes over delta-fetch, serves "
+             "cached parameter bytes, refuses when stale, redirects "
+             "writes to the primary")
+    r.add_argument("--primary", required=True,
+                   help="address (host:port) of the shard primary this "
+                        "replica mirrors")
+    r.add_argument("--port", type=int, default=_env("DPS_PORT", 0, int),
+                   help="replica serve port (0 = pick a free port)")
+    r.add_argument("--shard-id", type=int, default=0,
+                   help="shard slot of the primary (stamped on replies "
+                        "and the announce)")
+    r.add_argument("--advertise", default=None,
+                   help="address to announce to the primary (defaults to "
+                        "localhost:<bound port>)")
+    r.add_argument("--poll-interval", type=float,
+                   default=_env("DPS_REPLICA_POLL", 0.05, float),
+                   help="seconds between delta-fetch refreshes against "
+                        "the primary (NOT_MODIFIED when idle)")
+    r.add_argument("--staleness-bound", type=float,
+                   default=_env("DPS_REPLICA_STALENESS", 5.0, float),
+                   help="max seconds since the last successful refresh "
+                        "before fetches are refused with a redirect to "
+                        "the primary")
+    add_telemetry(r)
+
+    lg = sub.add_parser(
+        "loadgen",
+        help="fetch-path load generator: hammer FetchParameters on one "
+             "or more targets and print aggregate QPS as LOADGEN_JSON "
+             "(docs/SHARDING.md)")
+    lg.add_argument("--targets", required=True,
+                    help="comma list of fetch targets (primaries and/or "
+                         "replicas), host:port each; threads round-robin "
+                         "over the list")
+    lg.add_argument("--duration", type=float, default=5.0,
+                    help="seconds to run")
+    lg.add_argument("--concurrency", type=int, default=4,
+                    help="total client threads (each with its own "
+                         "channel)")
+    lg.add_argument("--fetch-mode", choices=["full", "delta"],
+                    default="full",
+                    help="full = whole model every fetch; delta = poll "
+                         "at the current step (header-only NOT_MODIFIED "
+                         "steady state)")
 
     st = sub.add_parser(
         "status",
@@ -685,14 +757,44 @@ def _cmd_serve(args) -> int:
                          "--store-backend python|device (the C++ arena "
                          "runs its own round loop)")
 
+    shard_index = int(getattr(args, "shard_index", 0))
+    shard_count = int(getattr(args, "shard_count", 1))
+    shard_peers = getattr(args, "shard_peers", None)
+    sharding = None
+    # A 1-shard server with --shard-peers is a degenerate-but-real
+    # topology: no partitioning, but the shard map, replica membership,
+    # and lag gauges go live (the read-replica tier without sharding).
+    if shard_count > 1 or shard_peers:
+        from .ps.sharding import ShardInfo, partition_keys
+        if not 0 <= shard_index < shard_count:
+            raise SystemExit(f"--shard-index {shard_index} out of range "
+                             f"for --shard-count {shard_count}")
+        primaries = [a for a in (shard_peers or "").split(",") if a]
+        if len(primaries) != shard_count:
+            raise SystemExit(f"--shard-peers must list exactly "
+                             f"--shard-count={shard_count} addresses "
+                             f"(got {len(primaries)})")
+        sharding = ShardInfo(shard_index, shard_count, primaries)
+
     model = get_model(args.model, num_classes=args.num_classes,
                       image_size=args.image_size)
     size = args.image_size
     variables = model.init(jax.random.PRNGKey(args.seed),
                            np.zeros((1, size, size, 3), np.float32),
                            train=False)
+    flat = flatten_params(variables["params"])
+    if sharding is not None:
+        # This primary holds ONLY its consistent-hash key range — workers
+        # fan pushes/fetches out per shard and reassemble the full model
+        # client-side (docs/SHARDING.md).
+        total = len(flat)
+        mine = set(partition_keys(flat, shard_count)[shard_index])
+        flat = {k: v for k, v in flat.items() if k in mine}
+        print(f"shard {shard_index}/{shard_count}: owning "
+              f"{len(flat)}/{total} of the model's tensors",
+              file=sys.stderr)
     store = make_store(
-        args.store_backend, flatten_params(variables["params"]),
+        args.store_backend, flat,
         StoreConfig(mode=args.mode, total_workers=args.workers,
                     learning_rate=args.lr,
                     staleness_bound=args.staleness_bound,
@@ -704,7 +806,8 @@ def _cmd_serve(args) -> int:
                     compressed_domain=not getattr(
                         args, "no_compressed_domain", False),
                     sync_quorum=getattr(args, "sync_quorum", None),
-                    round_deadline=getattr(args, "round_deadline", None)))
+                    round_deadline=getattr(args, "round_deadline", None),
+                    shard_index=shard_index, shard_count=shard_count))
     monitor = None
     if not getattr(args, "no_health_monitor", False):
         # Cluster health monitor (docs/OBSERVABILITY.md): aggregates the
@@ -724,8 +827,12 @@ def _cmd_serve(args) -> int:
             emit_stream=bool(getattr(args, "telemetry", False)))
         set_cluster_monitor(monitor)
         monitor.start()
+        if sharding is not None:
+            # Shard identity + replica lag ride the same /cluster payload
+            # cli status renders (docs/SHARDING.md, docs/OBSERVABILITY.md).
+            monitor.sharding = sharding
     svc = ParameterService(store, faults=getattr(args, "faults", None),
-                           monitor=monitor)
+                           monitor=monitor, sharding=sharding)
     if getattr(args, "remediate", False) \
             or getattr(args, "remediate_dry_run", False):
         # Remediation policy engine (docs/ROBUSTNESS.md): turns the
@@ -808,6 +915,8 @@ def _cmd_serve(args) -> int:
           f"(mode={store.config.mode}, workers={args.workers}, "
           f"backend={args.store_backend}"
           + (f", restored_step={restored}" if restored is not None else "")
+          + (f", shard={shard_index}/{shard_count}"
+             if sharding is not None else "")
           + (", faults=on" if svc.faults is not None else "")
           + ")", file=sys.stderr)
     try:
@@ -855,7 +964,14 @@ def _cmd_worker(args) -> int:
     from .utils.metrics import emit_metrics_json
 
     dataset = _load_dataset(args)
-    store = RemoteStore(args.server, faults=getattr(args, "faults", None))
+    shards = getattr(args, "shards", None)
+    if shards:
+        from .comms.sharded import ShardedRemoteStore
+        store = ShardedRemoteStore(shards,
+                                   faults=getattr(args, "faults", None))
+    else:
+        store = RemoteStore(args.server,
+                            faults=getattr(args, "faults", None))
     import jax.numpy as jnp
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     # Honor --model/--dataset like cmd_train does — a mismatched architecture
@@ -1042,6 +1158,22 @@ def _render_status(view: dict) -> str:
         if q:
             lines.append("  quarantined pushes: " + ", ".join(
                 f"worker {w} ({s:.0f}s left)" for w, s in q.items()))
+    sh = view.get("sharding")
+    if sh:
+        # Shard identity + replica lag (docs/SHARDING.md): which slot of
+        # the partition this server is, and how far each announced read
+        # replica trails it.
+        lines.append("")
+        lines.append(f"shard: {sh.get('shard_id', '?')}"
+                     f"/{sh.get('shard_count', '?')} "
+                     f"map_version={sh.get('map_version', '?')} "
+                     f"replicas={len(sh.get('replicas', []))}")
+        for rep in sh.get("replicas", []):
+            lines.append(
+                f"  replica {rep.get('address')}: "
+                f"step={rep.get('step')} "
+                f"lag={rep.get('lag_steps')} step(s), "
+                f"announced {rep.get('announce_age_s', 0):.1f}s ago")
     return "\n".join(lines)
 
 
@@ -1143,6 +1275,52 @@ def _cmd_experiments(args) -> int:
     return 0
 
 
+def cmd_replica(args) -> int:
+    with _telemetry_session(args, "replica"):
+        return _cmd_replica(args)
+
+
+def _cmd_replica(args) -> int:
+    import time
+
+    from .comms.replica import ReplicaServer
+
+    rep = ReplicaServer(args.primary, port=args.port,
+                        shard_id=args.shard_id,
+                        advertise=args.advertise,
+                        poll_interval=args.poll_interval,
+                        staleness_bound_s=args.staleness_bound)
+    port = rep.start()
+    print(f"replica up on :{port} (primary={args.primary}, "
+          f"shard={args.shard_id}, "
+          f"staleness_bound={args.staleness_bound:g}s)", file=sys.stderr,
+          flush=True)
+    try:
+        while True:
+            time.sleep(1.0)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        rep.stop()
+    return 0
+
+
+def cmd_loadgen(args) -> int:
+    import json as _json
+
+    from .comms.loadgen import run_loadgen
+
+    result = run_loadgen(args.targets, duration_s=args.duration,
+                         concurrency=args.concurrency,
+                         mode=args.fetch_mode)
+    print("LOADGEN_JSON " + _json.dumps(result), flush=True)
+    print(f"{result['qps']:.1f} fetch/s aggregate over "
+          f"{len(result['targets'])} target(s) "
+          f"({result['fetches_err']} errors, "
+          f"{result['mb_per_s']:.2f} MB/s in)", file=sys.stderr)
+    return 0 if result["fetches_ok"] > 0 else 1
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if getattr(args, "platform", "default") == "cpu":
@@ -1150,7 +1328,8 @@ def main(argv=None) -> int:
         jax.config.update("jax_platforms", "cpu")
     return {"train": cmd_train, "serve": cmd_serve, "worker": cmd_worker,
             "experiments": cmd_experiments, "supervise": cmd_supervise,
-            "status": cmd_status}[args.command](args)
+            "status": cmd_status, "replica": cmd_replica,
+            "loadgen": cmd_loadgen}[args.command](args)
 
 
 if __name__ == "__main__":

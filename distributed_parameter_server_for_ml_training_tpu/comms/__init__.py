@@ -5,11 +5,18 @@ opaque pickled bytes (ps.proto:4-19, worker.py:289). Here the same lifecycle
 is exposed over gRPC for DCN/multi-host deployments — but with a safe
 length-prefixed tensor codec instead of pickle, and the TPU-native sync path
 (XLA collectives over ICI) not using this service at all.
+
+The sharded tier (docs/SHARDING.md) lives here too: ShardedRemoteStore
+fans a worker's pushes/fetches out across consistent-hash shard
+primaries, and ReplicaServer is the delta-fed read-only cache that
+serves the fetch path behind each shard.
 """
 
 from .wire import encode_tensor_dict, decode_tensor_dict
-from .service import ParameterService, serve
+from .service import ParameterService, RawJSON, serve
 from .client import RemoteStore, SessionLostError
+from .sharded import ShardedRemoteStore
+from .replica import ReplicaServer
 from .faults import FaultInjector, install_client_faults
 
 __all__ = [
@@ -18,7 +25,10 @@ __all__ = [
     "FaultInjector",
     "install_client_faults",
     "ParameterService",
+    "RawJSON",
+    "ReplicaServer",
     "serve",
     "RemoteStore",
     "SessionLostError",
+    "ShardedRemoteStore",
 ]

@@ -29,6 +29,7 @@ stale duplicate (round-4 ADVICE finding).
 
 from __future__ import annotations
 
+import json
 import time
 
 import grpc
@@ -36,7 +37,8 @@ import numpy as np
 
 from ..telemetry import current_wire_trace, now as _tnow, trace_span
 
-from .service import GRPC_OPTIONS, SERVICE_NAME, pack_msg, unpack_msg
+from .service import (GRPC_OPTIONS, SERVICE_NAME, RawJSON, pack_msg,
+                      unpack_msg)
 
 #: Transient codes worth retrying; anything else (e.g. INVALID_ARGUMENT,
 #: UNIMPLEMENTED) indicates a real protocol problem and raises immediately.
@@ -161,6 +163,22 @@ class RemoteStore:
         #: combinations — no provider, or a server that never advertised —
         #: attach nothing, so heartbeats degrade to plain pings.
         self.health_provider = None
+        #: Optional zero-arg callable returning a monotonic REVISION for
+        #: the provider's current report. When installed (PSWorker bumps
+        #: it on every report mutation), the JSON encode of the report is
+        #: cached per revision and spliced into the envelope as a
+        #: pre-encoded fragment (RawJSON) — heartbeat pings at replica-
+        #: refresh cadence were re-serializing an unchanged report per
+        #: RPC. Without it every attach re-encodes (legacy behavior).
+        self.health_revision = None
+        self._health_enc: tuple | None = None  # (revision, RawJSON)
+        #: Server-published shard map (docs/SHARDING.md), adopted from the
+        #: registration reply (its presence IS the capability) and
+        #: refreshed off fetch reply meta delta-gated on the version the
+        #: client sends back as ``have_shard_map``. None against an
+        #: unsharded server — the wire stays single-server.
+        self.shard_map = None
+        self._shard_map_version = 0
         self.config = _RemoteConfig()
         # Last membership seen on the wire (elastic servers piggyback it on
         # Register/Fetch replies). Workers fetch at least once per K-step
@@ -303,13 +321,22 @@ class RemoteStore:
         side fault injection survives the reset (same injector, same
         schedule state, re-installed over the fresh stubs); ad-hoc test
         wrappers around the old stubs do not — by the time a reset
-        happens their work (killing a server at call N) is done."""
-        old = self._channel
-        self._build_channel()
+        happens their work (killing a server at call N) is done.
+
+        Closes the abandoned channel BEFORE building its replacement:
+        close() releases the old channel's sockets/fds synchronously, so
+        a worker that reconnects many times (flapping network, chaos
+        drills) holds at most one channel at a time. The old order —
+        build first, close after — left a window per reset where two
+        channels were live, and an exception from _build_channel leaked
+        the old one entirely (tests/test_recovery.py pins the no-growth
+        invariant)."""
+        old, self._channel = self._channel, None
         try:
             old.close()
         except Exception:  # noqa: BLE001 — a dead channel may complain
             pass
+        self._build_channel()
 
     def wire_stats(self) -> dict:
         """Cumulative client-side wire accounting (bytes + per-RPC counts
@@ -377,6 +404,23 @@ class RemoteStore:
         table (PSWorker quantizes against it; docs/WIRE_PROTOCOL.md)."""
         return dict(self._qscales), self._qscale_step
 
+    def _note_shard_map(self, reply_meta: dict) -> None:
+        """Adopt a piggybacked shard map (register/fetch reply meta).
+        Validated before adoption; a garbled or older map degrades to the
+        cached one — routing must never regress off a bad refresh."""
+        m = reply_meta.get("shard_map")
+        if m is None:
+            return
+        from ..ps.sharding import validate_shard_map
+        try:
+            norm = validate_shard_map(m)
+        except ValueError:
+            return
+        if self.shard_map is None \
+                or norm["version"] >= self._shard_map_version:
+            self.shard_map = norm
+            self._shard_map_version = norm["version"]
+
     def membership_snapshot(self) -> list[int]:
         """Client-side view of the server's live membership (sorted ids),
         as of the most recent Register/Fetch reply. Empty until the first
@@ -440,6 +484,11 @@ class RemoteStore:
                 # server's version caught up.
                 self._qscales, self._qscale_step = {}, 0
                 self._note_qscales(reply)
+                # Same discipline for the shard map: a restarted primary's
+                # map versions restart from 1, so the cached version must
+                # not suppress the fresh map's adoption.
+                self.shard_map, self._shard_map_version = None, 0
+                self._note_shard_map(reply)
                 self.config.elastic = bool(reply.get("elastic", False))
                 self.config.mode = reply.get("mode", "sync")
                 self.config.learning_rate = float(
@@ -470,12 +519,27 @@ class RemoteStore:
         layer must never fail the RPC that would have carried it."""
         if not self.supports_health_report or self.health_provider is None:
             return
+        rev = None
+        if self.health_revision is not None:
+            try:
+                rev = self.health_revision()
+            except Exception:  # noqa: BLE001
+                rev = None
+        if rev is not None and self._health_enc is not None \
+                and self._health_enc[0] == rev:
+            meta["health"] = self._health_enc[1]
+            return
         try:
             report = self.health_provider()
         except Exception:  # noqa: BLE001
             return
         if isinstance(report, dict) and report:
-            meta["health"] = report
+            if rev is None:
+                meta["health"] = report
+                return
+            enc = RawJSON(json.dumps(report))
+            self._health_enc = (rev, enc)
+            meta["health"] = enc
 
     def fetch(self, worker_id: int | None = None,
               have_step: int | None = None
@@ -496,6 +560,10 @@ class RemoteStore:
             # Scale-table delta handshake: the server attaches qscales to
             # the reply only when its version is newer than this.
             meta["have_qscales"] = self._qscale_step
+        if self.shard_map is not None:
+            # Shard-map delta handshake (docs/SHARDING.md): the server
+            # attaches a map only when its version is newer than this.
+            meta["have_shard_map"] = self._shard_map_version
         if self.supports_trace_context:
             # A fetch request carries no tensor frame, so the trace
             # context rides the envelope meta (docs/WIRE_PROTOCOL.md);
@@ -508,6 +576,7 @@ class RemoteStore:
         self._note_membership(rmeta)
         self._note_qscales(rmeta)
         self._note_directives(rmeta)
+        self._note_shard_map(rmeta)
         if rmeta.get("not_modified"):
             self._tm_fetch_nm.inc()
             return {}, int(rmeta["global_step"])

@@ -1,0 +1,127 @@
+"""Fetch-path load generator (docs/SHARDING.md "Serve-path load").
+
+Drives ``FetchParameters`` at open-throttle concurrency against one or
+more targets (shard primaries and/or replicas) and reports aggregate
+QPS — the measurement tool behind the recorded ≥10× serve-path claim
+(experiments/run_shard_scale.py) and the ``fetch_qps`` field bench.py
+records.
+
+Deliberately NOT built on RemoteStore: the generator unpacks only the
+reply envelope and never decodes tensors, so the client side stays far
+from saturation and the measured ceiling is the SERVER's. Each worker
+thread owns its own channel (no client-side multiplexing bottleneck)
+and round-robins over the target list by thread index.
+
+Modes:
+- ``full``  — every fetch ships the whole model (the production read
+  workload: parameter consumers arriving cold).
+- ``delta`` — fetches carry ``have_step`` at the target's current step,
+  so an idle server answers header-only NOT_MODIFIED (the replica-
+  refresh / heartbeat workload).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import grpc
+
+from .service import GRPC_OPTIONS, SERVICE_NAME, pack_msg, unpack_msg
+
+__all__ = ["run_loadgen"]
+
+
+def _fetch_stub(channel):
+    ident = lambda b: b  # noqa: E731
+    return channel.unary_unary(f"/{SERVICE_NAME}/FetchParameters",
+                               request_serializer=ident,
+                               response_deserializer=ident)
+
+
+def run_loadgen(targets, duration_s: float = 5.0, concurrency: int = 4,
+                mode: str = "full", rpc_timeout: float = 10.0) -> dict:
+    """Hammer ``targets`` with fetches for ``duration_s`` using
+    ``concurrency`` threads; returns the aggregate result dict (also the
+    ``LOADGEN_JSON`` schema ``cli loadgen`` emits)."""
+    if isinstance(targets, str):
+        targets = [t for t in targets.split(",") if t]
+    if not targets:
+        raise ValueError("loadgen needs at least one target")
+    if mode not in ("full", "delta"):
+        raise ValueError(f"mode must be full|delta, got {mode!r}")
+
+    lock = threading.Lock()
+    per_target = {t: {"ok": 0, "err": 0, "bytes_in": 0,
+                      "not_modified": 0} for t in targets}
+    stop = threading.Event()
+
+    def worker(idx: int) -> None:
+        target = targets[idx % len(targets)]
+        channel = grpc.insecure_channel(target, options=GRPC_OPTIONS)
+        stub = _fetch_stub(channel)
+        ok = err = nbytes = nm = 0
+        have = None
+        if mode == "delta":
+            # Learn the target's current step once, then poll at it so
+            # the steady state is all NOT_MODIFIED replies.
+            try:
+                meta, _ = unpack_msg(stub(pack_msg({}),
+                                          timeout=rpc_timeout))
+                have = int(meta["global_step"])
+            except Exception:  # noqa: BLE001 — count as errors below
+                have = 0
+        request = pack_msg({} if have is None else {"have_step": have})
+        while not stop.is_set():
+            try:
+                reply = stub(request, timeout=rpc_timeout)
+            except Exception:  # noqa: BLE001 — grpc errors only
+                err += 1
+                continue
+            ok += 1
+            nbytes += len(reply)
+            if mode == "delta":
+                rmeta, _ = unpack_msg(reply)
+                if rmeta.get("not_modified"):
+                    nm += 1
+                else:
+                    # The target advanced: re-arm at the new step so the
+                    # loop keeps measuring the NM path, not full ships.
+                    have = int(rmeta["global_step"])
+                    request = pack_msg({"have_step": have})
+        channel.close()
+        with lock:
+            row = per_target[target]
+            row["ok"] += ok
+            row["err"] += err
+            row["bytes_in"] += nbytes
+            row["not_modified"] += nm
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(int(concurrency))]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    time.sleep(float(duration_s))
+    stop.set()
+    for t in threads:
+        t.join(timeout=max(10.0, rpc_timeout * 2))
+    elapsed = time.monotonic() - t0
+    total_ok = sum(r["ok"] for r in per_target.values())
+    total_err = sum(r["err"] for r in per_target.values())
+    total_bytes = sum(r["bytes_in"] for r in per_target.values())
+    return {
+        "targets": list(targets),
+        "mode": mode,
+        "concurrency": int(concurrency),
+        "duration_s": round(elapsed, 3),
+        "fetches_ok": total_ok,
+        "fetches_err": total_err,
+        "not_modified": sum(r["not_modified"]
+                            for r in per_target.values()),
+        "bytes_in": total_bytes,
+        "qps": round(total_ok / elapsed, 1) if elapsed > 0 else 0.0,
+        "mb_per_s": round(total_bytes / elapsed / 1e6, 2)
+        if elapsed > 0 else 0.0,
+        "per_target": per_target,
+    }

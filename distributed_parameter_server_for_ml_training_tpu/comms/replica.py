@@ -1,0 +1,211 @@
+"""Delta-fed read replica: the wide cheap tier of the sharded topology.
+
+A :class:`ReplicaServer` speaks the same four RPCs as a shard primary
+(docs/WIRE_PROTOCOL.md) but holds no store at all — it subscribes to its
+primary over the delta-fetch protocol (a background loop polls
+``FetchParameters`` with ``have_step``; an idle primary answers with the
+cached header-only NOT_MODIFIED reply, so an up-to-date replica costs the
+primary a few bytes per poll) and serves fetch traffic from **cached
+bytes**:
+
+- the primary's tensor payload is kept VERBATIM — never decoded — and the
+  full fetch reply is pre-encoded once per step, so serving a fetch is a
+  dict lookup plus a socket write (this, times N replicas, is the ≥10×
+  aggregate fetch-QPS lever the recorded experiment pins);
+- ``have_step`` fetches at the replica's current step get the pre-encoded
+  NOT_MODIFIED reply — the delta protocol composes through the tier.
+
+Writes don't belong here: RegisterWorker / PushGradrients / JobFinished
+answer a ``redirect`` to the primary (docs/SHARDING.md "Routing rules").
+
+**Staleness contract**: every successful poll (including NOT_MODIFIED —
+the primary confirming "your step is current" is freshness) stamps
+``last_sync``; once that stamp ages past ``staleness_bound_s`` the
+replica REFUSES fetches (UNAVAILABLE, redirect in the detail) instead of
+serving arbitrarily old params. A replica can be behind by at most one
+poll interval of real data, and a partitioned replica fails loud.
+
+Each poll announces ``replica: {shard_id, address}`` in the fetch meta;
+the primary's ShardInfo (ps/sharding.py) turns that plus ``have_step``
+into the published replica membership and the ``dps_replica_lag_*``
+gauges.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent import futures
+
+import grpc
+
+from .service import GRPC_OPTIONS, SERVICE_NAME, pack_msg, unpack_msg
+
+__all__ = ["ReplicaServer"]
+
+
+class ReplicaServer:
+    """Read-only cache of one shard primary, behind the PS wire."""
+
+    def __init__(self, primary: str, port: int = 0, shard_id: int = 0,
+                 advertise: str | None = None,
+                 poll_interval: float = 0.05,
+                 staleness_bound_s: float = 5.0,
+                 rpc_timeout: float = 10.0,
+                 clock=time.time):
+        self.primary = primary
+        self.port = int(port)
+        self.shard_id = int(shard_id)
+        #: The address announced to the primary (what the shard map
+        #: publishes to clients); filled from the bound port at start()
+        #: when not given.
+        self.advertise = advertise
+        self.poll_interval = float(poll_interval)
+        self.staleness_bound_s = float(staleness_bound_s)
+        self.rpc_timeout = float(rpc_timeout)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._step: int | None = None     # None until the first sync
+        self._reply: bytes = b""          # pre-encoded full fetch reply
+        self._nm_reply: bytes = b""       # pre-encoded NOT_MODIFIED reply
+        self._last_sync: float | None = None
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._server: grpc.Server | None = None
+        self._channel = None
+        self._fetch_stub = None
+        from ..telemetry import get_registry
+        reg = get_registry()
+        self._tm_fetches = reg.counter("dps_replica_fetches_total")
+        self._tm_refreshes = reg.counter("dps_replica_refreshes_total")
+        self._tm_stale = reg.counter("dps_replica_stale_rejects_total")
+        self._tm_redirects = reg.counter("dps_replica_redirects_total")
+        self._tm_step = reg.gauge("dps_replica_step")
+
+    # -- subscription (replica -> primary) -----------------------------------
+
+    def _poll_once(self) -> None:
+        """One refresh poll. The raw reply BYTES are the cache — the
+        tensor payload is never decoded here, so a replica's refresh
+        cost is the wire transfer plus one envelope re-pack, regardless
+        of model size."""
+        with self._lock:
+            have = self._step
+        meta: dict = {"replica": {"shard_id": self.shard_id,
+                                  "address": self.advertise}}
+        if have is not None:
+            meta["have_step"] = int(have)
+        raw = self._fetch_stub(pack_msg(meta), timeout=self.rpc_timeout)
+        rmeta, payload = unpack_msg(raw)
+        now = self.clock()
+        if rmeta.get("not_modified"):
+            with self._lock:
+                self._last_sync = now
+            return
+        step = int(rmeta["global_step"])
+        # Re-pack with the replica's own envelope over the primary's
+        # payload bytes, once per step; every client fetch then serves
+        # these exact bytes.
+        head = {"global_step": step, "replica": True,
+                "shard_id": self.shard_id}
+        reply = pack_msg(head, bytes(payload))
+        nm_reply = pack_msg({**head, "not_modified": True})
+        with self._lock:
+            self._step = step
+            self._reply = reply
+            self._nm_reply = nm_reply
+            self._last_sync = now
+        self._tm_refreshes.inc()
+        self._tm_step.set(step)
+
+    def _poll_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._poll_once()
+            except Exception:  # noqa: BLE001 — a dead primary stalls the
+                pass           # stamp; the staleness gate fails us loud.
+            self._stop.wait(self.poll_interval)
+
+    # -- serving (client -> replica) -----------------------------------------
+
+    def _fresh_or_abort(self, ctx):
+        now = self.clock()
+        with self._lock:
+            last = self._last_sync
+        if last is None or now - last > self.staleness_bound_s:
+            self._tm_stale.inc()
+            ctx.abort(grpc.StatusCode.UNAVAILABLE,
+                      f"replica stale (last sync "
+                      f"{'never' if last is None else round(now - last, 2)}"
+                      f"); use primary {self.primary}")
+
+    def _fetch_parameters(self, request: bytes, ctx) -> bytes:
+        self._fresh_or_abort(ctx)
+        meta, _ = unpack_msg(request)
+        have = meta.get("have_step")
+        self._tm_fetches.inc()
+        with self._lock:
+            if have is not None and self._step is not None \
+                    and int(have) == self._step:
+                return self._nm_reply
+            return self._reply
+
+    def _redirect(self, request: bytes, ctx) -> bytes:
+        self._tm_redirects.inc()
+        return pack_msg({"accepted": False, "received": False,
+                         "acknowledged": False, "replica": True,
+                         "redirect": self.primary,
+                         "shard_id": self.shard_id})
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> int:
+        """Bind, start serving and polling. Returns the bound port."""
+        ident = lambda b: b  # noqa: E731
+        handlers = grpc.method_handlers_generic_handler(SERVICE_NAME, {
+            name: grpc.unary_unary_rpc_method_handler(
+                fn, request_deserializer=ident, response_serializer=ident)
+            for name, fn in [("FetchParameters", self._fetch_parameters),
+                             ("RegisterWorker", self._redirect),
+                             ("PushGradrients", self._redirect),
+                             ("JobFinished", self._redirect)]
+        })
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=20),
+            options=GRPC_OPTIONS)
+        self._server.add_generic_rpc_handlers((handlers,))
+        bound = self._server.add_insecure_port(f"[::]:{self.port}")
+        self.port = bound
+        if self.advertise is None:
+            self.advertise = f"localhost:{bound}"
+        self._server.start()
+        self._channel = grpc.insecure_channel(self.primary,
+                                              options=GRPC_OPTIONS)
+        self._fetch_stub = self._channel.unary_unary(
+            f"/{SERVICE_NAME}/FetchParameters",
+            request_serializer=ident, response_deserializer=ident)
+        self._thread = threading.Thread(target=self._poll_loop,
+                                        name="replica-poll", daemon=True)
+        self._thread.start()
+        return bound
+
+    def stop(self, grace: float = 0.5) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        if self._server is not None:
+            self._server.stop(grace).wait()
+        if self._channel is not None:
+            self._channel.close()
+
+    def view(self) -> dict:
+        """Local status (cli replica logs it; tests poke it)."""
+        now = self.clock()
+        with self._lock:
+            last = self._last_sync
+            return {"primary": self.primary, "shard_id": self.shard_id,
+                    "address": self.advertise, "step": self._step,
+                    "synced": last is not None,
+                    "sync_age_s": (None if last is None
+                                   else round(max(0.0, now - last), 3)),
+                    "staleness_bound_s": self.staleness_bound_s}

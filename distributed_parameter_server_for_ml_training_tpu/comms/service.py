@@ -103,8 +103,27 @@ GRPC_OPTIONS = [
 ]
 
 
+class RawJSON(str):
+    """A pre-encoded JSON fragment. :func:`pack_msg` splices a RawJSON
+    value into the envelope verbatim instead of re-serializing it — the
+    hot-path cache for meta that changes rarely but rides every RPC (the
+    worker's piggybacked health report is re-encoded per heartbeat ping
+    today; comms/client.py caches it per report revision). The value MUST
+    be a complete, valid JSON document; nothing re-validates it here."""
+
+    __slots__ = ()
+
+
 def pack_msg(meta: dict, payload: bytes = b"") -> bytes:
-    header = json.dumps(meta).encode("utf-8")
+    raw = {k: v for k, v in meta.items() if isinstance(v, RawJSON)}
+    if raw:
+        base = json.dumps({k: v for k, v in meta.items()
+                           if not isinstance(v, RawJSON)})
+        frag = ",".join(f'"{k}":{v}' for k, v in raw.items())
+        header = (base[:-1] + ("," if len(base) > 2 else "")
+                  + frag + "}").encode("utf-8")
+    else:
+        header = json.dumps(meta).encode("utf-8")
     return struct.pack("<I", len(header)) + header + payload
 
 
@@ -123,8 +142,17 @@ class ParameterService:
     """Generic-handler implementation of the 4-RPC lifecycle."""
 
     def __init__(self, store: ParameterStore, faults=None, monitor=None,
-                 reject_nonfinite: bool = False):
+                 reject_nonfinite: bool = False, sharding=None):
         self.store = store
+        # Sharding state (ps/sharding.py ShardInfo): when set, this server
+        # is ONE shard primary of a consistent-hash partition — the
+        # registration reply publishes the shard map (that presence IS the
+        # capability advertisement), fetch replies refresh it delta-gated
+        # on the client's ``have_shard_map`` version, and replica
+        # announces riding fetch meta feed the live replica membership.
+        # None = single-server wire, byte-identical to every prior PR —
+        # same legacy-degradation discipline as delta_fetch/directives.
+        self.sharding = sharding
         # Self-healing guard (docs/ROBUSTNESS.md): a push whose OWN
         # piggybacked health report flags a non-finite loss/grad is
         # refused synchronously. The evidence and the poison ride the
@@ -205,6 +233,18 @@ class ParameterService:
         # action; docs/ROBUSTNESS.md).
         self._tm_quarantined = reg.counter(
             "dps_service_quarantined_pushes_total")
+        # Encoded header-only NOT_MODIFIED reply cache (single entry: the
+        # current step). At replica-refresh/heartbeat QPS the NM reply is
+        # the whole serve path, and re-running json.dumps + struct.pack
+        # per RPC dominated it; one idle step serves identical bytes to
+        # every poller. Keyed on everything that shapes the reply —
+        # entered only when the qscale/directive/shard-map attachments are
+        # empty — and invalidated by key mismatch when the step or the
+        # membership view moves.
+        self._nm_cache: tuple | None = None  # (key, encoded reply)
+        self._nm_lock = threading.Lock()
+        self._tm_nm_cache_hits = reg.counter(
+            "dps_fetch_nm_cache_hits_total")
 
     # -- directive channel (docs/ROBUSTNESS.md "Self-healing") ---------------
 
@@ -342,6 +382,40 @@ class ParameterService:
             return {}
         return {"qscales": scales, "qscale_step": step}
 
+    def _shard_fields(self, have_version=None) -> dict:
+        """Shard-map fields for a reply (docs/SHARDING.md): the full map
+        at registration (``have_version`` None — its presence there IS the
+        capability advertisement), then refreshed via fetch replies only
+        when the client's known version (``have_shard_map``) is older —
+        the same delta idiom as the qscale table. Unsharded servers
+        contribute nothing and the wire stays single-server."""
+        if self.sharding is None:
+            return {}
+        try:
+            have = None if have_version is None else int(have_version)
+        except (TypeError, ValueError):
+            have = None  # garbled version: resend the map, never fail
+        m = self.sharding.shard_map()
+        if have is not None and have >= m["version"]:
+            return {}
+        return {"shard_map": m}
+
+    def _note_replica(self, meta: dict) -> None:
+        """Ingest a replica announce riding fetch meta: ``replica:
+        {shard_id, address}`` plus the fetch's own ``have_step`` gives the
+        primary this replica's applied step — the lag source behind the
+        ``dps_replica_lag_*`` gauges and the published replica list.
+        Observability + routing metadata only; never fails the fetch."""
+        rep = meta.get("replica")
+        if self.sharding is None or not isinstance(rep, dict):
+            return
+        try:
+            self.sharding.note_replica(rep.get("address"),
+                                       meta.get("have_step", 0),
+                                       self.store.global_step)
+        except Exception:  # noqa: BLE001
+            pass
+
     def register_worker(self, request: bytes, ctx) -> bytes:
         meta, _ = unpack_msg(request)
         self._expire_tick()
@@ -417,6 +491,12 @@ class ParameterService:
             "directives": True,
             **self._qscale_fields(),
             **self._membership_fields(),
+            # Shard-map capability (docs/SHARDING.md): present only when
+            # this server runs as a shard primary. A capable client fans
+            # pushes/fetches out per the map and refreshes it via
+            # have_shard_map; a legacy client ignores the field and keeps
+            # talking to this one shard (it sees a key-subset store).
+            **self._shard_fields(),
         })
 
     def _ingest_health(self, worker_id, meta: dict) -> None:
@@ -594,6 +674,7 @@ class ParameterService:
         # envelope meta, so a delta-gated ping (header-only both ways)
         # still refreshes the cluster monitor's view of this worker.
         self._ingest_health(wid, meta)
+        self._note_replica(meta)
         have = meta.get("have_step")
         # Scale-table refresh rides the same reply (delta-gated on the
         # client's known version): new rounds move both the params and
@@ -602,6 +683,8 @@ class ParameterService:
         qfields = self._qscale_fields(meta["have_qscales"]) \
             if "have_qscales" in meta else {}
         dfields = self._directive_fields(wid, meta)
+        sfields = self._shard_fields(meta["have_shard_map"]) \
+            if "have_shard_map" in meta else {}
         if have is not None \
                 and getattr(self.store, "supports_delta_fetch", False):
             params, step = self.store.fetch(wid, have_step=int(have))
@@ -610,13 +693,29 @@ class ParameterService:
                 # advanced past what the client holds — the reply costs a
                 # header instead of the full model (the straggler-wait /
                 # polling fetch win; docs/WIRE_PROTOCOL.md).
-                return pack_msg({"global_step": step, "not_modified": True,
-                                 **qfields, **dfields,
-                                 **self._membership_fields()})
+                mfields = self._membership_fields()
+                if qfields or dfields or sfields:
+                    return pack_msg({"global_step": step,
+                                     "not_modified": True, **qfields,
+                                     **dfields, **sfields, **mfields})
+                # Attachment-free NM reply: serve the cached encode. The
+                # key folds in the membership view so an elastic join/
+                # leave at an unchanged step still invalidates.
+                key = (step, repr(mfields))
+                with self._nm_lock:
+                    if self._nm_cache is not None \
+                            and self._nm_cache[0] == key:
+                        self._tm_nm_cache_hits.inc()
+                        return self._nm_cache[1]
+                reply = pack_msg({"global_step": step,
+                                  "not_modified": True, **mfields})
+                with self._nm_lock:
+                    self._nm_cache = (key, reply)
+                return reply
         else:
             params, step = self.store.fetch(wid)
         return pack_msg({"global_step": step, **qfields, **dfields,
-                         **self._membership_fields()},
+                         **sfields, **self._membership_fields()},
                         encode_tensor_dict(params))
 
     def job_finished(self, request: bytes, ctx) -> bytes:

@@ -15,6 +15,8 @@ from .semantics import (
     DEFAULT_STALENESS_BOUND,
 )
 from .device_store import DeviceParameterStore
+from .sharding import (SHARD_SLOTS, ShardInfo, partition_keys,
+                       shard_for_key, validate_shard_map)
 from .store import ParameterStore, StoreConfig
 from .supervisor import SupervisorConfig, WorkerSupervisor
 from .worker import PSWorker, WorkerConfig, WorkerResult, run_workers
@@ -37,6 +39,11 @@ __all__ = [
     "ParameterStore",
     "DeviceParameterStore",
     "make_store",
+    "SHARD_SLOTS",
+    "ShardInfo",
+    "partition_keys",
+    "shard_for_key",
+    "validate_shard_map",
     "StoreConfig",
     "SupervisorConfig",
     "WorkerSupervisor",

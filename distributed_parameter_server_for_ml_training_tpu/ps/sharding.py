@@ -1,0 +1,274 @@
+"""Consistent-hash parameter sharding + the shard map (docs/SHARDING.md).
+
+The single parameter server holds every canonical tensor — the hard
+ceiling on both training fan-in and any serve-path read workload. This
+module is the partitioning layer under the sharded topology (ACE-Sync's
+two-tier shape, PAPERS.md): parameter NAMES are consistent-hashed into a
+fixed slot space, slot ranges are owned by N primary shards, and each
+shard may publish read-only replicas that subscribe to it over the
+delta-fetch protocol.
+
+Everything that routes — the worker's push/fetch fan-out
+(``comms/sharded.py``), each shard's key-subset filter (``cli serve
+--shard-index``), the replica announce path, the checkpoint identity
+check — derives from the same two pure functions here
+(:func:`shard_for_key` / :func:`partition_keys`), so no two layers can
+ever disagree about who owns a tensor.
+
+The **shard map** is the wire artifact (schema pinned both directions by
+``tests/test_docs_drift.py``): published in the registration reply when a
+server runs sharded, refreshed via fetch-reply meta exactly like the
+qscale table (the client sends ``have_shard_map``, the server attaches
+the map only when its version is newer), and capability-gated with the
+same legacy-degradation discipline as ``delta_fetch`` /
+``compressed_domain`` / ``directives`` — an unsharded server never
+advertises it, an old client never asks, and either pairing degrades to
+the single-server wire.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+
+__all__ = [
+    "SHARD_MAP_FIELDS",
+    "SHARD_SLOTS",
+    "ShardInfo",
+    "partition_keys",
+    "shard_for_key",
+    "slot_range",
+    "validate_shard_map",
+]
+
+#: Fixed consistent-hash slot space. Key -> slot assignment NEVER moves
+#: when the shard count changes; only the slot-range -> shard ownership
+#: does — so a rebalance remaps whole contiguous ranges instead of
+#: rehashing every tensor (docs/SHARDING.md "Rebalance semantics").
+SHARD_SLOTS = 64
+
+#: The shard-map wire schema: field name -> one-line meaning. This table
+#: IS the doc contract — ``tests/test_docs_drift.py`` pins it to
+#: docs/SHARDING.md's field table in both directions, the same discipline
+#: as metric/span/rule/codec/directive names.
+SHARD_MAP_FIELDS = {
+    "version": "monotonic map revision; refresh is delta-gated on it "
+               "(have_shard_map handshake)",
+    "slots": "size of the consistent-hash slot space (SHARD_SLOTS)",
+    "shard_count": "number of primary shards owning slot ranges",
+    "shards": "one entry per shard: shard_id, slot_range, primary, "
+              "replicas",
+    "shard_id": "this entry's shard index in [0, shard_count)",
+    "slot_range": "[lo, hi) slot interval this shard owns",
+    "primary": "the shard primary's host:port (push + authoritative "
+               "fetch)",
+    "replicas": "host:port list of live delta-fed read replicas behind "
+                "this shard",
+}
+
+
+def shard_for_key(name: str, shard_count: int,
+                  slots: int = SHARD_SLOTS) -> int:
+    """Owning shard index for a parameter name.
+
+    crc32 over the name, folded into the fixed slot space, then mapped to
+    the shard owning that slot's range. Pure and stable: every layer
+    (worker fan-out, shard key filter, checkpoint identity) computes the
+    same answer forever, and adding shards moves only whole slot ranges.
+    """
+    if shard_count < 1:
+        raise ValueError(f"shard_count must be >= 1, got {shard_count}")
+    slot = zlib.crc32(str(name).encode("utf-8")) % slots
+    # Contiguous ranges: shard i owns [i*slots//N, (i+1)*slots//N).
+    return min(shard_count - 1, slot * shard_count // slots)
+
+
+def slot_range(shard_id: int, shard_count: int,
+               slots: int = SHARD_SLOTS) -> tuple[int, int]:
+    """The [lo, hi) slot interval shard ``shard_id`` owns."""
+    if not 0 <= shard_id < shard_count:
+        raise ValueError(f"shard_id {shard_id} outside "
+                         f"[0, {shard_count})")
+    return (shard_id * slots // shard_count,
+            (shard_id + 1) * slots // shard_count)
+
+
+def partition_keys(keys, shard_count: int) -> list[list[str]]:
+    """Split parameter names into per-shard key lists (deterministic:
+    input order preserved within each shard). Every shard's serve process
+    and every worker derive the same partition from the same two
+    arguments — there is no partition state to distribute."""
+    out: list[list[str]] = [[] for _ in range(shard_count)]
+    for k in keys:
+        out[shard_for_key(k, shard_count)].append(k)
+    return out
+
+
+def validate_shard_map(m) -> dict:
+    """Validate a wire shard map; returns it normalized. Raises
+    ``ValueError`` on anything malformed — the CLIENT calls this before
+    adopting a refresh, so a garbled map degrades to the cached one
+    (the caller swallows the error), never to misrouted pushes."""
+    if not isinstance(m, dict):
+        raise ValueError("shard map must be an object")
+    try:
+        version = int(m["version"])
+        slots = int(m.get("slots", SHARD_SLOTS))
+        shard_count = int(m["shard_count"])
+        shards = m["shards"]
+    except (KeyError, TypeError, ValueError) as e:
+        raise ValueError(f"bad shard map: {e}") from e
+    if shard_count < 1 or slots < shard_count:
+        raise ValueError(f"bad shard map: shard_count={shard_count} "
+                         f"slots={slots}")
+    if not isinstance(shards, list) or len(shards) != shard_count:
+        raise ValueError("bad shard map: shards list does not match "
+                         "shard_count")
+    norm = []
+    for i, s in enumerate(shards):
+        if not isinstance(s, dict):
+            raise ValueError(f"bad shard entry {i}")
+        try:
+            sid = int(s["shard_id"])
+            primary = str(s["primary"])
+            lo, hi = (int(x) for x in s["slot_range"])
+            replicas = [str(r) for r in s.get("replicas", [])]
+        except (KeyError, TypeError, ValueError) as e:
+            raise ValueError(f"bad shard entry {i}: {e}") from e
+        if sid != i or (lo, hi) != slot_range(i, shard_count, slots):
+            raise ValueError(f"bad shard entry {i}: id/range mismatch")
+        norm.append({"shard_id": sid, "slot_range": [lo, hi],
+                     "primary": primary, "replicas": replicas})
+    return {"version": version, "slots": slots,
+            "shard_count": shard_count, "shards": norm}
+
+
+class ShardInfo:
+    """One shard primary's live sharding state (held by the
+    ``ParameterService`` when ``cli serve`` runs sharded).
+
+    Owns the authoritative copy of this server's shard map — the static
+    topology (``--shard-peers``) plus the LIVE replica membership learned
+    from replica announces riding fetch meta — and the replica lag
+    bookkeeping behind the ``dps_replica_lag_*`` gauges and the
+    ``GET /cluster`` / ``cli status`` shard rows.
+
+    Thread-safety: announces arrive on gRPC handler threads; the map and
+    the lag table are read by every registration/fetch reply and by the
+    monitor's view. One small lock covers both.
+    """
+
+    #: A replica silent for this long drops out of the published map (and
+    #: its lag gauges stop updating) — liveness is announce-driven, there
+    #: is no replica heartbeat channel.
+    REPLICA_EXPIRE_S = 30.0
+
+    def __init__(self, shard_id: int, shard_count: int,
+                 primaries: list[str], clock=time.time):
+        if len(primaries) != shard_count:
+            raise ValueError(
+                f"need one primary address per shard: got "
+                f"{len(primaries)} for shard_count={shard_count}")
+        if not 0 <= shard_id < shard_count:
+            raise ValueError(f"shard_id {shard_id} outside "
+                             f"[0, {shard_count})")
+        self.shard_id = int(shard_id)
+        self.shard_count = int(shard_count)
+        self.primaries = [str(p) for p in primaries]
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._version = 1
+        #: replica address -> {"step": int, "ts": float, "lag_steps": int}
+        self._replicas: dict[str, dict] = {}
+        from ..telemetry import get_registry
+        reg = get_registry()
+        self._tm_id = reg.gauge("dps_shard_id")
+        self._tm_count = reg.gauge("dps_shard_count")
+        self._tm_map_version = reg.gauge("dps_shard_map_version")
+        self._tm_replicas = reg.gauge("dps_shard_replicas")
+        self._tm_id.set(self.shard_id)
+        self._tm_count.set(self.shard_count)
+        self._tm_map_version.set(self._version)
+        self._reg = reg
+        self._tm_lag: dict[str, tuple] = {}
+
+    @property
+    def version(self) -> int:
+        with self._lock:
+            return self._version
+
+    def note_replica(self, address: str, step, global_step: int) -> None:
+        """Ingest one replica announce (rides the replica's refresh fetch
+        meta). A NEW address bumps the map version so subscribed clients
+        refresh; a known one just updates lag. Never raises — a garbled
+        announce must not fail the fetch that carried it."""
+        try:
+            addr = str(address)
+            have = int(step)
+        except (TypeError, ValueError):
+            return
+        now = self.clock()
+        lag = max(0, int(global_step) - have)
+        with self._lock:
+            fresh = addr not in self._replicas
+            self._replicas[addr] = {"step": have, "ts": now,
+                                    "lag_steps": lag}
+            if fresh:
+                self._version += 1
+                self._tm_map_version.set(self._version)
+            self._expire_locked(now)
+            self._tm_replicas.set(len(self._replicas))
+        if addr not in self._tm_lag:
+            self._tm_lag[addr] = (
+                self._reg.gauge("dps_replica_lag_steps", replica=addr),
+                self._reg.gauge("dps_replica_lag_seconds", replica=addr))
+        self._tm_lag[addr][0].set(lag)
+        self._tm_lag[addr][1].set(0.0)  # fresh announce = just synced
+
+    def _expire_locked(self, now: float) -> None:
+        dead = [a for a, r in self._replicas.items()
+                if now - r["ts"] > self.REPLICA_EXPIRE_S]
+        for a in dead:
+            del self._replicas[a]
+        if dead:
+            self._version += 1
+            self._tm_map_version.set(self._version)
+
+    def shard_map(self) -> dict:
+        """The current wire shard map (docs/SHARDING.md schema). Only
+        THIS shard's replica list is live-tracked here; peer shards'
+        replica lists are published by their own primaries — a client
+        merges maps per shard_id by version."""
+        now = self.clock()
+        with self._lock:
+            self._expire_locked(now)
+            shards = []
+            for i, primary in enumerate(self.primaries):
+                lo, hi = slot_range(i, self.shard_count)
+                shards.append({
+                    "shard_id": i, "slot_range": [lo, hi],
+                    "primary": primary,
+                    "replicas": (sorted(self._replicas)
+                                 if i == self.shard_id else []),
+                })
+            return {"version": self._version, "slots": SHARD_SLOTS,
+                    "shard_count": self.shard_count, "shards": shards}
+
+    def view(self) -> dict:
+        """The ``GET /cluster`` sharding block (rendered by
+        ``cli status``): identity, map version, and per-replica lag."""
+        now = self.clock()
+        with self._lock:
+            self._expire_locked(now)
+            replicas = [
+                {"address": a, "step": r["step"],
+                 "lag_steps": r["lag_steps"],
+                 "announce_age_s": round(max(0.0, now - r["ts"]), 3)}
+                for a, r in sorted(self._replicas.items())
+            ]
+            return {"shard_id": self.shard_id,
+                    "shard_count": self.shard_count,
+                    "map_version": self._version,
+                    "primaries": list(self.primaries),
+                    "replicas": replicas}

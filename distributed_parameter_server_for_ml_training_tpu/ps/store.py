@@ -119,6 +119,14 @@ class StoreConfig:
     # (>= 1 contribution). Composable with sync_quorum (whichever trips
     # first); None disables.
     round_deadline: float | None = None
+    # Shard identity (docs/SHARDING.md): when shard_count > 1 this store
+    # holds only the key subset consistent-hashing assigns to shard_index
+    # (cli serve filters the init params via ps/sharding.partition_keys).
+    # Carried in checkpoints so a restore into the WRONG shard slot — or
+    # into a differently-partitioned topology — is refused instead of
+    # silently serving another shard's tensors.
+    shard_index: int = 0
+    shard_count: int = 1
 
     def __post_init__(self):
         if self.mode not in ("sync", "async"):
@@ -151,6 +159,12 @@ class StoreConfig:
             # strict_rounds accounting (regression-pinned in
             # tests/test_selfheal.py).
             self.strict_rounds = True
+        if self.shard_count < 1 or not \
+                0 <= self.shard_index < self.shard_count:
+            raise ValueError(
+                f"shard_index must be in [0, shard_count) with "
+                f"shard_count >= 1; got index={self.shard_index} "
+                f"count={self.shard_count}")
 
 
 @dataclass

@@ -446,6 +446,11 @@ class PSWorker(threading.Thread):
         self._health: dict = {}
         self._health_enabled = False
         self._health_rate: tuple[float, int] | None = None
+        # Report revision, bumped under the lock on every mutation: lets
+        # the RemoteStore cache the report's JSON encode across the many
+        # heartbeat pings between boundary updates (comms/client.py
+        # health_revision).
+        self._health_rev = 0
         # Quantized-codec state (set up after registration, once the
         # store's negotiated codec is known): error-feedback residuals and
         # the per-layer bitwidth controller (docs/WIRE_PROTOCOL.md).
@@ -536,6 +541,7 @@ class PSWorker(threading.Thread):
                 with self._health_lock:
                     self._health["heartbeat_errors"] = \
                         self._health.get("heartbeat_errors", 0) + 1
+                    self._health_rev += 1
                 if not failing:
                     failing = True
                     print(f"HEARTBEAT_FAILING worker={self.worker_name} "
@@ -631,6 +637,13 @@ class PSWorker(threading.Thread):
         with self._health_lock:
             return dict(self._health) if self._health else None
 
+    def _health_revision(self) -> int:
+        """Companion provider: the report's revision, so the store can
+        reuse its cached JSON encode while the report is unchanged
+        (heartbeat pings far outnumber boundary updates)."""
+        with self._health_lock:
+            return self._health_rev
+
     def _note_health(self, loss, grads_tree, epoch: int,
                      grad_scale: float = 1.0) -> None:
         """Refresh the health report at a push boundary — the one place the
@@ -692,6 +705,7 @@ class PSWorker(threading.Thread):
             h["push_codec"] = codec + ("+ef" if self._ef is not None
                                        else "")
             h.setdefault("heartbeat_errors", 0)
+            self._health_rev += 1
 
     # -- directive channel (docs/ROBUSTNESS.md "Self-healing") ---------------
 
@@ -765,6 +779,8 @@ class PSWorker(threading.Thread):
         if getattr(self.store, "supports_health_report", False) \
                 and hasattr(self.store, "health_provider"):
             self.store.health_provider = self._health_snapshot
+            if hasattr(self.store, "health_revision"):
+                self.store.health_revision = self._health_revision
             self._health_enabled = True
         # Injected compute slowdown (comms/faults.py 'compute' pseudo-op):
         # the same --faults spec that drives RPC chaos can make THIS

@@ -165,6 +165,12 @@ class ClusterMonitor:
         #: Optional RemediationEngine; when set, cluster_view() carries
         #: its state under "remediation" (cli serve --remediate wires it).
         self.remediation = None
+        #: Optional sharding state (ps/sharding.py ShardInfo); when set,
+        #: cluster_view() carries shard identity, the live shard map
+        #: version, and per-replica lag under "sharding" (cli serve
+        #: --shard-count wires it) — the surface the remediation engine
+        #: and `cli status` read to act on a lagging replica.
+        self.sharding = None
 
         reg = registry or get_registry()
         # Alert counters pre-created for every rule so a scrape shows the
@@ -396,6 +402,11 @@ class ClusterMonitor:
         if self.remediation is not None:
             try:
                 out["remediation"] = self.remediation.view()
+            except Exception:  # noqa: BLE001
+                pass
+        if self.sharding is not None:
+            try:
+                out["sharding"] = self.sharding.view()
             except Exception:  # noqa: BLE001
                 pass
         return out

@@ -1,0 +1,704 @@
+"""Recorded sharded-serve-path demo (ISSUE 9 acceptance evidence).
+
+Four cells under ``experiments/results/sharding/``, every check
+exit-code-verified (the PR 4-7 recorded-demo format). Environment note
+recorded in the artifact: this container exposes ONE cpu, so
+process-parallel scale-out is not measurable here — the serve-path QPS
+lever this demo pins is the PER-REQUEST cost collapse of the read tier
+(cached-bytes replicas + delta polls) against the reference fetch path
+(the source paper's server ships the full model on every fetch,
+server.py:213-237).
+
+**Cell A — serve-path QPS, 1 shard + 4 replicas vs single-server
+control.** A control ``cli serve`` process takes ``cli loadgen`` full
+fetches (the reference fetch path). The scale topology — one primary
+with ``--shard-peers`` + four ``cli replica`` processes — takes the same
+loadgen in both modes against the replica tier. Headline check: the
+production read path (delta polls against the tier) sustains >= 10x the
+aggregate fetch QPS of the reference path against the control, while
+the primary's own fetch handler sees almost none of the consumer
+traffic (offload check: its call counter moves by replica polls, not by
+consumer fetches). Replica membership + zero lag are read live from
+``GET /cluster``.
+
+**Cell B/C — replica lag + exact training parity, real processes.**
+Control: single server + 1 sync worker. Sharded: two shard primaries
+(``--shard-count 2``) + a delta-fed replica behind shard 0 + the same
+worker driving ``--shards``. While training runs, shard 0's
+``GET /cluster`` sharding block is polled continuously: every observed
+replica lag must stay within the bound, and ``cli status`` during the
+run must render the shard/replica rows (exit code recorded). Parity
+check: the sharded run's per-epoch accuracy curve and local step count
+equal the control's EXACTLY — consistent-hash partitioning changes
+where tensors live, not one bit of the math.
+
+**Cell D — shard-primary kill+restart, journal-verified.** One shard
+primary (``--shard-index 1 --shard-count 2``) with periodic
+checkpoints: apply a tokened push, wait for the covering snapshot
+(stamped with its shard identity), SIGKILL, restart with ``--restore``,
+and replay the IDENTICAL push bytes — the restarted shard must answer
+``duplicate`` from its restored journal with the step unmoved (zero
+double-applies), then accept a genuinely new push.
+
+Artifacts: ``shard_scale.json`` (summary + PASS/FAIL checks), per-cell
+loadgen JSON, cluster/status captures, and server logs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT_DIR = os.path.join(REPO, "experiments", "results", "sharding")
+PKG = "distributed_parameter_server_for_ml_training_tpu"
+sys.path.insert(0, REPO)
+
+MODEL = "vit_tiny"
+LOADGEN_SECS = 5.0
+REPLICAS = 4
+LAG_BOUND_STEPS = 5          # cell B: every observed replica lag <= this
+STALENESS_BOUND_S = 5.0
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _env(**extra) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def _http(url: str, timeout: float = 5.0) -> str | None:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.read().decode()
+    except Exception:
+        return None
+
+
+def _cluster(port: int) -> dict | None:
+    raw = _http(f"http://127.0.0.1:{port}/cluster")
+    if raw is None:
+        return None
+    try:
+        return json.loads(raw)
+    except ValueError:
+        return None
+
+
+def _metric_value(metrics_text: str | None, name: str,
+                  labels: str = "") -> float | None:
+    import re
+    if not metrics_text:
+        return None
+    pat = re.compile(rf"^{re.escape(name + labels)} ([0-9.e+-]+)$", re.M)
+    m = pat.search(metrics_text)
+    return float(m.group(1)) if m else None
+
+
+def _spawn(argv: list[str], log_path: str, **env_extra) -> tuple:
+    log = open(log_path, "w")
+    proc = subprocess.Popen(argv, stdout=log, stderr=subprocess.STDOUT,
+                            env=_env(**env_extra), cwd=REPO)
+    return proc, log
+
+
+def _stop(proc, log, grace: float = 15.0) -> int | None:
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=grace)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=grace)
+    log.close()
+    return proc.returncode
+
+
+def _serve_argv(*, port: int, metrics_port: int, mode: str = "async",
+                workers: int = 1, extra: list[str] | None = None):
+    return [sys.executable, "-m", f"{PKG}.cli", "serve",
+            "--mode", mode, "--workers", str(workers),
+            "--port", str(port), "--model", MODEL, "--num-classes", "100",
+            "--image-size", "32", "--platform", "cpu",
+            "--metrics-port", str(metrics_port)] + (extra or [])
+
+
+def _wait_up(metrics_port: int, proc, what: str, timeout: float = 180.0):
+    deadline = time.time() + timeout
+    while _cluster(metrics_port) is None:
+        if time.time() > deadline or proc.poll() is not None:
+            raise RuntimeError(f"{what} never came up "
+                               f"(rc={proc.poll()})")
+        time.sleep(0.25)
+
+
+def _grpc_up(addr: str, timeout: float = 60.0) -> None:
+    """Block until a PS answers FetchParameters at ``addr``."""
+    from distributed_parameter_server_for_ml_training_tpu.comms.loadgen \
+        import run_loadgen
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        r = run_loadgen([addr], duration_s=0.2, concurrency=1,
+                        rpc_timeout=2.0)
+        if r["fetches_ok"] > 0:
+            return
+        time.sleep(0.5)
+    raise RuntimeError(f"no PS answering at {addr}")
+
+
+def _loadgen(targets: list[str], mode: str, name: str,
+             concurrency: int = 4) -> tuple[int, dict | None]:
+    """Run ``cli loadgen`` as a subprocess; returns (rc, LOADGEN_JSON)."""
+    p = subprocess.run(
+        [sys.executable, "-m", f"{PKG}.cli", "loadgen",
+         "--targets", ",".join(targets),
+         "--duration", str(LOADGEN_SECS),
+         "--concurrency", str(concurrency), "--fetch-mode", mode],
+        capture_output=True, text=True, env=_env(), cwd=REPO, timeout=300)
+    result = None
+    for line in p.stdout.splitlines():
+        if line.startswith("LOADGEN_JSON "):
+            result = json.loads(line[len("LOADGEN_JSON "):])
+    with open(os.path.join(OUT_DIR, f"loadgen_{name}.json"), "w") as f:
+        json.dump({"rc": p.returncode, "result": result}, f, indent=2)
+    return p.returncode, result
+
+
+def _run_status(metrics_port: int) -> tuple[int | None, str]:
+    try:
+        p = subprocess.run(
+            [sys.executable, "-m", f"{PKG}.cli", "status",
+             "--metrics-port", str(metrics_port)],
+            capture_output=True, text=True, env=_env(), cwd=REPO,
+            timeout=60)
+        return p.returncode, p.stdout + p.stderr
+    except subprocess.TimeoutExpired:
+        return None, "status timed out"
+
+
+# ---------------------------------------------------------------------------
+# Cell A: 1 shard + 4 replicas vs single-server control
+# ---------------------------------------------------------------------------
+
+def cell_a() -> tuple[dict, dict]:
+    procs = []
+    try:
+        # Control: one server, the reference fetch path.
+        c_port, c_metrics = _free_port(), _free_port()
+        control, c_log = _spawn(
+            _serve_argv(port=c_port, metrics_port=c_metrics),
+            os.path.join(OUT_DIR, "a_control_server.log"))
+        procs.append((control, c_log))
+        _wait_up(c_metrics, control, "cell A control server")
+        control_rc, control_full = _loadgen([f"localhost:{c_port}"],
+                                            "full", "control_full")
+        _, control_delta = _loadgen([f"localhost:{c_port}"], "delta",
+                                    "control_delta")
+        _stop(control, c_log)
+        procs.pop()
+
+        # Scale tier: 1 shard primary + 4 replicas.
+        p_port, p_metrics = _free_port(), _free_port()
+        primary, p_log = _spawn(
+            _serve_argv(port=p_port, metrics_port=p_metrics,
+                        extra=["--shard-count", "1",
+                               "--shard-peers", f"localhost:{p_port}"]),
+            os.path.join(OUT_DIR, "a_primary_server.log"))
+        procs.append((primary, p_log))
+        _wait_up(p_metrics, primary, "cell A shard primary")
+
+        rep_ports = [_free_port() for _ in range(REPLICAS)]
+        for i, rport in enumerate(rep_ports):
+            rep, r_log = _spawn(
+                [sys.executable, "-m", f"{PKG}.cli", "replica",
+                 "--primary", f"localhost:{p_port}", "--port", str(rport),
+                 "--poll-interval", "0.05",
+                 "--staleness-bound", str(STALENESS_BOUND_S)],
+                os.path.join(OUT_DIR, f"a_replica{i}.log"))
+            procs.append((rep, r_log))
+        targets = [f"localhost:{p}" for p in rep_ports]
+        for t in targets:
+            _grpc_up(t)
+
+        # Offload accounting: the primary's fetch handler should see the
+        # replicas' polls — a CONSTANT-rate cost (4 pollers at 20 Hz,
+        # independent of consumer load) — not the consumer traffic.
+        t_window = time.time()
+        before = _metric_value(
+            _http(f"http://127.0.0.1:{p_metrics}/metrics"),
+            "dps_rpc_handler_calls_total", '{rpc="FetchParameters"}') or 0
+        tier_rc, tier_delta = _loadgen(targets, "delta", "tier_delta",
+                                       concurrency=4)
+        _, tier_full = _loadgen(targets, "full", "tier_full",
+                                concurrency=4)
+        after = _metric_value(
+            _http(f"http://127.0.0.1:{p_metrics}/metrics"),
+            "dps_rpc_handler_calls_total", '{rpc="FetchParameters"}') or 0
+        t_window = time.time() - t_window
+        poll_budget = REPLICAS * t_window / 0.05 * 1.5 + 50
+        view = _cluster(p_metrics) or {}
+        with open(os.path.join(OUT_DIR, "a_cluster.json"), "w") as f:
+            json.dump(view, f, indent=2)
+        sharding = view.get("sharding") or {}
+
+        consumer_fetches = ((tier_delta or {}).get("fetches_ok", 0)
+                            + (tier_full or {}).get("fetches_ok", 0))
+        primary_fetch_delta = after - before
+        record = {
+            "model": MODEL,
+            "replicas": REPLICAS,
+            "loadgen_seconds": LOADGEN_SECS,
+            "control_full_qps": (control_full or {}).get("qps", 0.0),
+            "control_delta_qps": (control_delta or {}).get("qps", 0.0),
+            "tier_delta_qps": (tier_delta or {}).get("qps", 0.0),
+            "tier_full_qps": (tier_full or {}).get("qps", 0.0),
+            "headline_ratio": round(
+                (tier_delta or {}).get("qps", 0.0)
+                / max(1e-9, (control_full or {}).get("qps", 0.0)), 1),
+            "consumer_fetches_to_tier": consumer_fetches,
+            "primary_fetches_during_tier_load": primary_fetch_delta,
+            "offload_window_seconds": round(t_window, 1),
+            "replica_poll_budget": int(poll_budget),
+            "replica_membership": sharding.get("replicas", []),
+            "note": "single-cpu container: the lever measured here is "
+                    "per-request serve cost (cached-bytes replicas + "
+                    "delta polls) vs the reference full-fetch path, not "
+                    "process parallelism",
+        }
+        checks = {
+            "A_loadgen_exit_codes_zero":
+                control_rc == 0 and tier_rc == 0,
+            # The headline: production read path vs the reference fetch
+            # path, >= 10x aggregate QPS.
+            "A_read_tier_10x_vs_reference_fetch_path":
+                record["tier_delta_qps"]
+                >= 10.0 * record["control_full_qps"] > 0,
+            # Same-mode sanity: raw full-payload serving from the tier is
+            # no slower than the control's.
+            "A_tier_full_not_slower":
+                record["tier_full_qps"]
+                >= 0.9 * record["control_full_qps"],
+            # Offload: consumer traffic lands on replicas; the primary's
+            # fetch handler moved only by the (cheap, header-only,
+            # rate-bounded) replica polls — within the 4x20Hz poll
+            # budget for the window, and well under the consumer volume.
+            "A_primary_offloaded":
+                0 < primary_fetch_delta <= poll_budget
+                and primary_fetch_delta < 0.2 * max(1, consumer_fetches),
+            # Membership + lag live in GET /cluster: all 4 replicas
+            # announced, all fully caught up on the idle primary.
+            "A_replica_membership_live":
+                len(record["replica_membership"]) == REPLICAS
+                and all(r["lag_steps"] == 0
+                        for r in record["replica_membership"]),
+        }
+        return record, checks
+    finally:
+        for proc, log in procs:
+            _stop(proc, log)
+
+
+# ---------------------------------------------------------------------------
+# Cells B + C: replica lag under live training, exact sharded parity
+# ---------------------------------------------------------------------------
+
+def _worker_argv(server_args: list[str], name: str) -> list[str]:
+    return [sys.executable, "-m", f"{PKG}.cli", "worker",
+            *server_args, "--worker-name", name,
+            "--model", MODEL, "--synthetic",
+            "--num-train", "256", "--num-test", "96",
+            "--epochs", "2", "--batch-size", "32",
+            "--dtype", "float32", "--no-augment",
+            "--seed", "0", "--platform", "cpu", "--emit-metrics"]
+
+
+def _worker_metrics(log_path: str) -> dict | None:
+    from distributed_parameter_server_for_ml_training_tpu.utils.metrics \
+        import parse_metrics_lines
+    recs = [r for r in parse_metrics_lines(open(log_path).read())
+            if "final_test_accuracy" in r]
+    return recs[-1] if recs else None
+
+
+def cell_bc() -> tuple[dict, dict]:
+    procs = []
+    try:
+        # Control: single server, one sync worker.
+        c_port, c_metrics = _free_port(), _free_port()
+        control, c_log = _spawn(
+            _serve_argv(port=c_port, metrics_port=c_metrics, mode="sync"),
+            os.path.join(OUT_DIR, "c_control_server.log"))
+        procs.append((control, c_log))
+        _wait_up(c_metrics, control, "cell C control server")
+        wlog = os.path.join(OUT_DIR, "c_control_worker.log")
+        w = subprocess.run(
+            _worker_argv(["--server", f"localhost:{c_port}"], "ctl-0"),
+            stdout=open(wlog, "w"), stderr=subprocess.STDOUT,
+            env=_env(), cwd=REPO, timeout=1200)
+        control_worker_rc = w.returncode
+        control_metrics = _worker_metrics(wlog)
+        _stop(control, c_log)
+        procs.pop()
+
+        # Sharded: 2 primaries + a delta-fed replica behind shard 0.
+        ports = [_free_port(), _free_port()]
+        metrics_ports = [_free_port(), _free_port()]
+        peers = ",".join(f"localhost:{p}" for p in ports)
+        shards = []
+        for i in range(2):
+            sp, s_log = _spawn(
+                _serve_argv(port=ports[i], metrics_port=metrics_ports[i],
+                            mode="sync",
+                            extra=["--shard-index", str(i),
+                                   "--shard-count", "2",
+                                   "--shard-peers", peers]),
+                os.path.join(OUT_DIR, f"c_shard{i}_server.log"))
+            procs.append((sp, s_log))
+            shards.append(sp)
+        for i in range(2):
+            _wait_up(metrics_ports[i], shards[i], f"cell C shard {i}")
+        rep_port = _free_port()
+        rep, r_log = _spawn(
+            [sys.executable, "-m", f"{PKG}.cli", "replica",
+             "--primary", f"localhost:{ports[0]}",
+             "--port", str(rep_port), "--poll-interval", "0.05",
+             "--staleness-bound", str(STALENESS_BOUND_S)],
+            os.path.join(OUT_DIR, "c_replica.log"))
+        procs.append((rep, r_log))
+        _grpc_up(f"localhost:{rep_port}")
+
+        swlog = os.path.join(OUT_DIR, "c_sharded_worker.log")
+        worker = subprocess.Popen(
+            _worker_argv(["--server", f"localhost:{ports[0]}",
+                          "--shards", peers], "shard-0"),
+            stdout=open(swlog, "w"), stderr=subprocess.STDOUT,
+            env=_env(), cwd=REPO)
+
+        # Cell B evidence, captured MID-RUN: poll shard 0's sharding
+        # block for replica lag; grab cli status once the replica has
+        # announced. The shard primaries exit on their own once the
+        # worker reports JobFinished, so catch-up evidence is the LAST
+        # live sample, not a post-mortem read.
+        lags, max_age = [], 0.0
+        last_sharding: dict | None = None
+        lag_gauge_mid: float | None = None
+        status_cap: tuple[int | None, str] | None = None
+        deadline = time.time() + 1200
+
+        def _sample() -> bool:
+            nonlocal max_age, last_sharding
+            view = _cluster(metrics_ports[0])
+            if not view:
+                return False
+            sh = view.get("sharding")
+            if sh and sh["replicas"]:
+                last_sharding = sh
+                for r in sh["replicas"]:
+                    lags.append(r["lag_steps"])
+                    max_age = max(max_age, r["announce_age_s"])
+            return True
+
+        while worker.poll() is None and time.time() < deadline:
+            if _sample() and status_cap is None and lags:
+                status_cap = _run_status(metrics_ports[0])
+                lag_gauge_mid = _metric_value(
+                    _http(f"http://127.0.0.1:{metrics_ports[0]}"
+                          "/metrics"),
+                    "dps_replica_lag_steps",
+                    f'{{replica="localhost:{rep_port}"}}')
+            time.sleep(0.25)
+        worker.wait(timeout=60)
+        sharded_worker_rc = worker.returncode
+        sharded_metrics = _worker_metrics(swlog)
+
+        # Keep sampling until the primary leaves: the final samples show
+        # the replica converged to the shard's last step.
+        grace = time.time() + 15
+        while time.time() < grace and _sample():
+            time.sleep(0.1)
+        with open(os.path.join(OUT_DIR, "c_cluster.json"), "w") as f:
+            json.dump(last_sharding, f, indent=2)
+        if status_cap is not None:
+            with open(os.path.join(OUT_DIR, "c_status.txt"), "w") as f:
+                f.write(f"# cli status exit code: {status_cap[0]}\n\n"
+                        f"{status_cap[1]}")
+        final_reps = (last_sharding or {}).get("replicas", [])
+        final_step = (sharded_metrics or {}).get("local_steps_completed")
+
+        record = {
+            "control_worker_rc": control_worker_rc,
+            "sharded_worker_rc": sharded_worker_rc,
+            "control": {k: control_metrics.get(k) for k in
+                        ("all_test_accuracies", "local_steps_completed",
+                         "final_test_accuracy")} if control_metrics
+                       else None,
+            "sharded": {k: sharded_metrics.get(k) for k in
+                        ("all_test_accuracies", "local_steps_completed",
+                         "final_test_accuracy")} if sharded_metrics
+                       else None,
+            "lag_samples": len(lags),
+            "max_lag_steps_observed": max(lags) if lags else None,
+            "max_announce_age_s_observed": round(max_age, 3),
+            "mid_run_replica_lag_steps_gauge": lag_gauge_mid,
+            "final_replicas": final_reps,
+            "final_local_steps": final_step,
+            "status_rc": (status_cap or (None, ""))[0],
+            "status_has_shard_rows": bool(
+                status_cap and "shard:" in status_cap[1]
+                and "replica " in status_cap[1]),
+        }
+        checks = {
+            "B_workers_clean_exit":
+                control_worker_rc == 0 and sharded_worker_rc == 0,
+            "B_replica_lag_within_bound":
+                bool(lags) and max(lags) <= LAG_BOUND_STEPS,
+            "B_replica_announces_fresh":
+                bool(lags) and max_age <= STALENESS_BOUND_S,
+            "B_replica_caught_up_to_final_step":
+                bool(final_reps) and final_reps[0]["lag_steps"] == 0
+                and final_reps[0]["step"] == final_step,
+            "B_status_renders_shard_rows":
+                record["status_has_shard_rows"]
+                and record["status_rc"] == 0,
+            # Cell C: EXACT parity — accuracy-vs-step curve and step
+            # count identical between sharded and single-server runs.
+            "C_accuracy_curve_exactly_equal":
+                control_metrics is not None
+                and sharded_metrics is not None
+                and control_metrics["all_test_accuracies"]
+                == sharded_metrics["all_test_accuracies"]
+                and len(control_metrics["all_test_accuracies"]) == 2,
+            "C_step_count_equal":
+                control_metrics is not None
+                and sharded_metrics is not None
+                and control_metrics["local_steps_completed"]
+                == sharded_metrics["local_steps_completed"] > 0,
+        }
+        return record, checks
+    finally:
+        for proc, log in procs:
+            _stop(proc, log)
+
+
+# ---------------------------------------------------------------------------
+# Cell D: shard-primary kill+restart, journal-verified exactly-once
+# ---------------------------------------------------------------------------
+
+def cell_d() -> tuple[dict, dict]:
+    import glob
+
+    import grpc as grpc_mod
+    import numpy as np
+
+    from distributed_parameter_server_for_ml_training_tpu.comms.service \
+        import GRPC_OPTIONS, SERVICE_NAME, pack_msg, unpack_msg
+    from distributed_parameter_server_for_ml_training_tpu.comms.wire \
+        import decode_tensor_dict, encode_tensor_dict
+
+    ckpt_dir = os.path.join(OUT_DIR, "d_ckpt")
+    os.makedirs(ckpt_dir, exist_ok=True)
+    for f in glob.glob(os.path.join(ckpt_dir, "*")):
+        os.remove(f)
+    port = _free_port()
+    argv = _serve_argv(
+        port=port, metrics_port=_free_port(), mode="sync",
+        extra=["--shard-index", "1", "--shard-count", "2",
+               "--shard-peers", f"localhost:1,localhost:{port}",
+               "--checkpoint-dir", ckpt_dir,
+               "--checkpoint-interval", "0.5"])
+
+    def stub(name):
+        ch = grpc_mod.insecure_channel(f"localhost:{port}",
+                                       options=GRPC_OPTIONS)
+        return ch, ch.unary_unary(f"/{SERVICE_NAME}/{name}",
+                                  request_serializer=lambda b: b,
+                                  response_deserializer=lambda b: b)
+
+    def rpc(name, req, timeout=20.0):
+        ch, s = stub(name)
+        try:
+            return unpack_msg(s(req, timeout=timeout))
+        finally:
+            ch.close()
+
+    server, log = _spawn(argv, os.path.join(OUT_DIR, "d_shard1.log"))
+    record: dict = {"checkpoint_dir": os.path.relpath(ckpt_dir, REPO)}
+    try:
+        _grpc_up(f"localhost:{port}", timeout=180.0)
+
+        # This shard owns the shard-1 key subset of the model; build a
+        # matching gradient from the served parameters themselves.
+        meta, _ = rpc("RegisterWorker", pack_msg({"worker_name": "d"}))
+        wid = meta["worker_id"]
+        fmeta, payload = rpc("FetchParameters", pack_msg({}))
+        params0 = {k: np.array(v) for k, v in
+                   decode_tensor_dict(payload).items()}
+        record["shard1_tensors"] = len(params0)
+        grads = {k: np.full(v.shape, 0.01, np.float32)
+                 for k, v in params0.items()}
+        push1 = pack_msg({"worker_id": wid, "fetched_step": 0,
+                          "push_token": "demo:1"},
+                         encode_tensor_dict(grads))
+        m1, _ = rpc("PushGradrients", push1)
+        record["push1"] = {"accepted": m1["accepted"],
+                           "duplicate": bool(m1.get("duplicate"))}
+        fmeta, payload = rpc("FetchParameters", pack_msg({}))
+        step_after_push = int(fmeta["global_step"])
+        params1 = {k: np.array(v) for k, v in
+                   decode_tensor_dict(payload).items()}
+
+        # Wait for a snapshot covering the push, stamped with the shard
+        # identity.
+        covering = None
+        deadline = time.time() + 60
+        while covering is None and time.time() < deadline:
+            for mf in glob.glob(os.path.join(ckpt_dir, "*.json")):
+                try:
+                    snap = json.load(open(mf))
+                except ValueError:
+                    continue
+                if snap.get("global_step", -1) >= step_after_push:
+                    covering = snap
+            time.sleep(0.2)
+        if covering is None:
+            raise RuntimeError("no covering snapshot appeared")
+        record["snapshot_shard_identity"] = covering.get("shard")
+        record["snapshot_journal"] = [
+            {"nonce": e["nonce"], "count": e["count"],
+             "accepted": e["accepted"]}
+            for e in covering.get("push_journal", [])]
+
+        # Crash the shard primary (SIGKILL: no clean shutdown path).
+        server.send_signal(signal.SIGKILL)
+        server.wait(timeout=30)
+        log.close()
+
+        # Restart with --restore on the same port and identity.
+        server, log = _spawn(argv + ["--restore"],
+                             os.path.join(OUT_DIR, "d_shard1_restart.log"))
+        _grpc_up(f"localhost:{port}", timeout=180.0)
+        restart_log = open(os.path.join(OUT_DIR,
+                                        "d_shard1_restart.log")).read()
+        record["restore_line"] = next(
+            (ln.strip() for ln in restart_log.splitlines()
+             if "restored store at step" in ln), None)
+
+        # Session resume, then the IDENTICAL push bytes: the journal
+        # must replay, not re-apply.
+        rpc("RegisterWorker", pack_msg({"worker_name": "d"}))
+        m2, _ = rpc("PushGradrients", push1)
+        record["replay"] = {"accepted": m2["accepted"],
+                            "duplicate": bool(m2.get("duplicate"))}
+        fmeta, payload = rpc("FetchParameters", pack_msg({}))
+        record["step_after_replay"] = int(fmeta["global_step"])
+        params2 = {k: np.array(v) for k, v in
+                   decode_tensor_dict(payload).items()}
+        params_equal = (sorted(params1) == sorted(params2)
+                        and all(np.array_equal(params1[k], params2[k])
+                                for k in params1))
+        params_moved_once = any(not np.array_equal(params0[k], params1[k])
+                                for k in params0)
+
+        # A genuinely new push still applies on the recovered shard.
+        m3, _ = rpc("PushGradrients",
+                    pack_msg({"worker_id": wid, "fetched_step": 1,
+                              "push_token": "demo:2"},
+                             encode_tensor_dict(grads)))
+        fmeta, _ = rpc("FetchParameters", pack_msg({}))
+        record["step_after_new_push"] = int(fmeta["global_step"])
+
+        checks = {
+            "D_push_applied_before_crash":
+                record["push1"]["accepted"]
+                and not record["push1"]["duplicate"]
+                and step_after_push == 1 and params_moved_once,
+            "D_snapshot_stamped_with_shard_identity":
+                record["snapshot_shard_identity"]
+                == {"shard_index": 1, "shard_count": 2},
+            "D_journal_in_snapshot":
+                record["snapshot_journal"]
+                == [{"nonce": "demo", "count": 1, "accepted": True}],
+            "D_restore_reseeded_journal":
+                record["restore_line"] is not None
+                and "+1 journaled push tokens" in record["restore_line"],
+            "D_replay_deduped_zero_double_applies":
+                record["replay"]["duplicate"]
+                and record["replay"]["accepted"]
+                and record["step_after_replay"] == 1 and params_equal,
+            "D_new_push_applies_after_recovery":
+                m3["accepted"] and not m3.get("duplicate")
+                and record["step_after_new_push"] == 2,
+        }
+        return record, checks
+    finally:
+        _stop(server, log)
+
+
+def main(argv=None) -> int:
+    import argparse
+    global OUT_DIR
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out-dir", default=OUT_DIR,
+                    help="artifact directory (default: the recorded "
+                         "experiments/results/sharding)")
+    args = ap.parse_args(argv)
+    OUT_DIR = args.out_dir
+    os.makedirs(OUT_DIR, exist_ok=True)
+    t0 = time.time()
+    checks: dict = {}
+
+    a_rec, a_checks = cell_a()
+    checks.update(a_checks)
+    print(f"cell A: control_full={a_rec['control_full_qps']:.1f} qps, "
+          f"tier_delta={a_rec['tier_delta_qps']:.1f} qps "
+          f"(x{a_rec['headline_ratio']}), "
+          f"{len(a_rec['replica_membership'])} replicas live", flush=True)
+
+    bc_rec, bc_checks = cell_bc()
+    checks.update(bc_checks)
+    print(f"cell B/C: max lag {bc_rec['max_lag_steps_observed']} step(s) "
+          f"over {bc_rec['lag_samples']} samples; parity "
+          f"{'EXACT' if bc_checks['C_accuracy_curve_exactly_equal'] else 'BROKEN'}",
+          flush=True)
+
+    d_rec, d_checks = cell_d()
+    checks.update(d_checks)
+    print(f"cell D: replay duplicate={d_rec['replay']['duplicate']}, "
+          f"step stayed {d_rec['step_after_replay']}", flush=True)
+
+    record = {
+        "demo": "sharded parameter server + delta-fed read replicas "
+                "(ISSUE 9)",
+        "elapsed_seconds": round(time.time() - t0, 1),
+        "environment": {"cpus": os.cpu_count()},
+        "checks": checks,
+        "all_pass": all(checks.values()),
+        "cell_a": a_rec,
+        "cell_bc": bc_rec,
+        "cell_d": d_rec,
+    }
+    with open(os.path.join(OUT_DIR, "shard_scale.json"), "w") as f:
+        json.dump(record, f, indent=2)
+    n_pass = sum(bool(v) for v in checks.values())
+    print(f"shard scale demo: {n_pass}/{len(checks)} checks PASS "
+          f"({record['elapsed_seconds']}s)")
+    for name, ok in checks.items():
+        print(f"  [{'PASS' if ok else 'FAIL'}] {name}")
+    return 0 if record["all_pass"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -229,6 +229,49 @@ def test_policy_table_rules_and_actions_exist():
         f"{doc_policy} code={code_policy}")
 
 
+SHARD_DOC = REPO / "docs" / "SHARDING.md"
+
+
+def test_shard_map_fields_documented_and_vice_versa():
+    """ISSUE 9 satellite: the shard map is the wire artifact workers
+    route pushes by — ``SHARD_MAP_FIELDS`` is pinned to docs/SHARDING.md's
+    field table in both directions, same discipline as metrics/codecs."""
+    from distributed_parameter_server_for_ml_training_tpu.ps.sharding \
+        import SHARD_MAP_FIELDS
+
+    section = _doc_section(SHARD_DOC.read_text(), "### Shard map schema")
+    doc_fields = set(_DOC_NAME_ROW_RE.findall(section))
+    schema = set(SHARD_MAP_FIELDS)
+    missing_from_doc = sorted(schema - doc_fields)
+    unknown_in_doc = sorted(doc_fields - schema)
+    assert not missing_from_doc, (
+        f"SHARD_MAP_FIELDS absent from docs/SHARDING.md's field table: "
+        f"{missing_from_doc}")
+    assert not unknown_in_doc, (
+        f"docs/SHARDING.md documents shard-map fields not in "
+        f"SHARD_MAP_FIELDS (renamed or removed?): {unknown_in_doc}")
+
+
+def test_sharding_metric_families_pinned_both_directions():
+    """The general metric pin already guards every dps_* name; this makes
+    the ISSUE 9 families an explicit contract — removing or renaming the
+    shard/replica-lag gauges must fail HERE with a sharding-specific
+    message, not only in the catch-all diff."""
+    registered: set[str] = set()
+    for _, text in _package_sources():
+        registered |= set(_REG_RE.findall(text))
+    documented = set(_DOC_METRIC_RE.findall(OBS_DOC.read_text()))
+    families = {"dps_shard_id", "dps_shard_count",
+                "dps_shard_map_version", "dps_shard_replicas",
+                "dps_replica_lag_steps", "dps_replica_lag_seconds"}
+    assert families <= registered, (
+        f"sharding metrics no longer registered: "
+        f"{sorted(families - registered)}")
+    assert families <= documented, (
+        f"sharding metrics missing from docs/OBSERVABILITY.md: "
+        f"{sorted(families - documented)}")
+
+
 def test_catalog_names_are_namespaced_and_lowercase():
     for name in SPAN_CATALOG:
         assert re.fullmatch(r"[a-z]+\.[a-z_]+", name), name

@@ -622,6 +622,7 @@ class TestHeartbeatHardening:
         w.worker_name = "hb-test"
         w._health_lock = threading.Lock()
         w._health = {}
+        w._health_rev = 0
         from distributed_parameter_server_for_ml_training_tpu.ps.worker import (
             WorkerResult)
         w.result = WorkerResult(worker_id=0)

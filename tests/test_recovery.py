@@ -404,6 +404,143 @@ class TestWorkerSessionResume:
         assert w._repush_viable(old_fetched=5, server_step=4) is False
 
 
+class TestChannelLifecycle:
+    """ISSUE 9 satellite: ``reset_channel`` must close the abandoned gRPC
+    channel BEFORE replacing it — each leaked channel keeps an OS socket
+    and its worker thread alive, so a worker riding many reconnects grows
+    file descriptors without bound."""
+
+    def test_repeated_reconnects_do_not_grow_open_channels(self, monkeypatch):
+        created, closed = [], []
+        real_insecure_channel = grpc.insecure_channel
+
+        class TrackedChannel:
+            def __init__(self, inner):
+                self._inner = inner
+
+            def close(self):
+                if self not in closed:
+                    closed.append(self)
+                return self._inner.close()
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+        def tracked(address, *args, **kwargs):
+            ch = TrackedChannel(real_insecure_channel(address, *args,
+                                                     **kwargs))
+            created.append(ch)
+            return ch
+
+        monkeypatch.setattr(grpc, "insecure_channel", tracked)
+        client = RemoteStore("localhost:1", rpc_retries=1,
+                             rpc_backoff=0.01, rpc_timeout=1.0)
+        assert len(created) == 1
+        for _ in range(5):
+            client.reset_channel()
+        assert len(created) == 6
+        # Every abandoned channel was closed at the moment it was
+        # replaced; only the newest stays open.
+        assert closed == created[:-1]
+        client.close()
+        assert closed == created
+
+
+class TestShardedExactlyOnce:
+    """ISSUE 9 satellite: the exactly-once machinery is PER SHARD — each
+    primary journals only its own key subset, a push token survives its
+    shard's kill+restart even when the shard map was refreshed in
+    between, and zombie-token ordering holds independently on every
+    shard."""
+
+    def _shard(self, i, n=2, register=True):
+        from distributed_parameter_server_for_ml_training_tpu.ps.sharding \
+            import ShardInfo
+        store = ParameterStore(
+            {"w": np.ones(4, np.float32)},
+            StoreConfig(mode="sync", total_workers=1, push_codec="none",
+                        shard_index=i, shard_count=n))
+        if register:
+            store.register_worker()
+        svc = ParameterService(store, sharding=ShardInfo(
+            i, n, [f"localhost:{7000 + j}" for j in range(n)]))
+        return store, svc
+
+    def test_push_token_spans_map_refresh_and_shard_restart(self, tmp_path):
+        """Per shard: apply a push, bump the shard-map version via a
+        replica announce (the refresh the token must span), snapshot,
+        kill, restore a fresh process with the SAME shard identity — the
+        client's retry must replay from the journal, not re-apply, and
+        the restarted primary must serve the map on ``have_shard_map``."""
+        for i in range(2):
+            store1, svc1 = self._shard(i, register=False)
+            rmeta, _ = unpack_msg(svc1.register_worker(
+                pack_msg({"worker_name": "w"}), None))
+            # Shard map rides the registration reply (the capability).
+            v0 = rmeta["shard_map"]["version"]
+            assert rmeta["shard_map"]["shards"][i]["shard_id"] == i
+
+            req = _push_request(rmeta["worker_id"], f"sh{i}:1", 0.5)
+            m1, _ = unpack_msg(svc1.push_gradrients(req, None))
+            assert m1["accepted"] and store1.global_step == 1
+
+            # A replica announce lands between the apply and the retry:
+            # the map version moves while the token is outstanding.
+            svc1.fetch_parameters(pack_msg(
+                {"replica": {"shard_id": i, "address": "localhost:9909"},
+                 "have_step": 1}), None)
+            assert svc1.sharding.version > v0
+
+            path = tmp_path / f"shard{i}"
+            save_store(store1, str(path), journal_fn=svc1.journal_snapshot)
+            # The shard primary dies; a new process with the same
+            # identity restores its OWN checkpoint+journal.
+            store2, svc2 = self._shard(i)
+            step, journal_n = restore_server_state(store2, svc2, str(path))
+            assert (step, journal_n) == (1, 1)
+
+            # Retry (same bytes) replays across the restart+refresh: no
+            # double-apply on this shard.
+            m2, _ = unpack_msg(svc2.push_gradrients(req, None))
+            assert m2.get("duplicate") is True and m2["accepted"]
+            assert store2.global_step == 1
+            np.testing.assert_array_equal(store2.parameters["w"],
+                                          store1.parameters["w"])
+
+            # The restarted primary republishes its map via the same
+            # delta handshake the refresh used.
+            fmeta, _ = unpack_msg(svc2.fetch_parameters(
+                pack_msg({"have_shard_map": 0}), None))
+            assert fmeta["shard_map"]["shard_count"] == 2
+            assert fmeta["shard_map"]["shards"][i]["shard_id"] == i
+
+    def test_zombie_token_ordering_holds_per_shard(self):
+        """The zombie-token scenario on every shard of a 2-shard
+        topology: push n:1, then n:2; a late zombie n:1 must neither
+        re-apply nor evict n:2's record on ITS shard."""
+        for i in range(2):
+            store, svc = self._shard(i)
+            r1 = _push_request(0, f"zs{i}:1", 0.5)
+            r2 = _push_request(0, f"zs{i}:2", 0.25, fetched_step=1)
+            m1, _ = unpack_msg(svc.push_gradrients(r1, None))
+            m2, _ = unpack_msg(svc.push_gradrients(r2, None))
+            assert m1["accepted"] and m2["accepted"]
+            assert store.global_step == 2
+            w_after = store.parameters["w"].copy()
+
+            mz, _ = unpack_msg(svc.push_gradrients(r1, None))
+            assert mz.get("duplicate") is True
+            assert mz.get("stale_token") is True
+            assert store.global_step == 2
+            np.testing.assert_array_equal(store.parameters["w"], w_after)
+
+            mr, _ = unpack_msg(svc.push_gradrients(r2, None))
+            assert mr.get("duplicate") is True and mr["accepted"]
+            assert not mr.get("stale_token")
+            assert store.global_step == 2
+            np.testing.assert_array_equal(store.parameters["w"], w_after)
+
+
 class TestFaultInjection:
     def test_same_seed_same_schedule(self):
         spec = "seed=11;push.unavailable@p=0.3;fetch.delay=0.01@every=4"

@@ -1,0 +1,37 @@
+"""Sharded serve-path demo wrapper (slow — outside tier-1 by design).
+
+The full recorded drill — control vs 1-shard+4-replica loadgen, live
+replica-lag polling under real training, exact sharded/unsharded parity,
+and the shard-primary kill+restart journal replay — lives in
+``experiments/run_shard_scale.py``; this runs it end-to-end into a temp
+dir and asserts the recorded verdicts. Fast, in-process sharding
+coverage is in ``tests/test_sharding.py`` (tier-1).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_shard_scale_demo(tmp_path):
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "experiments", "run_shard_scale.py"),
+         "--out-dir", str(tmp_path)],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        cwd=REPO, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-3000:]
+    with open(tmp_path / "shard_scale.json") as f:
+        summary = json.load(f)
+    assert summary["all_pass"], summary["checks"]
+    # the headline properties, named explicitly
+    checks = summary["checks"]
+    assert checks["A_read_tier_10x_vs_reference_fetch_path"]
+    assert checks["C_accuracy_curve_exactly_equal"]
+    assert checks["D_replay_deduped_zero_double_applies"]

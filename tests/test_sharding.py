@@ -1,0 +1,411 @@
+"""PS sharding tests (docs/SHARDING.md, tier-1): consistent-hash
+partitioning, the shard-map wire artifact, the ``ShardedRemoteStore``
+fan-out, and the delta-fed read replica.
+
+Layers covered, cheapest first:
+
+- pure functions: ``shard_for_key`` / ``slot_range`` / ``partition_keys``
+  / ``validate_shard_map`` — determinism, coverage, range arithmetic,
+  garbled-map rejection;
+- ``ShardInfo``: announce-driven replica membership, version bumps,
+  expiry on an injected clock, the published map and status view;
+- service + client capability gating: map rides the registration reply
+  only for sharded servers, refreshes via ``have_shard_map``, a garbled
+  refresh never evicts the cached map;
+- end-to-end: ``ShardedRemoteStore`` over two in-process gRPC shard
+  primaries reproduces the single-store training semantics exactly
+  (same fetched params, same applied mean) — the parity argument the
+  recorded experiment leans on;
+- ``ReplicaServer``: delta-fed sync, header-only serving at the cached
+  step, the staleness refusal with the primary redirect, and write
+  redirects;
+- checkpoint identity: a snapshot restores only into the shard that
+  wrote it.
+"""
+
+import time
+
+import grpc
+import numpy as np
+import pytest
+
+from distributed_parameter_server_for_ml_training_tpu.checkpoint import (
+    restore_server_state, save_store)
+from distributed_parameter_server_for_ml_training_tpu.comms import (
+    RemoteStore, ReplicaServer, ShardedRemoteStore, serve)
+from distributed_parameter_server_for_ml_training_tpu.comms.service import (
+    ParameterService, pack_msg, unpack_msg)
+from distributed_parameter_server_for_ml_training_tpu.ps import (
+    ParameterStore, StoreConfig)
+from distributed_parameter_server_for_ml_training_tpu.ps.sharding import (
+    SHARD_SLOTS, ShardInfo, partition_keys, shard_for_key, slot_range,
+    validate_shard_map)
+
+
+def _keys(n=40):
+    return [f"layer{i}/kernel" for i in range(n)]
+
+
+class TestHashPartition:
+    def test_shard_for_key_deterministic_and_in_range(self):
+        for n in (1, 2, 3, 5, 8):
+            for k in _keys():
+                s = shard_for_key(k, n)
+                assert s == shard_for_key(k, n)  # pure
+                assert 0 <= s < n
+
+    def test_single_shard_owns_everything(self):
+        assert {shard_for_key(k, 1) for k in _keys()} == {0}
+
+    def test_slot_ranges_tile_the_slot_space(self):
+        for n in (1, 2, 3, 5, 64):
+            ranges = [slot_range(i, n) for i in range(n)]
+            assert ranges[0][0] == 0 and ranges[-1][1] == SHARD_SLOTS
+            for (_, hi), (lo, _) in zip(ranges, ranges[1:]):
+                assert hi == lo  # contiguous, no gaps or overlaps
+
+    def test_slot_range_rejects_out_of_range_shard(self):
+        with pytest.raises(ValueError):
+            slot_range(2, 2)
+        with pytest.raises(ValueError):
+            shard_for_key("w", 0)
+
+    def test_partition_keys_is_a_partition(self):
+        keys = _keys()
+        parts = partition_keys(keys, 3)
+        assert sorted(k for p in parts for k in p) == sorted(keys)
+        for i, part in enumerate(parts):
+            assert all(shard_for_key(k, 3) == i for k in part)
+
+    def test_key_to_slot_never_moves_across_topologies(self):
+        """The rebalance invariant: changing shard_count remaps only
+        range OWNERSHIP — the slot a key hashes to is fixed."""
+        import zlib
+        for k in _keys():
+            slot = zlib.crc32(k.encode()) % SHARD_SLOTS
+            for n in (1, 2, 4):
+                lo, hi = slot_range(shard_for_key(k, n), n)
+                assert lo <= slot < hi
+
+
+class TestValidateShardMap:
+    def _map(self, n=2):
+        return ShardInfo(0, n, [f"h:{i}" for i in range(n)]).shard_map()
+
+    def test_roundtrip_normalizes(self):
+        m = self._map()
+        norm = validate_shard_map(m)
+        assert norm["shard_count"] == 2
+        assert norm["shards"][1]["primary"] == "h:1"
+
+    def test_garbled_maps_rejected(self):
+        good = self._map()
+        bad_cases = [
+            None, [], "map", {},
+            {**good, "shard_count": 0},
+            {**good, "shard_count": 3},          # shards list mismatch
+            {**good, "shards": good["shards"][:1]},
+            {**good, "version": "new"},
+        ]
+        swapped = validate_shard_map(self._map())
+        swapped["shards"][0]["shard_id"] = 1      # id/range mismatch
+        bad_cases.append(swapped)
+        moved = validate_shard_map(self._map())
+        moved["shards"][0]["slot_range"] = [0, 5]
+        bad_cases.append(moved)
+        for bad in bad_cases:
+            with pytest.raises(ValueError):
+                validate_shard_map(bad)
+
+
+class TestShardInfo:
+    def test_announce_bumps_version_only_for_new_addresses(self):
+        si = ShardInfo(0, 2, ["a:1", "b:2"])
+        v0 = si.version
+        si.note_replica("r:1", 3, 5)
+        assert si.version == v0 + 1
+        si.note_replica("r:1", 5, 5)             # known address: no bump
+        assert si.version == v0 + 1
+        m = si.shard_map()
+        assert m["shards"][0]["replicas"] == ["r:1"]
+        assert m["shards"][1]["replicas"] == []   # peer lists aren't ours
+
+    def test_garbled_announce_ignored(self):
+        si = ShardInfo(0, 1, ["a:1"])
+        v0 = si.version
+        si.note_replica(None, "x", 5)
+        si.note_replica("r:1", None, 5)
+        assert si.version == v0 and si.shard_map()["shards"][0][
+            "replicas"] == []
+
+    def test_silent_replica_expires_and_bumps_version(self):
+        t = [0.0]
+        si = ShardInfo(0, 1, ["a:1"], clock=lambda: t[0])
+        si.note_replica("r:1", 1, 1)
+        v = si.version
+        t[0] = ShardInfo.REPLICA_EXPIRE_S + 1.0
+        m = si.shard_map()
+        assert m["shards"][0]["replicas"] == []
+        assert m["version"] > v
+        assert si.view()["replicas"] == []
+
+    def test_view_reports_lag(self):
+        si = ShardInfo(1, 2, ["a:1", "b:2"])
+        si.note_replica("r:9", 3, 7)
+        view = si.view()
+        assert view["shard_id"] == 1 and view["shard_count"] == 2
+        assert view["replicas"][0]["address"] == "r:9"
+        assert view["replicas"][0]["lag_steps"] == 4
+
+    def test_identity_validation(self):
+        with pytest.raises(ValueError):
+            ShardInfo(2, 2, ["a", "b"])
+        with pytest.raises(ValueError):
+            ShardInfo(0, 2, ["a"])               # one primary per shard
+
+
+def _svc(sharding=None, keys=("w",)):
+    store = ParameterStore(
+        {k: np.ones(4, np.float32) for k in keys},
+        StoreConfig(mode="sync", total_workers=1, push_codec="none"))
+    return store, ParameterService(store, sharding=sharding)
+
+
+class TestCapabilityGating:
+    def test_unsharded_register_reply_has_no_map(self):
+        _, svc = _svc()
+        meta, _ = unpack_msg(svc.register_worker(
+            pack_msg({"worker_name": "w"}), None))
+        assert "shard_map" not in meta
+
+    def test_sharded_register_reply_carries_map(self):
+        _, svc = _svc(ShardInfo(0, 2, ["a:1", "b:2"]))
+        meta, _ = unpack_msg(svc.register_worker(
+            pack_msg({"worker_name": "w"}), None))
+        assert meta["shard_map"]["shard_count"] == 2
+
+    def test_fetch_refresh_is_version_gated(self):
+        si = ShardInfo(0, 1, ["a:1"])
+        _, svc = _svc(si)
+        cur = si.version
+        meta, _ = unpack_msg(svc.fetch_parameters(
+            pack_msg({"have_shard_map": cur}), None))
+        assert "shard_map" not in meta            # up to date: no resend
+        si.note_replica("r:1", 0, 0)              # topology change
+        meta, _ = unpack_msg(svc.fetch_parameters(
+            pack_msg({"have_shard_map": cur}), None))
+        assert meta["shard_map"]["version"] > cur
+
+    def test_client_adopts_map_and_keeps_cached_on_garbled_refresh(self):
+        client = RemoteStore.__new__(RemoteStore)
+        client.shard_map = None
+        client._shard_map_version = 0
+        good = ShardInfo(0, 2, ["a:1", "b:2"]).shard_map()
+        client._note_shard_map({"shard_map": good})
+        assert client.shard_map["shard_count"] == 2
+        garbled = dict(good, shards=good["shards"][:1], version=99)
+        client._note_shard_map({"shard_map": garbled})
+        assert client.shard_map["shard_count"] == 2  # cached map survives
+        assert client._shard_map_version == good["version"]
+
+
+class TestShardedRemoteStoreParity:
+    """Two in-process shard primaries behind a ShardedRemoteStore must be
+    observationally identical to one store holding the whole model."""
+
+    def _topology(self, keys, n=2):
+        servers, addrs, stores = [], [], []
+        parts = partition_keys(keys, n)
+        for i in range(n):
+            store = ParameterStore(
+                {k: np.full(4, float(hash(k) % 7), np.float32)
+                 for k in parts[i]},
+                StoreConfig(mode="sync", total_workers=1,
+                            push_codec="none", shard_index=i,
+                            shard_count=n))
+            server, port = serve(store, port=0, service=ParameterService(
+                store, sharding=ShardInfo(i, n, ["pending"] * n)))
+            servers.append(server)
+            addrs.append(f"localhost:{port}")
+            stores.append(store)
+        return servers, addrs, stores, parts
+
+    def test_fetch_push_parity_with_single_store(self):
+        keys = _keys(12)
+        assert all(partition_keys(keys, 2))  # both shards own something
+        servers, addrs, stores, parts = self._topology(keys)
+        single = ParameterStore(
+            {k: np.full(4, float(hash(k) % 7), np.float32) for k in keys},
+            StoreConfig(mode="sync", total_workers=1, push_codec="none"))
+        single.register_worker()
+        sharded = ShardedRemoteStore(addrs, rpc_timeout=10.0)
+        try:
+            wid, total = sharded.register_worker("w0")
+            assert total >= 1
+            params, step = sharded.fetch(wid)
+            assert step == 0 and sorted(params) == sorted(keys)
+            for k in keys:
+                np.testing.assert_array_equal(params[k],
+                                              single.parameters[k])
+            grads = {k: np.full(4, 0.25, np.float32) for k in keys}
+            assert sharded.push(wid, grads, 0)
+            single.push(0, grads, 0)
+            params2, step2 = sharded.fetch(wid)
+            assert step2 == 1  # min over shards; every shard closed round 1
+            for k in keys:
+                np.testing.assert_allclose(params2[k],
+                                           single.parameters[k],
+                                           rtol=1e-6)
+            # Delta idiom composes through the fan-out: nothing moved, so
+            # a have_step fetch is NOT_MODIFIED on every shard.
+            params3, step3 = sharded.fetch(wid, have_step=1)
+            assert step3 == 1 and params3 == {}
+        finally:
+            sharded.close()
+            for s in servers:
+                s.stop(grace=None)
+
+    def test_push_partitioned_by_ownership(self):
+        keys = _keys(12)
+        servers, addrs, stores, parts = self._topology(keys)
+        sharded = ShardedRemoteStore(addrs)
+        try:
+            wid, _ = sharded.register_worker("w0")
+            grads = {k: np.ones(4, np.float32) for k in keys}
+            assert sharded.push(wid, grads, 0)
+            for store, mine in zip(stores, parts):
+                assert sorted(store.parameters) == sorted(mine)
+                assert store.global_step == 1  # empty slices still push
+        finally:
+            sharded.close()
+            for s in servers:
+                s.stop(grace=None)
+
+
+class TestReplicaServer:
+    def _primary(self, mode="async"):
+        store = ParameterStore(
+            {"w": np.zeros(8, np.float32)},
+            StoreConfig(mode=mode, total_workers=1, push_codec="none"))
+        svc = ParameterService(store,
+                               sharding=ShardInfo(0, 1, ["pending"]))
+        server, port = serve(store, port=0, service=svc)
+        return store, svc, server, f"localhost:{port}"
+
+    def _wait(self, pred, timeout=5.0):
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if pred():
+                return True
+            time.sleep(0.01)
+        return False
+
+    def test_replica_serves_primary_bytes_and_nm(self):
+        store, svc, server, addr = self._primary()
+        rep = ReplicaServer(addr, poll_interval=0.02,
+                            staleness_bound_s=5.0)
+        client = None
+        try:
+            port = rep.start()
+            assert self._wait(lambda: rep.view()["synced"])
+            client = RemoteStore(f"localhost:{port}")
+            params, step = client.fetch()
+            assert step == 0
+            np.testing.assert_array_equal(params["w"], store.parameters["w"])
+            # Advance the primary; the replica converges and serves the
+            # new step, then answers delta fetches header-only.
+            store.register_worker()
+            store.push(0, {"w": np.ones(8, np.float32)}, 0)
+            assert self._wait(lambda: rep.view()["step"] == 1)
+            params2, step2 = client.fetch()
+            assert step2 == 1
+            np.testing.assert_array_equal(params2["w"],
+                                          store.parameters["w"])
+            client.supports_delta_fetch = True  # no register: set by hand
+            delta, step3 = client.fetch(have_step=1)
+            assert step3 == 1 and delta == {}
+            # The announce reached the primary's membership.
+            assert svc.sharding.shard_map()["shards"][0]["replicas"] \
+                == [rep.advertise]
+        finally:
+            if client is not None:
+                client.close()
+            rep.stop()
+            server.stop(grace=None)
+
+    def test_stale_replica_refuses_with_redirect(self):
+        store, svc, server, addr = self._primary()
+        rep = ReplicaServer(addr, poll_interval=0.02,
+                            staleness_bound_s=0.2)
+        try:
+            port = rep.start()
+            assert self._wait(lambda: rep.view()["synced"])
+            server.stop(grace=None)  # primary gone: syncs stop
+            assert self._wait(
+                lambda: (rep.view()["sync_age_s"] or 0) > 0.3)
+            channel = grpc.insecure_channel(f"localhost:{port}")
+            stub = channel.unary_unary(
+                "/ps.ParameterServer/FetchParameters",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b)
+            with pytest.raises(grpc.RpcError) as e:
+                stub(pack_msg({}), timeout=5.0)
+            assert e.value.code() == grpc.StatusCode.UNAVAILABLE
+            assert addr in e.value.details()  # "use primary <addr>"
+            channel.close()
+        finally:
+            rep.stop()
+            server.stop(grace=None)
+
+    def test_writes_redirected_to_primary(self):
+        store, svc, server, addr = self._primary()
+        rep = ReplicaServer(addr, poll_interval=0.02)
+        try:
+            port = rep.start()
+            assert self._wait(lambda: rep.view()["synced"])
+            channel = grpc.insecure_channel(f"localhost:{port}")
+            stub = channel.unary_unary(
+                "/ps.ParameterServer/RegisterWorker",
+                request_serializer=lambda b: b,
+                response_deserializer=lambda b: b)
+            meta, _ = unpack_msg(stub(pack_msg({"worker_name": "w"}),
+                                      timeout=5.0))
+            assert meta["redirect"] == addr
+            assert meta["accepted"] is False
+            channel.close()
+        finally:
+            rep.stop()
+            server.stop(grace=None)
+
+
+class TestCheckpointShardIdentity:
+    def test_cross_shard_restore_refused(self, tmp_path):
+        store0 = ParameterStore(
+            {"w": np.ones(4, np.float32)},
+            StoreConfig(mode="sync", total_workers=1, push_codec="none",
+                        shard_index=0, shard_count=2))
+        svc0 = ParameterService(store0)
+        save_store(store0, str(tmp_path), journal_fn=svc0.journal_snapshot)
+
+        other = ParameterStore(
+            {"w": np.ones(4, np.float32)},
+            StoreConfig(mode="sync", total_workers=1, push_codec="none",
+                        shard_index=1, shard_count=2))
+        with pytest.raises(ValueError, match="refusing a cross-shard"):
+            restore_server_state(other, ParameterService(other),
+                                 str(tmp_path))
+
+    def test_legacy_snapshot_restores_into_unsharded_server(self, tmp_path):
+        store = ParameterStore(
+            {"w": np.ones(4, np.float32)},
+            StoreConfig(mode="sync", total_workers=1, push_codec="none"))
+        svc = ParameterService(store)
+        save_store(store, str(tmp_path), journal_fn=svc.journal_snapshot)
+        fresh = ParameterStore(
+            {"w": np.zeros(4, np.float32)},
+            StoreConfig(mode="sync", total_workers=1, push_codec="none"))
+        step, _ = restore_server_state(fresh, ParameterService(fresh),
+                                       str(tmp_path))
+        assert step == 0
+        np.testing.assert_array_equal(fresh.parameters["w"],
+                                      store.parameters["w"])

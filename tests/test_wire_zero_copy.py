@@ -122,6 +122,57 @@ class TestQuantizedPushPath:
         assert not arr.flags.owndata and arr.base is not None
 
 
+class TestCachedNotModifiedReply:
+    """ISSUE 9 satellite: the serve path's pre-encoded NOT_MODIFIED reply
+    cache extends the copy budget to the REPLY — at replica-refresh QPS
+    an idle step must serve the identical bytes object (no re-encode) and
+    never touch the tensor encoder at all."""
+
+    def _svc(self):
+        from distributed_parameter_server_for_ml_training_tpu.comms.service import (
+            ParameterService)
+        from distributed_parameter_server_for_ml_training_tpu.ps.store import (
+            ParameterStore, StoreConfig)
+        store = ParameterStore(
+            {"w": np.zeros(64, np.float32)},
+            StoreConfig(mode="async", total_workers=1))
+        return store, ParameterService(store)
+
+    def test_cache_hit_is_same_object_and_zero_copies(self, copy_counts):
+        from distributed_parameter_server_for_ml_training_tpu.comms.service import (
+            pack_msg, unpack_msg)
+        _, svc = self._svc()
+        req = pack_msg({"have_step": 0})
+        first = svc.fetch_parameters(req, None)
+        meta, payload = unpack_msg(first)
+        assert meta["not_modified"] is True and payload == b""
+        again = svc.fetch_parameters(req, None)
+        assert again is first, "NM reply was re-encoded on a cache hit"
+        assert copy_counts == {}, "NM serve path touched the tensor encoder"
+
+    def test_step_advance_invalidates_cache(self):
+        from distributed_parameter_server_for_ml_training_tpu.comms.service import (
+            pack_msg, unpack_msg)
+        store, svc = self._svc()
+        stale = svc.fetch_parameters(pack_msg({"have_step": 0}), None)
+        wid = unpack_msg(svc.register_worker(
+            pack_msg({"worker_name": "w"}), None))[0]["worker_id"]
+        svc.push_gradrients(
+            pack_msg({"worker_id": wid, "fetched_step": 0,
+                      "push_token": "nmcache:1"},
+                     wire.encode_tensor_dict(
+                         {"w": np.ones(64, np.float32)})), None)
+        fresh = svc.fetch_parameters(
+            pack_msg({"have_step": store.global_step}), None)
+        assert fresh is not stale
+        meta, payload = unpack_msg(fresh)
+        assert meta["not_modified"] is True and payload == b""
+        assert meta["global_step"] == store.global_step
+        # And the new key caches in turn.
+        assert svc.fetch_parameters(
+            pack_msg({"have_step": store.global_step}), None) is fresh
+
+
 class TestDecodeZeroCopy:
     def test_decoded_arrays_are_views_into_payload(self):
         blob = wire.encode_tensor_dict(_payload(n_tensors=4))
