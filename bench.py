@@ -199,6 +199,26 @@ def fetch_qps_probe(duration_s: float = 1.0, concurrency: int = 2):
         return None
 
 
+def lint_probe() -> dict:
+    """Static-analysis companion fields: ``lint_clean`` (did the tree
+    pass dpslint — live findings or a stale baseline mean False) and
+    ``lint_runtime_s`` (what the analyzer costs, pinned < 5 s by
+    tests/test_dpslint.py). Failure-hardened like the fetch probe: any
+    analyzer error records ``{"lint_clean": null}`` and never costs the
+    training-throughput record."""
+    try:
+        root = os.path.dirname(os.path.abspath(__file__))
+        if root not in sys.path:
+            sys.path.insert(0, root)
+        from tools.dpslint.cli import run_lint
+        res = run_lint(root)
+        return {"lint_clean": res["exit_code"] == 0,
+                "lint_runtime_s": res["runtime_s"]}
+    except Exception as e:  # noqa: BLE001 — probe is best-effort
+        print(f"lint probe failed (recording null): {e}", file=sys.stderr)
+        return {"lint_clean": None, "lint_runtime_s": None}
+
+
 def run_bench(args) -> dict:
     stage = "backend_init"
     try:
@@ -335,6 +355,12 @@ def run_bench(args) -> dict:
             "replica_count": 0,
             "fetch_qps": fetch_qps,
         }
+        # Static-analysis attribution (ISSUE 10 satellite): whether the
+        # tree this number was measured from passed dpslint, and what the
+        # analyzer itself costs — a perf record from a tree with live
+        # findings is flagged at the source instead of discovered later.
+        stage = "lint_probe"
+        result.update(lint_probe())
         if fallback is not None:
             # A fallback number must never be mistaken for a chip number:
             # the record says so explicitly, and readers comparing rounds

@@ -29,6 +29,7 @@ from __future__ import annotations
 import argparse
 import os
 import sys
+from contextlib import contextmanager
 
 
 def _env(name: str, default, cast=str):
@@ -536,10 +537,18 @@ def build_parser() -> argparse.ArgumentParser:
     st.add_argument("--json", action="store_true",
                     help="print the raw /cluster JSON instead of the table")
 
+    ln = sub.add_parser(
+        "lint",
+        help="framework-aware static analysis (tools/dpslint): lock "
+             "discipline, hot-path allocations, capability gating, JAX "
+             "pitfalls, catalog drift (docs/STATIC_ANALYSIS.md)")
+    ln.add_argument("--json", action="store_true",
+                    help="emit a JSON report instead of human lines")
+    ln.add_argument("--baseline", default=None,
+                    help="alternate baseline file (default: the reviewed "
+                         "register at tools/dpslint/baseline.json)")
+
     return p
-
-
-from contextlib import contextmanager
 
 
 @contextmanager
@@ -1321,6 +1330,25 @@ def cmd_loadgen(args) -> int:
     return 0 if result["fetches_ok"] > 0 else 1
 
 
+def cmd_lint(args) -> int:
+    """Delegate to tools/dpslint. The analyzer and its baseline live
+    beside the package in the repo checkout (not in the wheel) — exactly
+    like scripts/tier1.sh, ``cli lint`` is a checkout tool."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if not os.path.isdir(os.path.join(root, "tools", "dpslint")):
+        print("cli lint: tools/dpslint not found — run from a repo "
+              "checkout (the analyzer is not shipped in the wheel)",
+              file=sys.stderr)
+        return 2
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from tools.dpslint.cli import main as dpslint_main
+    argv = ["--json"] if args.json else []
+    if args.baseline:
+        argv += ["--baseline", args.baseline]
+    return dpslint_main(argv)
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if getattr(args, "platform", "default") == "cpu":
@@ -1329,7 +1357,7 @@ def main(argv=None) -> int:
     return {"train": cmd_train, "serve": cmd_serve, "worker": cmd_worker,
             "experiments": cmd_experiments, "supervise": cmd_supervise,
             "status": cmd_status, "replica": cmd_replica,
-            "loadgen": cmd_loadgen}[args.command](args)
+            "loadgen": cmd_loadgen, "lint": cmd_lint}[args.command](args)
 
 
 if __name__ == "__main__":

@@ -147,14 +147,14 @@ class RemoteStore:
         #: Directives received but not yet taken by the worker loop, plus
         #: the highest seq seen (the dedupe/ack watermark — the server
         #: re-attaches outstanding directives every reply until acked).
-        self._pending_directives: list[dict] = []
-        self._directive_last_seq = 0
+        self._pending_directives: list[dict] = []  # guarded by: self._wire_lock
+        self._directive_last_seq = 0  # guarded by: self._wire_lock
         #: Server-published per-layer gradient ABSMAX table + version,
         #: cached from the registration reply and refreshed off fetch
         #: reply meta (the client sends its version as ``have_qscales``;
         #: the server attaches the table only when newer).
-        self._qscales: dict[str, float] = {}
-        self._qscale_step = 0
+        self._qscales: dict[str, float] = {}  # guarded by: self._wire_lock
+        self._qscale_step = 0  # guarded by: self._wire_lock
         #: Zero-arg callable returning the worker's current health report
         #: (a small JSON-able dict) or None. PSWorker installs its own
         #: snapshot builder here after registration; when set AND the
@@ -171,7 +171,9 @@ class RemoteStore:
         #: refresh cadence were re-serializing an unchanged report per
         #: RPC. Without it every attach re-encodes (legacy behavior).
         self.health_revision = None
-        self._health_enc: tuple | None = None  # (revision, RawJSON)
+        # (revision, RawJSON) — the heartbeat thread's pings and the
+        # comms thread's pushes both consult/refresh this cache.
+        self._health_enc: tuple | None = None  # guarded by: self._wire_lock
         #: Server-published shard map (docs/SHARDING.md), adopted from the
         #: registration reply (its presence IS the capability) and
         #: refreshed off fetch reply meta delta-gated on the version the
@@ -193,9 +195,9 @@ class RemoteStore:
         import threading
 
         self._wire_lock = threading.Lock()
-        self.wire_bytes_out = 0
-        self.wire_bytes_in = 0
-        self.rpc_counts: dict[str, int] = {}
+        self.wire_bytes_out = 0  # guarded by: self._wire_lock
+        self.wire_bytes_in = 0  # guarded by: self._wire_lock
+        self.rpc_counts: dict[str, int] = {}  # guarded by: self._wire_lock
         # Push-dedupe token source: a per-client nonce + counter makes every
         # push's token unique across client restarts too (a replacement
         # worker reusing an elastic slot must not collide with its
@@ -359,6 +361,10 @@ class RemoteStore:
         same directive may arrive on several replies. Malformed entries
         are dropped; directives must never fail the RPC that carried
         them."""
+        if not self.supports_directives:
+            # Never negotiated: a directive-shaped key from a confused
+            # peer must not steer this worker (cap-gate discipline).
+            return
         ds = reply_meta.get("directives")
         if not isinstance(ds, list):
             return
@@ -384,25 +390,41 @@ class RemoteStore:
 
     def _attach_directive_ack(self, meta: dict) -> None:
         if self.supports_directives:
-            meta["directives_ack"] = self._directive_last_seq
+            # Under the lock: the heartbeat thread's fetch replies may
+            # advance the watermark concurrently with a push's attach.
+            with self._wire_lock:
+                meta["directives_ack"] = self._directive_last_seq
 
     def _note_qscales(self, reply_meta: dict) -> None:
         """Adopt a piggybacked shared-scale table (register/fetch reply
         meta). A malformed table degrades to the cached one — scales are
         an optimization hint, never worth failing an RPC over."""
+        if not self.supports_compressed_domain:
+            # Scales only exist under compressed-domain aggregation; an
+            # ungated adopt would cache a table nothing consumes.
+            return
         qs = reply_meta.get("qscales")
         if not isinstance(qs, dict):
             return
         try:
-            self._qscales = {str(k): float(v) for k, v in qs.items()}
-            self._qscale_step = int(reply_meta.get("qscale_step", 0))
+            table = {str(k): float(v) for k, v in qs.items()}
+            step = int(reply_meta.get("qscale_step", 0))
         except (TypeError, ValueError):
-            pass
+            return
+        # One lock write for the PAIR: the heartbeat thread's ping can
+        # adopt a refresh while the training thread quantizes against
+        # gradient_scales(); without the lock the reader could pair the
+        # new table with the old version stamp (or vice versa) and
+        # desync from the server's dequant scales.
+        with self._wire_lock:
+            self._qscales = table
+            self._qscale_step = step
 
     def gradient_scales(self) -> tuple[dict[str, float], int]:
         """Client-side cache of the server's per-layer gradient absmax
         table (PSWorker quantizes against it; docs/WIRE_PROTOCOL.md)."""
-        return dict(self._qscales), self._qscale_step
+        with self._wire_lock:
+            return dict(self._qscales), self._qscale_step
 
     def _note_shard_map(self, reply_meta: dict) -> None:
         """Adopt a piggybacked shard map (register/fetch reply meta).
@@ -473,16 +495,16 @@ class RemoteStore:
                 # restarted server) starts a fresh directive stream: the
                 # new server's seqs restart from 1, so a stale watermark
                 # would suppress every delivery.
+                # Registration is the negotiation point: drop any cached
+                # scale table before adopting the reply's. A crash-
+                # RESTORED server restarts its scale versions from 0 — a
+                # stale higher version kept across session resume would
+                # make have_qscales suppress every refresh until the new
+                # server's version caught up.
                 with self._wire_lock:
                     self._pending_directives = []
                     self._directive_last_seq = 0
-                # Registration is the negotiation point: drop any cached
-                # table before adopting the reply's. A crash-RESTORED
-                # server restarts its scale versions from 0 — a stale
-                # higher version kept across session resume would make
-                # have_qscales suppress every refresh until the new
-                # server's version caught up.
-                self._qscales, self._qscale_step = {}, 0
+                    self._qscales, self._qscale_step = {}, 0
                 self._note_qscales(reply)
                 # Same discipline for the shard map: a restarted primary's
                 # map versions restart from 1, so the cached version must
@@ -525,10 +547,12 @@ class RemoteStore:
                 rev = self.health_revision()
             except Exception:  # noqa: BLE001
                 rev = None
-        if rev is not None and self._health_enc is not None \
-                and self._health_enc[0] == rev:
-            meta["health"] = self._health_enc[1]
-            return
+        if rev is not None:
+            with self._wire_lock:
+                cached = self._health_enc
+            if cached is not None and cached[0] == rev:
+                meta["health"] = cached[1]
+                return
         try:
             report = self.health_provider()
         except Exception:  # noqa: BLE001
@@ -538,7 +562,8 @@ class RemoteStore:
                 meta["health"] = report
                 return
             enc = RawJSON(json.dumps(report))
-            self._health_enc = (rev, enc)
+            with self._wire_lock:
+                self._health_enc = (rev, enc)
             meta["health"] = enc
 
     def fetch(self, worker_id: int | None = None,
@@ -559,7 +584,8 @@ class RemoteStore:
         if self.supports_compressed_domain:
             # Scale-table delta handshake: the server attaches qscales to
             # the reply only when its version is newer than this.
-            meta["have_qscales"] = self._qscale_step
+            with self._wire_lock:
+                meta["have_qscales"] = self._qscale_step
         if self.shard_map is not None:
             # Shard-map delta handshake (docs/SHARDING.md): the server
             # attaches a map only when its version is newer than this.

@@ -185,7 +185,7 @@ class ParameterService:
         # reply reports the original's true outcome, not a guess.
         # nonce -> [count, outcome (None while in flight), done event,
         #           worker_id, step_at_completion]; LRU-bounded.
-        self._push_seen: OrderedDict[str, list] = OrderedDict()
+        self._push_seen: OrderedDict[str, list] = OrderedDict()  # guarded by: self._push_seen_lock
         self._push_seen_lock = threading.Lock()
         # Directive channel (docs/ROBUSTNESS.md "Self-healing"): per-worker
         # outstanding server->worker directives, attached to every fetch/
@@ -194,19 +194,22 @@ class ParameterService:
         # capability at registration ever get them — legacy peers' replies
         # carry nothing, same degradation discipline as health reports.
         self._directive_lock = threading.Lock()
-        self._directives: dict[int, list[dict]] = {}
-        self._directive_seq = 0
-        self._directive_capable: set[int] = set()
+        self._directives: dict[int, list[dict]] = {}  # guarded by: self._directive_lock
+        self._directive_seq = 0  # guarded by: self._directive_lock
+        self._directive_capable: set[int] = set()  # guarded by: self._directive_lock
         # Server-side push quarantine (remediation action): worker id ->
         # wall-clock ts until which its pushes are refused (acknowledged,
         # never applied). Belt-and-braces beside the quarantine directive:
         # a legacy worker that can't hear the directive still can't poison
         # the aggregate.
-        self._quarantined: dict[int, float] = {}
+        self._quarantined: dict[int, float] = {}  # guarded by: self._directive_lock
         # Activity-coupled membership expiry (satellite: a stalled elastic
         # round unsticks on the next push/registration instead of waiting
-        # for the serve loop's next timer tick).
-        self._last_expire_check = 0.0
+        # for the serve loop's next timer tick). The throttle stamp needs
+        # its own lock: handler threads race the read-modify-write, and
+        # two passing the age check at once ran DUPLICATE expiry sweeps.
+        self._expire_lock = threading.Lock()
+        self._last_expire_check = 0.0  # guarded by: self._expire_lock
         # Deterministic fault injection (comms/faults.py): wraps the RPC
         # handler bodies in handlers(); None = no faults.
         from .faults import FaultInjector
@@ -241,7 +244,7 @@ class ParameterService:
         # entered only when the qscale/directive/shard-map attachments are
         # empty — and invalidated by key mismatch when the step or the
         # membership view moves.
-        self._nm_cache: tuple | None = None  # (key, encoded reply)
+        self._nm_cache: tuple | None = None  # guarded by: self._nm_lock
         self._nm_lock = threading.Lock()
         self._tm_nm_cache_hits = reg.counter(
             "dps_fetch_nm_cache_hits_total")
@@ -337,9 +340,10 @@ class ParameterService:
         if not timeout:
             return
         now = time.time()
-        if now - self._last_expire_check < min(1.0, timeout / 4.0):
-            return
-        self._last_expire_check = now
+        with self._expire_lock:
+            if now - self._last_expire_check < min(1.0, timeout / 4.0):
+                return
+            self._last_expire_check = now
         try:
             expired = self.store.expire_stale_workers()
         except Exception:  # noqa: BLE001 — expiry must not fail the RPC
@@ -666,6 +670,7 @@ class ParameterService:
                 self._push_seen.popitem(last=False)
         return loaded
 
+    # dpslint: hot-path — every worker ping; NM replies serve a cached encode
     def fetch_parameters(self, request: bytes, ctx) -> bytes:
         meta, _ = unpack_msg(request)
         wid = None if meta.get("worker_id") is None \
@@ -751,7 +756,7 @@ class ParameterService:
                     meta, payload = unpack_msg(request)
                     wire_ctx = meta.get("trace") or \
                         (peek_trace(payload) if len(payload) else None)
-                except Exception:
+                except Exception:  # noqa: BLE001
                     wire_ctx = None  # malformed request fails in fn, not here
             try:
                 with use_wire_context(wire_ctx), \

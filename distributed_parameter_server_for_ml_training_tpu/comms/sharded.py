@@ -52,8 +52,10 @@ class ShardedRemoteStore:
             RemoteStore(a, **self._remote_kwargs) for a in addresses]
         self._seeded = len(self._stores) == 1  # may grow from the map
         self._lock = threading.Lock()
-        self._wids: list[int] = []
+        self._wids: list[int] = []  # guarded by: self._lock
+        # guarded by: self._lock
         self._shard_steps: list[int | None] = [None] * len(self._stores)
+        # guarded by: self._lock
         self._param_cache: list[dict] = [{} for _ in self._stores]
         self._health_provider = None
         self._health_revision = None

@@ -105,6 +105,7 @@ def _note_copy(name: str, reason: str) -> None:
 
 # -- encode ------------------------------------------------------------------
 
+# dpslint: hot-path — the zero-copy primitive everything else leans on
 def _buffer_view(arr: np.ndarray) -> memoryview:
     """Raw little-endian bytes of a C-contiguous array, WITHOUT copying.
 
@@ -114,6 +115,7 @@ def _buffer_view(arr: np.ndarray) -> memoryview:
     return memoryview(arr.reshape(-1).view(np.uint8))
 
 
+# dpslint: hot-path — per-tensor, every push and fetch
 def _prepare(tensors: Mapping[str, np.ndarray]) -> tuple[list, list]:
     """Validate + normalize to (metas, contiguous arrays)."""
     metas, arrays = [], []
@@ -141,6 +143,7 @@ def _prepare(tensors: Mapping[str, np.ndarray]) -> tuple[list, list]:
     return metas, arrays
 
 
+# dpslint: hot-path — the ONE sanctioned copy is the final join
 def _frame(header_obj: dict, bodies: list, flags: int = 0) -> bytes:
     """Assemble one v2 frame. ``bodies`` are buffer-protocol objects; each
     is copied exactly once by the final join."""
@@ -150,6 +153,7 @@ def _frame(header_obj: dict, bodies: list, flags: int = 0) -> bytes:
     return b"".join([preamble, header, *bodies])
 
 
+# dpslint: hot-path — one buffer copy per tensor, enforced statically
 def encode_tensor_dict(tensors: Mapping[str, np.ndarray],
                        trace: dict | None = None) -> bytes:
     """Encode to a single v2 frame (one buffer copy per tensor).
@@ -225,6 +229,7 @@ def encode_tensor_dict_chunks(tensors: Mapping[str, np.ndarray],
 
 # -- decode ------------------------------------------------------------------
 
+# dpslint: hot-path — header parse only; bodies stay views
 def _parse_frame(payload) -> tuple[dict, memoryview, int]:
     """-> (header dict, body memoryview, flags). Accepts v2 and legacy v1
     frames; validates the header length BEFORE any allocation sized by it."""
@@ -295,6 +300,7 @@ def _tensor_extent(meta: dict) -> tuple[np.dtype, tuple, int, bool]:
     return dt, shape, nbytes, packed
 
 
+# dpslint: hot-path — frombuffer views; copy only on explicit opt-in
 def _tensors_from_body(header: dict, body: memoryview,
                        copy: bool) -> dict[str, np.ndarray]:
     metas = header.get("tensors")
@@ -318,6 +324,7 @@ def _tensors_from_body(header: dict, body: memoryview,
     return out
 
 
+# dpslint: hot-path — zero-copy decode is the whole point of v2 frames
 def decode_tensor_dict(payload, *, copy: bool = False
                        ) -> dict[str, np.ndarray]:
     """Decode one frame (v2 or legacy v1) to ``{name: ndarray}``.

@@ -439,5 +439,5 @@ class NativeParameterStore(TelemetryMixin, MembershipMixin):
     def __del__(self):
         try:
             self._lib.dps_store_destroy(self._handle)
-        except Exception:
+        except Exception:  # noqa: BLE001 — __del__ during interpreter teardown
             pass

@@ -86,6 +86,13 @@ class DeviceParameterStore(AggregationBase):
     push_codec = "none"
     fetch_codec = "none"
 
+    # AggregationBase's contracts re-declared (tools/dpslint checks are
+    # module-local), plus this backend's own sampling counter.
+    parameters: dict  # guarded by: self._param_lock
+    global_step: int  # guarded by: self._param_lock
+    last_seen: dict  # guarded by: self._registration_lock
+    _updates_since_wait: int  # guarded by: self._wait_lock
+
     def __init__(self, initial_params: Mapping[str, np.ndarray],
                  config: StoreConfig | None = None):
         self.config = config or StoreConfig()
@@ -133,6 +140,7 @@ class DeviceParameterStore(AggregationBase):
 
     # -- hot path ------------------------------------------------------------
 
+    # dpslint: hot-path — zero-byte fetch: references, never copies
     def fetch(self, worker_id: int | None = None
               ) -> tuple[dict[str, jax.Array], int]:
         """Consistent (params, step) snapshot — references, not copies
@@ -144,7 +152,10 @@ class DeviceParameterStore(AggregationBase):
                 payload = dict(self.parameters)
                 step = self.global_step
         if worker_id is not None:
-            self.last_seen[worker_id] = time.time()
+            # Registration lock: the bare dict store raced the reaper's
+            # iteration in expire_stale_workers.
+            with self._registration_lock:
+                self.last_seen[worker_id] = time.time()
         # NOTE: the span measures the dict-copy handoff (~us) — fetch here
         # moves zero bytes by design, so this histogram is the proof, not
         # the cost (compare against the python/native backends' ms-scale
@@ -153,6 +164,7 @@ class DeviceParameterStore(AggregationBase):
         self._tm_fetches.inc()
         return payload, step
 
+    # dpslint: hot-path — device arrays in, device arrays applied
     def push(self, worker_id: int, gradients: Mapping[str, jax.Array],
              fetched_step: int) -> bool:
         """Accept device-array gradients; apply per the configured mode.
@@ -162,14 +174,17 @@ class DeviceParameterStore(AggregationBase):
         bound.
         """
         t0 = _tnow()
-        self.last_seen[worker_id] = time.time()
+        with self._registration_lock:
+            self.last_seen[worker_id] = time.time()
+        with self._param_lock:
+            param_shapes = {k: v.shape for k, v in self.parameters.items()}
         for name, g in gradients.items():
-            p = self.parameters.get(name)
-            if p is not None and p.shape != g.shape:
+            p_shape = param_shapes.get(name)
+            if p_shape is not None and p_shape != g.shape:
                 self.stats.gradients_rejected += 1
                 self._tm_push_rej.inc()
                 print(f"rejecting push from worker {worker_id}: {name} "
-                      f"shape {g.shape} != server {p.shape}")
+                      f"shape {g.shape} != server {p_shape}")
                 return False
         try:
             with trace_span("store.push",
@@ -205,8 +220,10 @@ class DeviceParameterStore(AggregationBase):
         return mean
 
     def _apply(self, grads: dict, lr: float, weight: float = 1.0) -> None:
-        self.parameters = _sgd_apply_device(
-            self.parameters, grads, jnp.float32(lr * weight))
+        # Kernel contract (AggregationBase): callers hold _param_lock.
+        self.parameters = _sgd_apply_device(  # dpslint: ignore[lock-guard]
+            self.parameters, grads,  # dpslint: ignore[lock-guard]
+            jnp.float32(lr * weight))
 
     def _round_update(self, grad_dicts: list, lr: float) -> None:
         """Fused path for the common full round (every worker supplied
@@ -240,5 +257,8 @@ class DeviceParameterStore(AggregationBase):
             if self._updates_since_wait < self.wait_every:
                 return False  # declined: caller must not record a timing
             self._updates_since_wait = 0
-        jax.block_until_ready(self.parameters)
+        # Deliberately outside _param_lock: one consistent reference is
+        # enough (jax arrays are immutable), and blocking the device under
+        # the lock would convoy every concurrent push behind the wait.
+        jax.block_until_ready(self.parameters)  # dpslint: ignore[lock-guard]
         return True

@@ -189,6 +189,13 @@ class MembershipMixin:
     ``_finished_event``.
     """
 
+    # Membership state is the mixin's contract even though the concrete
+    # store's __init__ constructs it; declared here so tools/dpslint
+    # checks every method that touches it (lock-guard rule).
+    _next_worker_id: int  # guarded by: self._registration_lock
+    active_workers: set  # guarded by: self._registration_lock
+    last_seen: dict  # guarded by: self._registration_lock
+
     def register_worker(self, worker_name: str = "") -> tuple[int, int]:
         """Returns (worker_id, total_workers).
 
@@ -350,6 +357,17 @@ class AggregationBase(TelemetryMixin, MembershipMixin):
     #: native C++ arena's seqlock fetch) leave this False.
     supports_delta_fetch = False
 
+    # Cross-thread contracts (tools/dpslint lock-guard): pusher threads,
+    # the round-deadline Timer, and the reaper all meet on this state.
+    parameters: dict  # guarded by: self._param_lock
+    global_step: int  # guarded by: self._param_lock
+    _pending: dict  # guarded by: self._sync_lock
+    _gradients_received: int  # guarded by: self._sync_lock
+    _round_serial: int  # guarded by: self._sync_lock
+    _deadline_timer: object  # guarded by: self._sync_lock
+    _last_round_trigger: object  # guarded by: self._sync_lock
+    _excluded: set  # guarded by: self._registration_lock
+
     def _mean(self, grad_dicts: list) -> dict:
         raise NotImplementedError
 
@@ -407,8 +425,11 @@ class AggregationBase(TelemetryMixin, MembershipMixin):
         already closed under quorum/deadline — reconciles through the
         async staleness semantics instead of being stashed against a
         stale basis (docs/ROBUSTNESS.md)."""
+        # Routing pre-check only: an unlocked step read is fine here —
+        # the late path re-checks staleness under _param_lock, and a push
+        # mis-routed into the round path was on time by definition.
         if self._quorum_mode() and fetched_step is not None \
-                and fetched_step < self.global_step:
+                and fetched_step < self.global_step:  # dpslint: ignore[lock-guard]
             return self._push_late(worker_id, grads, fetched_step)
         with self._sync_lock:
             if self.config.strict_rounds:
@@ -593,7 +614,10 @@ class AggregationBase(TelemetryMixin, MembershipMixin):
         round if the survivors already cover the reduced target. An
         expired worker also leaves the exclusion set — if it returns
         (respawn reuses its slot), the replacement starts unexcluded."""
-        if self._excluded:
+        # Emptiness pre-check dodging the lock in the common (no
+        # exclusions) case; the mutation below re-checks nothing — it is
+        # a blind difference_update, safe against any interleaving.
+        if self._excluded:  # dpslint: ignore[lock-guard]
             with self._registration_lock:
                 self._excluded.difference_update(stale)
                 n = len(self._excluded)
@@ -613,7 +637,8 @@ class AggregationBase(TelemetryMixin, MembershipMixin):
     def _on_worker_departed(self, worker_id: int) -> None:
         """Elastic: a clean departure only shrinks the round target — its
         own final push (if any) stays in the round."""
-        if self._excluded:
+        # Emptiness pre-check, same rationale as _on_workers_expired.
+        if self._excluded:  # dpslint: ignore[lock-guard]
             self.include_worker(worker_id)
         if not getattr(self.config, "elastic", False):
             return
@@ -626,22 +651,33 @@ class AggregationBase(TelemetryMixin, MembershipMixin):
     def _push_async(self, worker_id: int, grads: dict,
                     fetched_step: int) -> bool:
         """server.py:290-304 + 171-186: bounded staleness with down-weighted
-        immediate apply."""
-        staleness = self.global_step - fetched_step
+        immediate apply.
+
+        The staleness check and the apply run under ONE ``_param_lock``
+        hold: with an unlocked pre-check, a concurrent apply could bump
+        ``global_step`` between check and apply, admitting a push that
+        was already past the bound — and weighting it as fresher than it
+        is (tests/test_dpslint_fixes.py pins this down).
+        """
+        t0 = time.time()
+        step = 0
+        with self._param_lock:
+            staleness = self.global_step - fetched_step
+            accepted = staleness <= self.config.staleness_bound
+            if accepted:
+                weight = staleness_weight(staleness)
+                with trace_span("store.apply", backend=self.store_backend,
+                                mode="async", staleness=staleness,
+                                weight=round(weight, 4)):
+                    self._apply(grads, self.config.learning_rate, weight)
+                    self.global_step += 1
+                step = self.global_step
         self._tm_staleness.observe(staleness)
-        if staleness > self.config.staleness_bound:
+        if not accepted:
             self.stats.gradients_rejected += 1
             self._tm_push_rej.inc()
             return False
-        weight = staleness_weight(staleness)
-        t0 = time.time()
-        with trace_span("store.apply", backend=self.store_backend,
-                        mode="async", staleness=staleness,
-                        weight=round(weight, 4)):
-            with self._param_lock:
-                self._apply(grads, self.config.learning_rate, weight)
-                self.global_step += 1
-        self._tm_step.set(self.global_step)
+        self._tm_step.set(step)
         measured = self._after_apply() is not False
         self.stats.gradients_processed += 1
         self.stats.total_parameter_updates += 1
@@ -693,7 +729,9 @@ class AggregationBase(TelemetryMixin, MembershipMixin):
             "mode": self.config.mode,
             "total_workers": self.config.total_workers,
             "total_training_time_seconds": round(elapsed, 2),
-            "global_steps_completed": self.global_step,
+            # Unlocked read: a final-stats row tolerates being one
+            # concurrent apply behind.
+            "global_steps_completed": self.global_step,  # dpslint: ignore[lock-guard]
             "total_parameter_updates": self.stats.total_parameter_updates,
             "gradients_processed": self.stats.gradients_processed,
             "average_update_time_seconds": (
@@ -747,10 +785,10 @@ class ParameterStore(AggregationBase):
         # Per-layer gradient ABSMAX estimates — the shared quantization
         # basis workers fetch (negotiated at registration, refreshed via
         # the fetch path) so a round's int8/int4 pushes land in ONE
-        # accumulator group. Guarded by _param_lock; _qscale_step bumps on
-        # every refresh so clients can cheap-check for changes.
-        self._qscales: dict[str, float] = {}
-        self._qscale_step = 0
+        # accumulator group. _qscale_step bumps on every refresh so
+        # clients can cheap-check for changes.
+        self._qscales: dict[str, float] = {}  # guarded by: self._param_lock
+        self._qscale_step = 0  # guarded by: self._param_lock
 
         self._param_lock = threading.Lock()
         self._sync_lock = threading.Lock()
@@ -822,6 +860,7 @@ class ParameterStore(AggregationBase):
         if changed:
             self._qscale_step += 1
 
+    # dpslint: hot-path — every worker, every step; ONE sanctioned copy
     def fetch(self, worker_id: int | None = None,
               have_step: int | None = None
               ) -> tuple[dict[str, np.ndarray], int]:
@@ -849,7 +888,10 @@ class ParameterStore(AggregationBase):
                     step = self.global_step
                     modified = True
             if worker_id is not None:
-                self.last_seen[worker_id] = time.time()
+                # Under the registration lock: a bare dict store raced
+                # the reaper's iteration in expire_stale_workers.
+                with self._registration_lock:
+                    self.last_seen[worker_id] = time.time()
             if not modified:
                 sp.attrs["not_modified"] = True
                 self._tm_fetch_nm.inc()
@@ -885,6 +927,7 @@ class ParameterStore(AggregationBase):
             finally:
                 self._tm_push_s.observe(_tnow() - t0)
 
+    # dpslint: hot-path — per-push; quantized payloads stay encoded
     def _push_timed(self, worker_id: int,
                     gradients: Mapping[str, np.ndarray],
                     fetched_step: int) -> bool:
@@ -898,7 +941,8 @@ class ParameterStore(AggregationBase):
         # dequantize the single incoming payload with its carried scale.
         keep_quantized = (quantized and self.config.mode == "sync"
                           and self.config.compressed_domain)
-        self.last_seen[worker_id] = time.time()
+        with self._registration_lock:
+            self.last_seen[worker_id] = time.time()
 
         # Reject malformed/mismatched pushes up front (e.g. a worker
         # built with a different head size than the server, a missing
@@ -926,13 +970,18 @@ class ParameterStore(AggregationBase):
             self._tm_push_rej.inc()
             print(f"rejecting push from worker {worker_id}: {e}")
             return False
+        # Snapshot the expected shapes under the lock (shapes never
+        # change after __init__, but the dict itself may be swapped by a
+        # concurrent load_snapshot restore).
+        with self._param_lock:
+            param_shapes = {k: v.shape for k, v in self.parameters.items()}
         for name, shape in shapes.items():
-            p = self.parameters.get(name)
-            if p is not None and p.shape != tuple(shape):
+            p_shape = param_shapes.get(name)
+            if p_shape is not None and p_shape != tuple(shape):
                 self.stats.gradients_rejected += 1
                 self._tm_push_rej.inc()
                 print(f"rejecting push from worker {worker_id}: {name} "
-                      f"shape {tuple(shape)} != server {p.shape} "
+                      f"shape {tuple(shape)} != server {p_shape} "
                       f"(model/dataset mismatch?)")
                 return False
         if keep_quantized:
@@ -967,4 +1016,5 @@ class ParameterStore(AggregationBase):
             self._refresh_qscales_locked(mean)
 
     def _apply(self, grads: dict, lr: float, weight: float = 1.0) -> None:
-        sgd_apply(self.parameters, grads, lr, weight=weight)
+        # Kernel contract (AggregationBase): callers hold _param_lock.
+        sgd_apply(self.parameters, grads, lr, weight=weight)  # dpslint: ignore[lock-guard]

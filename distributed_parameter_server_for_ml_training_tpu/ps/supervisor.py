@@ -99,6 +99,11 @@ class WorkerSupervisor:
         self._spawn_fn = spawn or self._default_spawn
         self.slots = [_Slot(index=i) for i in range(n_workers)]
         self._stop = threading.Event()
+        # Serializes supervision passes against stop(): stop() is called
+        # from signal handlers / other threads, and snapshotting the
+        # children while a pass was mid-respawn let the fresh child miss
+        # the snapshot — spawned a moment later, never terminated.
+        self._slots_lock = threading.Lock()
         from ..telemetry import get_registry
         reg = get_registry()
         self._tm_children = reg.gauge("dps_supervisor_children")
@@ -118,8 +123,9 @@ class WorkerSupervisor:
 
     def start(self) -> None:
         """Initial spawn of every slot."""
-        for slot in self.slots:
-            self._spawn(slot)
+        with self._slots_lock:
+            for slot in self.slots:
+                self._spawn(slot)
         self._tm_children.set(self.running_count())
 
     def _spawn(self, slot: _Slot) -> None:
@@ -139,7 +145,15 @@ class WorkerSupervisor:
         return list(built), None
 
     def poll_once(self) -> None:
-        """One supervision pass: reap exits, schedule/execute respawns."""
+        """One supervision pass: reap exits, schedule/execute respawns.
+        The whole pass holds the slots lock (every step is non-blocking
+        polls and bookkeeping) so stop() can never interleave with a
+        respawn."""
+        with self._slots_lock:
+            self._poll_locked()
+        self._tm_children.set(self.running_count())
+
+    def _poll_locked(self) -> None:
         now = self.clock()
         cfg = self.config
         for slot in self.slots:
@@ -201,7 +215,6 @@ class WorkerSupervisor:
                 self.log(f"SUPERVISOR_RESPAWN slot={slot.index} "
                          f"attempt={slot.attempt} "
                          f"after_rc={slot.last_rc}", flush=True)
-        self._tm_children.set(self.running_count())
 
     def run(self) -> int:
         """Supervise until every slot is done. Exit code: 0 when all
@@ -229,7 +242,10 @@ class WorkerSupervisor:
         """Terminate every running child (SIGTERM, then SIGKILL after the
         grace window)."""
         self._stop.set()
-        procs = [s.proc for s in self.slots if s.proc is not None]
+        # Taken AFTER setting the stop flag: an in-flight pass finishes
+        # (possibly spawning), then the snapshot sees its child too.
+        with self._slots_lock:
+            procs = [s.proc for s in self.slots if s.proc is not None]
         for p in procs:
             try:
                 p.terminate()

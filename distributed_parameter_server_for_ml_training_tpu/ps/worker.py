@@ -334,7 +334,7 @@ class _CommsPipeline:
                         try:
                             self._worker._push(self._worker_id, grads,
                                                fetched_step)
-                        except Exception:
+                        except Exception:  # noqa: BLE001 — stash, then re-raise
                             self._failed_push = (grads, fetched_step)
                             raise
                     if prefetch_current is not None:
@@ -348,7 +348,7 @@ class _CommsPipeline:
                         self._last_comms_s = _tnow() - t0
                         self._result = result
                         self._result_ready.set()
-            except Exception as e:
+            except Exception as e:  # noqa: BLE001 — surfaced via await_params
                 self._error = e
                 self._result_ready.set()  # wake a blocked await_params
             finally:
@@ -443,14 +443,14 @@ class PSWorker(threading.Thread):
         # fetch/push/heartbeat via the provider installed in _run. The lock
         # covers training-thread writes vs heartbeat/comms-thread reads.
         self._health_lock = threading.Lock()
-        self._health: dict = {}
+        self._health: dict = {}  # guarded by: self._health_lock
         self._health_enabled = False
         self._health_rate: tuple[float, int] | None = None
         # Report revision, bumped under the lock on every mutation: lets
         # the RemoteStore cache the report's JSON encode across the many
         # heartbeat pings between boundary updates (comms/client.py
         # health_revision).
-        self._health_rev = 0
+        self._health_rev = 0  # guarded by: self._health_lock
         # Quantized-codec state (set up after registration, once the
         # store's negotiated codec is known): error-feedback residuals and
         # the per-layer bitwidth controller (docs/WIRE_PROTOCOL.md).
@@ -485,7 +485,7 @@ class PSWorker(threading.Thread):
         self._done = threading.Event()
         try:
             self._run()
-        except Exception as e:  # surfaced via .result for the harness
+        except Exception as e:  # noqa: BLE001 — surfaced via .result
             self.result.error = e
         finally:
             self._done.set()
@@ -968,7 +968,7 @@ class PSWorker(threading.Thread):
                     # opening fetch.
                     try:
                         self._pipe.flush()
-                    except Exception as e:
+                    except Exception as e:  # noqa: BLE001 — session recovery
                         params, fetched_step = self._recover_session(e)
                         worker_id = self.result.worker_id
 
@@ -1169,7 +1169,7 @@ class PSWorker(threading.Thread):
                     current=params)
             self._poll_directives()
             return result
-        except Exception as e:
+        except Exception as e:  # noqa: BLE001 — session recovery
             return self._recover_session(e)
 
     def _dispatch_push(self, worker_id: int, grads_tree,
@@ -1195,7 +1195,7 @@ class PSWorker(threading.Thread):
                                       prefetch_current=params)
                 self._poll_directives()
                 return params, fetched_step
-            except Exception as e:
+            except Exception as e:  # noqa: BLE001 — push recovery
                 return self._recover_push(e, grads_tree, fetched_step)
 
     def _dispatch_push_mean(self, worker_id: int, accum_tree, n: int,
@@ -1213,7 +1213,7 @@ class PSWorker(threading.Thread):
                                       prefetch_current=params)
                 self._poll_directives()
                 return params, fetched_step
-            except Exception as e:
+            except Exception as e:  # noqa: BLE001 — push recovery
                 grads = mean_tree if mean_tree is not None \
                     else _window_mean(accum_tree, n)
                 return self._recover_push(e, grads, fetched_step)
@@ -1241,7 +1241,7 @@ class PSWorker(threading.Thread):
         if pipelined and self._repush_viable(fetched_step, new_step):
             try:
                 self._push(self.result.worker_id, grads_tree, fetched_step)
-            except Exception as e2:
+            except Exception as e2:  # noqa: BLE001 — double-flap handoff
                 # The server flapped AGAIN between the resume and this
                 # send: this push is now the in-flight gradient of a new
                 # session loss — recover once more (bounded by its own

@@ -142,11 +142,11 @@ class ClusterMonitor:
         # the push-delta accounting is read-modify-write); concurrent
         # /cluster scrapes queue here briefly instead of corrupting state.
         self._eval_lock = threading.Lock()
-        self._reports: dict[int, tuple[dict, float]] = {}
-        self._expired_pending: list[int] = []
+        self._reports: dict[int, tuple[dict, float]] = {}  # guarded by: self._lock
+        self._expired_pending: list[int] = []  # guarded by: self._lock
         self._started_ts = clock()
-        self._seq = 0
-        self._last_events: list[dict] = []
+        self._seq = 0  # guarded by: self._lock
+        self._last_events: list[dict] = []  # guarded by: self._lock
         # Staleness-spike measurement window, anchored in TIME — (start_ts,
         # accepted_total, rejected_total at start). Rolled at most once per
         # monitor interval, NOT per evaluation: /healthz and /cluster each
@@ -161,7 +161,7 @@ class ClusterMonitor:
         # here, docs/ROBUSTNESS.md): called with each non-empty batch of
         # events after an evaluation pass. Listener failures are
         # swallowed — acting on alerts must not break detecting them.
-        self._listeners: list = []
+        self._listeners: list = []  # guarded by: self._lock
         #: Optional RemediationEngine; when set, cluster_view() carries
         #: its state under "remediation" (cli serve --remediate wires it).
         self.remediation = None
@@ -240,7 +240,7 @@ class ClusterMonitor:
     def _build_state(self, now: float) -> ClusterState:
         try:
             membership = list(self.store.membership_snapshot())
-        except Exception:
+        except Exception:  # noqa: BLE001 — any store backend, any failure
             membership = []
         last_seen = dict(getattr(self.store, "last_seen", {}) or {})
         cfg = getattr(self.store, "config", None)
@@ -302,9 +302,13 @@ class ClusterMonitor:
                                       if w.in_membership]))
             self._tm_active.set(len(active))
             if events:
+                # Listener snapshot under the lock: an unguarded
+                # list() raced add_listener's append from another
+                # thread (remediation attaches mid-flight).
                 with self._lock:
                     self._last_events.extend(events)
-                for fn in list(self._listeners):
+                    listeners = list(self._listeners)
+                for fn in listeners:
                     try:
                         fn(events)
                     except Exception:  # noqa: BLE001
@@ -316,7 +320,8 @@ class ClusterMonitor:
         """Subscribe to alert edge events: ``fn(events)`` is called after
         every evaluation pass that produced any (the remediation engine's
         intake; docs/ROBUSTNESS.md)."""
-        self._listeners.append(fn)
+        with self._lock:
+            self._listeners.append(fn)
 
     def _record_event(self, ev: dict) -> None:
         """Drop the alert event into the flight recorder, span-shaped so
@@ -336,7 +341,7 @@ class ClusterMonitor:
                 "tid": threading.get_ident(),
                 "attrs": {k: v for k, v in ev.items() if v is not None},
             })
-        except Exception:
+        except Exception:  # noqa: BLE001 — recording must not hurt
             pass
 
     # -- read side -----------------------------------------------------------
@@ -438,7 +443,7 @@ class ClusterMonitor:
                     self.emit_once()
                 else:
                     self.evaluate()
-            except Exception:
+            except Exception:  # noqa: BLE001
                 pass  # the monitor must never take the server down
 
     def start(self) -> "ClusterMonitor":
@@ -457,7 +462,7 @@ class ClusterMonitor:
         if final and self.emit_stream:
             try:
                 self.emit_once()
-            except Exception:
+            except Exception:  # noqa: BLE001 — shutdown path must not raise
                 pass
 
 

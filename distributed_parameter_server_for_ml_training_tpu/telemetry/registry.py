@@ -87,7 +87,7 @@ class Counter:
     def __init__(self, name: str, labels: dict | None = None):
         self.name = name
         self.labels = dict(labels or {})
-        self._value = 0.0
+        self._value = 0.0  # guarded by: self._lock
         self._lock = threading.Lock()
 
     def inc(self, n: float = 1.0) -> None:
@@ -114,7 +114,7 @@ class Gauge:
     def __init__(self, name: str, labels: dict | None = None):
         self.name = name
         self.labels = dict(labels or {})
-        self._value = 0.0
+        self._value = 0.0  # guarded by: self._lock
         self._lock = threading.Lock()
 
     def set(self, v: float) -> None:
@@ -156,9 +156,10 @@ class Histogram:
         self.name = name
         self.labels = dict(labels or {})
         self.buckets = tuple(float(b) for b in buckets)
+        # guarded by: self._lock
         self._counts = [0] * (len(self.buckets) + 1)  # +1 = overflow
-        self._sum = 0.0
-        self._count = 0
+        self._sum = 0.0  # guarded by: self._lock
+        self._count = 0  # guarded by: self._lock
         self._lock = threading.Lock()
 
     def observe(self, v: float) -> None:
@@ -198,7 +199,7 @@ class MetricsRegistry:
     """
 
     def __init__(self):
-        self._instruments: dict[str, object] = {}
+        self._instruments: dict[str, object] = {}  # guarded by: self._lock
         self._lock = threading.Lock()
 
     def _get_or_create(self, cls, name: str, labels: dict, **kwargs):

@@ -142,12 +142,12 @@ class RemediationEngine:
         self.clock = clock
         self.role = role
         self._lock = threading.Lock()
-        self._last_action: dict[tuple, float] = {}
+        self._last_action: dict[tuple, float] = {}  # guarded by: self._lock
         #: (action, worker) -> the event dict that activated it; an entry
         #: here is an ACTIVE remediation (shown in /cluster, lifted on
         #: alert resolution).
-        self._active: dict[tuple, dict] = {}
-        self.events: deque = deque(maxlen=EVENTS_KEPT)
+        self._active: dict[tuple, dict] = {}  # guarded by: self._lock
+        self.events: deque = deque(maxlen=EVENTS_KEPT)  # guarded by: self._lock
         reg = registry or get_registry()
         self._tm = {
             (a, o): reg.counter("dps_remediation_actions_total",

@@ -54,7 +54,7 @@ class SnapshotEmitter:
         self.proc = {"role": role, "pid": os.getpid(), **(proc or {})}
         self.stream = stream
         self.clock = clock
-        self.seq = 0
+        self.seq = 0  # guarded by: self._emit_lock
         self._t0 = clock()
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None

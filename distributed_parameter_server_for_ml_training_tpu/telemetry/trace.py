@@ -130,9 +130,9 @@ class FlightRecorder:
             raise ValueError(f"maxlen must be >= 1, got {maxlen}")
         self.maxlen = int(maxlen)
         self.role = role
-        self._spans: deque = deque(maxlen=self.maxlen)
+        self._spans: deque = deque(maxlen=self.maxlen)  # guarded by: self._lock
         self._lock = threading.Lock()
-        self._dropped = 0
+        self._dropped = 0  # guarded by: self._lock
 
     def record(self, span: dict) -> None:
         with self._lock:
@@ -412,12 +412,12 @@ def _run_shutdown(reason: str) -> None:
             path = _RECORDER.dump_to_dir(dump_dir, reason)
             print(f"flight recorder: dumped {len(_RECORDER)} spans -> "
                   f"{path} ({reason})", file=sys.stderr, flush=True)
-        except Exception:
+        except Exception:  # noqa: BLE001 — crash dump is best-effort
             pass
     for fn in fns:
         try:
             fn()
-        except Exception:
+        except Exception:  # noqa: BLE001 — one bad hook can't block the rest
             pass
 
 

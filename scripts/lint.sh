@@ -1,0 +1,101 @@
+#!/usr/bin/env bash
+# Static gates, runnable as one command (and invoked by tier1.sh):
+#
+#   1. dpslint — the framework-aware analyzer (tools/dpslint): lock
+#      discipline, hot-path allocations, capability gating, JAX
+#      pitfalls, catalog drift. Exit 1 on any non-baselined finding.
+#   2. ruff — when the wheel is present. The offline build environment
+#      has no ruff, so its absence is a VISIBLE skip, not a silent
+#      pass, and a reduced AST audit (the rules pyproject.toml selects
+#      beyond E/F/W: B006/B008 mutable/call defaults, BLE001 blind
+#      excepts without noqa) runs in its place.
+#   3. slow-marker audit — any test module that imports the fetch load
+#      generator or drives the chaos soaks spawns subprocess servers or
+#      timed load loops; those belong behind the `slow` marker, outside
+#      the tier-1 budget.
+set -u
+cd "$(dirname "$0")/.."
+rc=0
+
+# --- 1. dpslint -----------------------------------------------------------
+if ! env JAX_PLATFORMS=cpu python -m tools.dpslint; then
+  echo "lint.sh: dpslint failed" >&2
+  rc=1
+fi
+
+# --- 2. ruff (or the offline AST audit) -----------------------------------
+if command -v ruff >/dev/null 2>&1; then
+  if ! ruff check .; then
+    echo "lint.sh: ruff failed" >&2
+    rc=1
+  fi
+elif python -c "import ruff" >/dev/null 2>&1; then
+  if ! python -m ruff check .; then
+    echo "lint.sh: ruff failed" >&2
+    rc=1
+  fi
+else
+  echo "SKIP: ruff not installed — running the reduced AST audit instead"
+  if ! python - <<'EOF'
+import ast
+import sys
+from pathlib import Path
+
+bad = []
+files = [p for base in ("distributed_parameter_server_for_ml_training_tpu",
+                        "tools", "tests", "experiments")
+         for p in Path(base).rglob("*.py") if "__pycache__" not in p.parts]
+files += [Path("bench.py"), Path("__graft_entry__.py")]
+PKG = "distributed_parameter_server_for_ml_training_tpu"
+for p in files:
+    text = p.read_text()
+    lines = text.splitlines()
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as e:
+        bad.append(f"{p}: syntax error: {e}")
+        continue
+    for i, line in enumerate(lines, 1):
+        if len(line) > 100:
+            bad.append(f"{p}:{i} E501 line too long ({len(line)} > 100)")
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for d in list(node.args.defaults) \
+                    + [d for d in node.args.kw_defaults if d]:
+                if isinstance(d, (ast.List, ast.Dict, ast.Set)):
+                    bad.append(f"{p}:{d.lineno} B006 mutable default "
+                               f"in {node.name}()")
+                if isinstance(d, ast.Call):
+                    bad.append(f"{p}:{d.lineno} B008 call in default "
+                               f"in {node.name}()")
+        if isinstance(node, ast.ExceptHandler) and p.parts[0] == PKG:
+            broad = node.type is None or (
+                isinstance(node.type, ast.Name)
+                and node.type.id in ("Exception", "BaseException"))
+            if broad and "noqa" not in lines[node.lineno - 1]:
+                bad.append(f"{p}:{node.lineno} BLE001 broad except "
+                           f"without an explicit noqa")
+if bad:
+    print("\n".join(bad))
+    print(f"AST audit: {len(bad)} finding(s)", file=sys.stderr)
+    sys.exit(1)
+print(f"AST audit OK ({len(files)} files)")
+EOF
+  then
+    echo "lint.sh: AST audit failed" >&2
+    rc=1
+  fi
+fi
+
+# --- 3. slow-marker audit -------------------------------------------------
+for f in tests/*.py; do
+  if grep -qE 'loadgen|run_loadgen|run_chaos_soak|run_shard_scale|chaos_soak' "$f"; then
+    if ! grep -qE 'pytest\.mark\.slow|pytestmark *= *\[?pytest\.mark\.slow' "$f"; then
+      echo "MARKER AUDIT FAIL: $f imports the load generator or chaos" \
+           "soaks but carries no 'slow' marker" >&2
+      rc=1
+    fi
+  fi
+done
+[ "$rc" -eq 0 ] && echo "lint.sh OK"
+exit "$rc"

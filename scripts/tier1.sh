@@ -1,30 +1,16 @@
 #!/usr/bin/env bash
-# Tier-1 verify, runnable as one command: the ROADMAP.md gate VERBATIM,
-# preceded by a marker audit so the gate cannot silently grow a slow
-# test. Any test module that imports the fetch load generator
-# (comms/loadgen) or drives the chaos soaks (experiments/run_chaos_soak /
-# run_shard_scale) spawns subprocess servers or timed load loops — those
-# belong behind the `slow` marker, outside the tier-1 budget. A file
-# matching either pattern without a `slow` marker fails the audit before
-# pytest even starts.
+# Tier-1 verify, runnable as one command: the static gates
+# (scripts/lint.sh — dpslint, ruff-when-present, the slow-marker audit)
+# followed by the ROADMAP.md pytest gate VERBATIM. Lint failures stop the
+# run before pytest even starts, exactly like the marker audit always did
+# (the audit now lives in lint.sh beside the other static checks).
 set -u
 cd "$(dirname "$0")/.."
 
-audit_rc=0
-for f in tests/*.py; do
-  if grep -qE 'loadgen|run_loadgen|run_chaos_soak|run_shard_scale|chaos_soak' "$f"; then
-    if ! grep -qE 'pytest\.mark\.slow|pytestmark *= *\[?pytest\.mark\.slow' "$f"; then
-      echo "MARKER AUDIT FAIL: $f imports the load generator or chaos" \
-           "soaks but carries no 'slow' marker" >&2
-      audit_rc=1
-    fi
-  fi
-done
-if [ "$audit_rc" -ne 0 ]; then
-  echo "marker audit failed — fix the markers before running tier-1" >&2
-  exit "$audit_rc"
+if ! bash scripts/lint.sh; then
+  echo "static gates failed — fix lint before running tier-1" >&2
+  exit 1
 fi
-echo "marker audit OK"
 
 # --- ROADMAP.md "Tier-1 verify", verbatim ---------------------------------
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

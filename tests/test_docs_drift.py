@@ -1,279 +1,97 @@
-"""Docs <-> code drift guard (ISSUE 3 satellite, tier-1).
+"""Docs <-> code drift guard (tier-1), one test per pinned contract.
 
-docs/OBSERVABILITY.md is the operator-facing contract for metric and span
-names; this static check pins it to the code in BOTH directions:
+The extraction engine moved into ``tools/dpslint/catalog_drift.py``
+(ISSUE 10): dpslint's ``doc-drift`` rule runs EVERY check below as part
+of ``python -m tools.dpslint`` / ``scripts/lint.sh``, and these tests
+delegate to the same ``CHECKS`` table — so pytest keeps its
+one-failure-per-contract granularity (a rotted codec table fails the
+codec test, not a monolith) while code and gate share one definition of
+each pin. The contracts, unchanged from their introductions:
 
-- every ``dps_*`` metric registered anywhere in the package appears in the
-  doc, and every ``dps_*`` name the doc mentions is actually registered
-  (a renamed metric that leaves a stale dashboard recipe fails CI, not a
-  production debugging session);
-- every span name in ``telemetry.SPAN_CATALOG`` is documented, every
-  span-like name the doc mentions exists in the catalog, and every
-  ``trace_span(...)`` call site in the package uses a catalog name;
-- every health rule in ``telemetry.health.RULE_CATALOG`` appears in the
-  doc's rule table WITH its severity, and every rule row the doc carries
-  exists in the catalog (ISSUE 5 satellite: rule names drive alerting,
-  ``dps_alerts_total`` labels, and status rendering — a silently renamed
-  rule would strand every consumer);
-- every push/fetch wire codec in ``ops.compression.CODEC_CATALOG``
-  appears in docs/WIRE_PROTOCOL.md's codec table and vice versa (ISSUE 6
-  satellite: codec names ride CLI flags, registration replies, and the
-  health report's ``push_codec`` field).
+- ISSUE 3: every registered ``dps_*`` metric appears in
+  docs/OBSERVABILITY.md and vice versa; span catalog + call sites.
+- ISSUE 5: health rules pinned with severities.
+- ISSUE 6: push/fetch wire codecs vs docs/WIRE_PROTOCOL.md.
+- ISSUE 7: directives, remediation actions, and the default policy
+  table vs docs/ROBUSTNESS.md.
+- ISSUE 9: shard-map schema fields and the sharding metric families.
+- ISSUE 10 (new): dpslint's own rule table vs docs/STATIC_ANALYSIS.md,
+  and the envelope-meta key catalog vs docs/WIRE_PROTOCOL.md.
 
-Pure text analysis — no training, no jax beyond the package import.
+Pure text/AST analysis — no jax, no package import: catalogs are
+literal-extracted from source files by the drift engine.
 """
 
 from __future__ import annotations
 
 import re
+import sys
 from pathlib import Path
 
-from distributed_parameter_server_for_ml_training_tpu.telemetry import (
-    RULE_CATALOG, SPAN_CATALOG)
+import pytest
 
 REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.dpslint import catalog_drift  # noqa: E402
+from tools.dpslint.core import load_sources  # noqa: E402
+
 PKG = REPO / "distributed_parameter_server_for_ml_training_tpu"
-OBS_DOC = REPO / "docs" / "OBSERVABILITY.md"
-
-#: An instrument registration: ``.counter("dps_...")`` / ``.gauge(...)`` /
-#: ``.histogram(...)`` — possibly line-wrapped between the paren and the
-#: name literal. Comparison string literals (ETL name matches in
-#: analysis/parse_logs.py) deliberately do NOT match.
-_REG_RE = re.compile(
-    r'\.(?:counter|gauge|histogram)\(\s*"(dps_[a-z0-9_]+)"', re.S)
-
-_DOC_METRIC_RE = re.compile(r"dps_[a-z0-9_]+")
-
-#: A span name mentioned in the doc: backticked, dotted, first segment
-#: from the known namespaces. File mentions like ``ps/worker.py`` don't
-#: match (the backtick is not immediately followed by the namespace);
-#: ``.py`` tails are filtered below for safety.
-_DOC_SPAN_RE = re.compile(
-    r"`((?:worker|rpc|store|pipeline|trainer)\.[a-z_]+)`")
-
-_CALLSITE_RE = re.compile(r'trace_span\(\s*"([a-z_.]+)"', re.S)
 
 
-def _package_sources() -> list[tuple[Path, str]]:
-    return [(p, p.read_text()) for p in sorted(PKG.rglob("*.py"))]
+@pytest.fixture(scope="module")
+def ctx() -> catalog_drift.DriftContext:
+    return catalog_drift.DriftContext(REPO, load_sources(PKG, REPO))
 
 
-def test_every_registered_metric_is_documented_and_vice_versa():
-    registered: set[str] = set()
-    for _, text in _package_sources():
-        registered |= set(_REG_RE.findall(text))
-    assert registered, "no registrations found — regex rotted?"
-    documented = set(_DOC_METRIC_RE.findall(OBS_DOC.read_text()))
-    missing_from_doc = sorted(registered - documented)
-    unknown_in_doc = sorted(documented - registered)
-    assert not missing_from_doc, (
-        f"metrics registered in code but absent from docs/OBSERVABILITY.md:"
-        f" {missing_from_doc}")
-    assert not unknown_in_doc, (
-        f"docs/OBSERVABILITY.md mentions metrics no code registers "
-        f"(renamed or removed?): {unknown_in_doc}")
+def _assert_clean(check: str, ctx) -> None:
+    findings = catalog_drift.CHECKS[check](ctx)
+    assert not findings, "\n".join(f.render() for f in findings)
 
 
-def test_every_catalog_span_is_documented_and_vice_versa():
-    doc_spans = {n for n in _DOC_SPAN_RE.findall(OBS_DOC.read_text())
-                 if not n.endswith(".py")}
-    catalog = set(SPAN_CATALOG)
-    missing_from_doc = sorted(catalog - doc_spans)
-    unknown_in_doc = sorted(doc_spans - catalog)
-    assert not missing_from_doc, (
-        f"SPAN_CATALOG names absent from docs/OBSERVABILITY.md: "
-        f"{missing_from_doc}")
-    assert not unknown_in_doc, (
-        f"docs/OBSERVABILITY.md mentions span names not in SPAN_CATALOG: "
-        f"{unknown_in_doc}")
+@pytest.mark.parametrize("check", sorted(catalog_drift.CHECKS))
+def test_contract_is_drift_free(check, ctx):
+    """One parametrized case per pinned contract: the failure names the
+    contract and every drifted entry with its rule id and location."""
+    _assert_clean(check, ctx)
 
 
-def test_every_trace_span_call_site_uses_a_catalog_name():
-    offenders = []
-    for path, text in _package_sources():
-        for name in _CALLSITE_RE.findall(text):
-            if name not in SPAN_CATALOG:
-                offenders.append((str(path.relative_to(REPO)), name))
-    assert not offenders, (
-        f"trace_span() call sites with names missing from SPAN_CATALOG "
-        f"(add them there AND to docs/OBSERVABILITY.md): {offenders}")
+def test_checks_table_covers_every_check_function():
+    """Adding a check_* function without registering it in CHECKS would
+    silently drop the contract from BOTH the gate and these tests."""
+    defined = {name for name in dir(catalog_drift)
+               if name.startswith("check_")}
+    registered = {fn.__name__ for fn in catalog_drift.CHECKS.values()}
+    assert defined == registered, (
+        f"check functions not registered in CHECKS: "
+        f"{sorted(defined - registered)}")
 
 
-#: A rule-table row: ``| `rule_name` | severity | ...``. Metric-table rows
-#: have a kind (counter/gauge/histogram) in column 2, so they can't match.
-_DOC_RULE_RE = re.compile(
-    r"\|\s*`([a-z_]+)`\s*\|\s*(critical|warning|info)\s*\|")
-
-
-def test_every_health_rule_is_documented_with_severity_and_vice_versa():
-    doc_rows = dict(_DOC_RULE_RE.findall(OBS_DOC.read_text()))
-    catalog = {rule: sev for rule, (sev, _) in RULE_CATALOG.items()}
-    assert doc_rows, "no rule-table rows found — table format rotted?"
-    missing_from_doc = sorted(set(catalog) - set(doc_rows))
-    unknown_in_doc = sorted(set(doc_rows) - set(catalog))
-    assert not missing_from_doc, (
-        f"RULE_CATALOG rules absent from docs/OBSERVABILITY.md's rule "
-        f"table: {missing_from_doc}")
-    assert not unknown_in_doc, (
-        f"docs/OBSERVABILITY.md documents rules not in RULE_CATALOG "
-        f"(renamed or removed?): {unknown_in_doc}")
-    mismatched = sorted(r for r in catalog
-                        if doc_rows[r] != catalog[r])
-    assert not mismatched, (
-        f"rule severities disagree between code and doc: "
-        f"{[(r, catalog[r], doc_rows[r]) for r in mismatched]}")
-
-
-WIRE_DOC = REPO / "docs" / "WIRE_PROTOCOL.md"
-
-#: A codec-table row: ``| `name` | ...`` inside the "Push codecs" section.
-#: Scoped to the section so metric names elsewhere in the doc can't match.
-_DOC_CODEC_RE = re.compile(r"^\|\s*`([a-z0-9_]+)`\s*\|", re.M)
-
-
-def test_every_codec_is_documented_and_vice_versa():
-    from distributed_parameter_server_for_ml_training_tpu.ops.compression \
-        import CODEC_CATALOG
-
-    text = WIRE_DOC.read_text()
-    assert "## Push codecs" in text, "codec section heading rotted?"
-    section = text.split("## Push codecs", 1)[1].split("\n## ", 1)[0]
-    doc_codecs = set(_DOC_CODEC_RE.findall(section))
-    catalog = set(CODEC_CATALOG)
-    missing_from_doc = sorted(catalog - doc_codecs)
-    unknown_in_doc = sorted(doc_codecs - catalog)
-    assert not missing_from_doc, (
-        f"CODEC_CATALOG codecs absent from docs/WIRE_PROTOCOL.md's codec "
-        f"table: {missing_from_doc}")
-    assert not unknown_in_doc, (
-        f"docs/WIRE_PROTOCOL.md documents codecs not in CODEC_CATALOG "
-        f"(renamed or removed?): {unknown_in_doc}")
-
-
-ROB_DOC = REPO / "docs" / "ROBUSTNESS.md"
-
-#: A directive/action-table row: ``| `name` | meaning |``; scoped to the
-#: relevant section below so other tables can't match.
-_DOC_NAME_ROW_RE = re.compile(r"^\|\s*`([a-z0-9_]+)`\s*\|", re.M)
-
-
-def _doc_section(text: str, heading: str) -> str:
-    assert heading in text, f"section {heading!r} rotted?"
-    return text.split(heading, 1)[1].split("\n#", 1)[0]
-
-
-def test_every_directive_is_documented_and_vice_versa():
-    """ISSUE 7 satellite: directive names ride the wire (reply meta),
-    label ``dps_worker_directives_total``, and drive worker behavior — a
-    silent rename would strand the remediation engine and the doc."""
-    from distributed_parameter_server_for_ml_training_tpu.comms.service \
-        import DIRECTIVE_CATALOG
-
-    section = _doc_section(ROB_DOC.read_text(), "#### Directive catalog")
-    doc_names = set(_DOC_NAME_ROW_RE.findall(section))
-    catalog = set(DIRECTIVE_CATALOG)
-    missing_from_doc = sorted(catalog - doc_names)
-    unknown_in_doc = sorted(doc_names - catalog)
-    assert not missing_from_doc, (
-        f"DIRECTIVE_CATALOG entries absent from docs/ROBUSTNESS.md's "
-        f"directive table: {missing_from_doc}")
-    assert not unknown_in_doc, (
-        f"docs/ROBUSTNESS.md documents directives not in "
-        f"DIRECTIVE_CATALOG (renamed or removed?): {unknown_in_doc}")
-
-
-def test_every_remediation_action_is_documented_and_vice_versa():
-    """ISSUE 7 satellite: action names label
-    ``dps_remediation_actions_total`` and the policy table — pinned to
-    docs/ROBUSTNESS.md's action catalog both directions."""
-    from distributed_parameter_server_for_ml_training_tpu.telemetry \
-        import ACTION_CATALOG
-
-    section = _doc_section(ROB_DOC.read_text(), "#### Action catalog")
-    doc_names = set(_DOC_NAME_ROW_RE.findall(section))
-    catalog = set(ACTION_CATALOG)
-    missing_from_doc = sorted(catalog - doc_names)
-    unknown_in_doc = sorted(doc_names - catalog)
-    assert not missing_from_doc, (
-        f"ACTION_CATALOG entries absent from docs/ROBUSTNESS.md's action "
-        f"table: {missing_from_doc}")
-    assert not unknown_in_doc, (
-        f"docs/ROBUSTNESS.md documents remediation actions not in "
-        f"ACTION_CATALOG (renamed or removed?): {unknown_in_doc}")
-
-
-def test_policy_table_rules_and_actions_exist():
-    """Every rule in the doc's policy table is a real health rule, and
-    every action it maps to is in the action catalog AND the engine's
-    default policy matches the documented rows."""
-    from distributed_parameter_server_for_ml_training_tpu.telemetry \
-        import ACTION_CATALOG
-    from distributed_parameter_server_for_ml_training_tpu.telemetry \
-        .remediation import DEFAULT_POLICY_RULES
-
-    section = _doc_section(ROB_DOC.read_text(),
-                           "#### Policy table (defaults)")
-    rows = re.findall(r"^\|\s*`([a-z_]+)`\s*\|\s*(.+?)\s*\|", section,
-                      re.M)
-    doc_policy = {}
-    for rule, actions_cell in rows:
-        doc_policy[rule] = tuple(re.findall(r"`([a-z_]+)`", actions_cell))
-    assert doc_policy, "policy table rotted?"
-    for rule, actions in doc_policy.items():
-        assert rule in RULE_CATALOG, f"unknown rule {rule!r} in doc"
-        for a in actions:
-            assert a in ACTION_CATALOG, f"unknown action {a!r} in doc"
-    code_policy = {r: tuple(a) for r, a in DEFAULT_POLICY_RULES.items()}
-    assert doc_policy == code_policy, (
-        f"policy table disagrees with DEFAULT_POLICY_RULES: doc="
-        f"{doc_policy} code={code_policy}")
-
-
-SHARD_DOC = REPO / "docs" / "SHARDING.md"
-
-
-def test_shard_map_fields_documented_and_vice_versa():
-    """ISSUE 9 satellite: the shard map is the wire artifact workers
-    route pushes by — ``SHARD_MAP_FIELDS`` is pinned to docs/SHARDING.md's
-    field table in both directions, same discipline as metrics/codecs."""
-    from distributed_parameter_server_for_ml_training_tpu.ps.sharding \
-        import SHARD_MAP_FIELDS
-
-    section = _doc_section(SHARD_DOC.read_text(), "### Shard map schema")
-    doc_fields = set(_DOC_NAME_ROW_RE.findall(section))
-    schema = set(SHARD_MAP_FIELDS)
-    missing_from_doc = sorted(schema - doc_fields)
-    unknown_in_doc = sorted(doc_fields - schema)
-    assert not missing_from_doc, (
-        f"SHARD_MAP_FIELDS absent from docs/SHARDING.md's field table: "
-        f"{missing_from_doc}")
-    assert not unknown_in_doc, (
-        f"docs/SHARDING.md documents shard-map fields not in "
-        f"SHARD_MAP_FIELDS (renamed or removed?): {unknown_in_doc}")
-
-
-def test_sharding_metric_families_pinned_both_directions():
-    """The general metric pin already guards every dps_* name; this makes
-    the ISSUE 9 families an explicit contract — removing or renaming the
-    shard/replica-lag gauges must fail HERE with a sharding-specific
-    message, not only in the catch-all diff."""
-    registered: set[str] = set()
-    for _, text in _package_sources():
-        registered |= set(_REG_RE.findall(text))
-    documented = set(_DOC_METRIC_RE.findall(OBS_DOC.read_text()))
-    families = {"dps_shard_id", "dps_shard_count",
-                "dps_shard_map_version", "dps_shard_replicas",
-                "dps_replica_lag_steps", "dps_replica_lag_seconds"}
-    assert families <= registered, (
-        f"sharding metrics no longer registered: "
-        f"{sorted(families - registered)}")
-    assert families <= documented, (
-        f"sharding metrics missing from docs/OBSERVABILITY.md: "
-        f"{sorted(families - documented)}")
-
-
-def test_catalog_names_are_namespaced_and_lowercase():
-    for name in SPAN_CATALOG:
+def test_catalog_names_are_namespaced_and_lowercase(ctx):
+    spans = ctx.catalog(
+        "distributed_parameter_server_for_ml_training_tpu/telemetry/"
+        "trace.py", "SPAN_CATALOG")
+    for name in spans:
         assert re.fullmatch(r"[a-z]+\.[a-z_]+", name), name
         assert name.split(".")[0] in {"worker", "rpc", "store",
                                       "pipeline", "trainer"}, name
+
+
+def test_drift_detection_actually_fires(tmp_path, ctx):
+    """Negative control: a doc missing one registered metric must produce
+    a doc-drift finding — guards against the diff silently passing on
+    regex rot (the check asserting non-emptiness is not enough when the
+    DOC side rots)."""
+    registered = {m for s in ctx.sources
+                  for m in catalog_drift.REG_RE.findall(s.text)}
+    victim = sorted(registered)[0]
+    real = (REPO / "docs" / "OBSERVABILITY.md").read_text()
+    (tmp_path / "docs").mkdir()
+    (tmp_path / "docs" / "OBSERVABILITY.md").write_text(
+        real.replace(victim, "dps_renamed_out_from_under_the_doc"))
+    broken = catalog_drift.DriftContext(tmp_path, ctx.sources)
+    findings = catalog_drift.check_metrics(broken)
+    assert any(victim in f.message for f in findings), (
+        f"renaming {victim!r} in the doc produced no finding: "
+        f"{[f.render() for f in findings]}")

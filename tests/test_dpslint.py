@@ -1,0 +1,481 @@
+"""dpslint analyzer tests (tier-1): fixtures per rule + the e2e gate.
+
+Three layers:
+
+1. **Fixture snippets** — tiny modules written to ``tmp_path``, wrapped
+   in :class:`SourceFile`, and fed to one pass at a time. Each rule gets
+   a positive (the pattern it exists to catch) AND the nearest negative
+   (the sanctioned spelling it must NOT flag), because a lint rule's
+   false-positive behavior is as much a contract as its detections —
+   these pins are what let the passes evolve without re-auditing the
+   whole package by hand.
+2. **Mechanics** — inline ``# dpslint: ignore[...]`` suppressions and
+   the baseline register (justification >= 10 chars enforced, stale
+   entries surfaced, matching by symbol so findings survive line drift).
+3. **The e2e gate** — ``run_lint(REPO)`` must come back clean (this IS
+   the tier-1 assertion ``scripts/lint.sh`` enforces) and under the 5 s
+   budget ``bench.py``'s ``lint_probe`` advertises.
+
+Pure stdlib + the tool itself — no jax, no package import.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.dpslint import capability  # noqa: E402
+from tools.dpslint import hot_path  # noqa: E402
+from tools.dpslint import jax_pitfalls  # noqa: E402
+from tools.dpslint import lock_discipline  # noqa: E402
+from tools.dpslint.cli import DEFAULT_BASELINE, run_lint  # noqa: E402
+from tools.dpslint.cli import main as dpslint_main  # noqa: E402
+from tools.dpslint.core import (BaselineError, Finding,  # noqa: E402
+                                SourceFile, apply_baseline,
+                                load_baseline, split_suppressed)
+
+
+def _src(tmp_path: Path, rel: str, code: str) -> SourceFile:
+    path = tmp_path / rel
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(code))
+    return SourceFile(path, tmp_path)
+
+
+# -- rule: lock-guard --------------------------------------------------------
+
+class TestLockGuard:
+    def test_access_outside_lock_is_flagged_held_is_not(self, tmp_path):
+        src = _src(tmp_path, "m.py", """\
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0  # guarded by: self._lock
+
+                def ok(self):
+                    with self._lock:
+                        self.count += 1
+
+                def bad_read(self):
+                    return self.count
+            """)
+        found = lock_discipline.run([src])
+        assert [f.rule for f in found] == ["lock-guard"]
+        assert found[0].symbol == "C.bad_read.count"
+        assert "read in bad_read()" in found[0].message
+
+    def test_constructor_and_locked_suffix_methods_exempt(self, tmp_path):
+        src = _src(tmp_path, "m.py", """\
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.count = 0  # guarded by: self._lock
+                    self._init_more()
+
+                def _init_more(self):
+                    self.count = 1
+
+                def drain_locked(self):
+                    return self.count
+            """)
+        assert lock_discipline.run([src]) == []
+
+    def test_guard_declared_on_mixin_binds_subclass(self, tmp_path):
+        # Module-local inheritance: the mixin declares the contract, the
+        # concrete class violates it (the AggregationBase pattern).
+        src = _src(tmp_path, "m.py", """\
+            class Base:
+                total: int  # guarded by: self._lock
+
+            class Impl(Base):
+                def bump(self):
+                    self.total += 1
+            """)
+        found = lock_discipline.run([src])
+        assert [f.symbol for f in found] == ["Impl.bump.total"]
+
+    def test_wrong_lock_held_still_flagged(self, tmp_path):
+        src = _src(tmp_path, "m.py", """\
+            import threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._other = threading.Lock()
+                    self.count = 0  # guarded by: self._lock
+
+                def bad(self):
+                    with self._other:
+                        self.count += 1
+            """)
+        found = lock_discipline.run([src])
+        assert [f.rule for f in found] == ["lock-guard"]
+
+
+# -- rule: thread-shared -----------------------------------------------------
+
+class TestThreadShared:
+    SHARED = """\
+        import threading
+
+        class W:
+            def __init__(self):
+                self._t = threading.Thread(target=self._run)
+
+            def _run(self):
+                self.status = "running"
+
+            def snapshot(self):
+                return self.status
+        """
+
+    def test_undeclared_cross_thread_write_is_flagged(self, tmp_path):
+        found = lock_discipline.run([_src(tmp_path, "m.py", self.SHARED)])
+        assert [(f.rule, f.symbol) for f in found] == \
+            [("thread-shared", "W.status")]
+        assert "_run" in found[0].message and "snapshot" in found[0].message
+
+    def test_declared_guard_silences_it(self, tmp_path):
+        src = _src(tmp_path, "m.py", """\
+            import threading
+
+            class W:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._t = threading.Thread(target=self._run)
+
+                def _run(self):
+                    with self._lock:
+                        self.status = "x"  # guarded by: self._lock
+
+                def snapshot(self):
+                    with self._lock:
+                        return self.status
+            """)
+        assert lock_discipline.run([src]) == []
+
+    def test_bind_then_spawn_start_writes_exempt(self, tmp_path):
+        # start() filling a field before spawning the thread is the
+        # codebase's lifecycle convention, not a race.
+        src = _src(tmp_path, "m.py", """\
+            import threading
+
+            class S:
+                def start(self):
+                    self.port = 9000
+                    self._t = threading.Thread(target=self._serve)
+                    self._t.start()
+
+                def _serve(self):
+                    return self.port
+            """)
+        assert lock_discipline.run([src]) == []
+
+
+# -- rule: hot-path-alloc ----------------------------------------------------
+
+class TestHotPathAlloc:
+    def test_marked_function_allocations_flagged(self, tmp_path):
+        src = _src(tmp_path, "m.py", """\
+            import numpy as np
+
+            # dpslint: hot-path — fixture
+            def encode(arr):
+                a = np.array(arr)
+                b = arr.tobytes()
+                c = arr.astype(np.float32)
+                d = arr.astype(np.float32, copy=False)
+                e = np.asarray(arr)
+                f = np.frombuffer(b)
+                return a, b, c, d, e, f
+            """)
+        found = hot_path.run([src])
+        assert len(found) == 3
+        assert all(f.rule == "hot-path-alloc" for f in found)
+        msgs = " | ".join(f.message for f in found)
+        assert "np.array()" in msgs
+        assert ".tobytes()" in msgs
+        assert "without copy=False" in msgs
+
+    def test_unmarked_function_is_ignored(self, tmp_path):
+        src = _src(tmp_path, "m.py", """\
+            import numpy as np
+
+            def cold(arr):
+                return np.array(arr.tobytes())
+            """)
+        assert hot_path.run([src]) == []
+
+    def test_trailing_marker_on_def_line_registers(self, tmp_path):
+        src = _src(tmp_path, "m.py", """\
+            import numpy as np
+
+            def push(arr):  # dpslint: hot-path
+                return np.copy(arr)
+            """)
+        found = hot_path.run([src])
+        assert [f.symbol for f in found] == ["push"]
+
+    def test_marker_separated_from_def_does_not_register(self, tmp_path):
+        # Only the single comment line directly above the def counts —
+        # a marker drifting upward as prose grows would silently unmark
+        # the function, so the rule refuses multi-line blocks outright.
+        src = _src(tmp_path, "m.py", """\
+            import numpy as np
+
+            # dpslint: hot-path
+            # ...followed by explanatory prose pushing it off the def.
+            def not_marked(arr):
+                return np.copy(arr)
+            """)
+        assert hot_path.run([src]) == []
+
+
+# -- rules: meta-key / cap-gate ----------------------------------------------
+
+class TestCapabilityGating:
+    CLIENT = """\
+        def on_reply(meta):
+            step = meta.get("global_step")
+            unknown = meta.get("brand_new_key")
+            wid = meta.get("worker_id")
+            return step, unknown, wid
+
+        def gated(meta):
+            if meta.get("not_modified"):
+                return None
+            return meta.get("global_step")
+        """
+
+    def test_uncataloged_and_ungated_reads_flagged(self, tmp_path):
+        src = _src(tmp_path, "pkg/comms/client.py", self.CLIENT)
+        found = capability.run([src])
+        by_rule = {(f.rule, f.symbol) for f in found}
+        assert by_rule == {
+            ("cap-gate", "on_reply:global_step"),
+            ("meta-key", "on_reply:brand_new_key"),
+        }
+
+    def test_gate_token_reference_satisfies_the_gate(self, tmp_path):
+        # gated() reads global_step but mentions "not_modified": clean —
+        # and core keys like worker_id never need a gate.
+        src = _src(tmp_path, "pkg/comms/client.py", self.CLIENT)
+        assert not [f for f in capability.run([src])
+                    if f.symbol.startswith("gated:")]
+
+    def test_wire_py_and_non_comms_excluded(self, tmp_path):
+        # wire.py's `meta` is the per-tensor frame table; outside comms/
+        # the receiver names mean nothing.
+        wire = _src(tmp_path, "pkg/comms/wire.py", self.CLIENT)
+        elsewhere = _src(tmp_path, "pkg/ps/store2.py", self.CLIENT)
+        assert capability.run([wire, elsewhere]) == []
+
+    def test_membership_test_and_store_are_not_reads(self, tmp_path):
+        src = _src(tmp_path, "pkg/comms/service2.py", """\
+            def build(meta):
+                meta["qscales"] = [1.0]
+                return "directives" in meta
+            """)
+        assert capability.run([src]) == []
+
+
+# -- rule: jax-side-effect ---------------------------------------------------
+
+class TestJaxPitfalls:
+    def test_side_effects_in_compiled_functions_flagged(self, tmp_path):
+        src = _src(tmp_path, "pkg/parallel/step.py", """\
+            import time
+
+            import jax
+
+            @jax.jit
+            def step(x):
+                print(x)
+                return x + 1
+
+            def helper(x):
+                t0 = time.time()
+                return x, t0
+
+            compiled = jax.jit(helper)
+            """)
+        found = jax_pitfalls.run([src])
+        assert {(f.rule, f.symbol) for f in found} == {
+            ("jax-side-effect", "step"),
+            ("jax-side-effect", "helper"),
+        }
+        msgs = " | ".join(f.message for f in found)
+        assert "jax.debug.print" in msgs
+        assert "time.time()" in msgs
+
+    def test_eager_functions_and_sanctioned_debug_clean(self, tmp_path):
+        src = _src(tmp_path, "pkg/parallel/step.py", """\
+            import jax
+
+            def eager(x):
+                print(x)
+                return x
+
+            @jax.jit
+            def step(x):
+                jax.debug.print("x={}", x)
+                return x.at[0].set(1.0)
+            """)
+        assert jax_pitfalls.run([src]) == []
+
+    def test_scope_is_compute_dirs_only(self, tmp_path):
+        src = _src(tmp_path, "pkg/comms/helper2.py", """\
+            import jax
+
+            @jax.jit
+            def step(x):
+                print(x)
+                return x
+            """)
+        assert jax_pitfalls.run([src]) == []
+
+
+# -- mechanics: suppression + baseline ---------------------------------------
+
+class TestSuppression:
+    def test_matching_inline_ignore_suppresses(self, tmp_path):
+        src = _src(tmp_path, "m.py", """\
+            import numpy as np
+
+            # dpslint: hot-path — fixture
+            def push(arr):
+                return np.copy(arr)  # dpslint: ignore[hot-path-alloc]
+            """)
+        live, suppressed = split_suppressed(hot_path.run([src]), [src])
+        assert live == []
+        assert [f.rule for f in suppressed] == ["hot-path-alloc"]
+
+    def test_ignore_for_a_different_rule_does_not(self, tmp_path):
+        src = _src(tmp_path, "m.py", """\
+            import numpy as np
+
+            # dpslint: hot-path — fixture
+            def push(arr):
+                return np.copy(arr)  # dpslint: ignore[meta-key]
+            """)
+        live, suppressed = split_suppressed(hot_path.run([src]), [src])
+        assert [f.rule for f in live] == ["hot-path-alloc"]
+        assert suppressed == []
+
+
+class TestBaseline:
+    ENTRY = {"rule": "thread-shared", "file": "pkg/m.py",
+             "symbol": "W.status",
+             "justification": "handshake via Event, reviewed in PR 10"}
+
+    def _write(self, tmp_path, data) -> Path:
+        p = tmp_path / "baseline.json"
+        p.write_text(json.dumps(data))
+        return p
+
+    def test_missing_file_is_empty(self, tmp_path):
+        assert load_baseline(tmp_path / "nope.json") == []
+
+    def test_valid_entry_round_trips(self, tmp_path):
+        assert load_baseline(self._write(tmp_path, [self.ENTRY])) == \
+            [self.ENTRY]
+
+    @pytest.mark.parametrize("mutate", [
+        lambda e: e.update(justification="too short"),
+        lambda e: e.pop("justification"),
+        lambda e: e.update(rule="no-such-rule"),
+        lambda e: e.update(symbol=""),
+    ])
+    def test_malformed_entries_fail_loudly(self, tmp_path, mutate):
+        entry = dict(self.ENTRY)
+        mutate(entry)
+        with pytest.raises(BaselineError):
+            load_baseline(self._write(tmp_path, [entry]))
+
+    def test_non_list_fails_loudly(self, tmp_path):
+        with pytest.raises(BaselineError):
+            load_baseline(self._write(tmp_path, {"rule": "x"}))
+
+    def test_matching_survives_line_drift_and_stale_surfaces(self):
+        drifted = Finding("thread-shared", "pkg/m.py", 999, "W.status",
+                          "moved 900 lines down, same symbol")
+        other = Finding("thread-shared", "pkg/m.py", 7, "W.other",
+                        "not in the register")
+        stale_entry = {**self.ENTRY, "symbol": "W.gone"}
+        live, baselined, stale = apply_baseline(
+            [drifted, other], [self.ENTRY, stale_entry])
+        assert [f.symbol for f in live] == ["W.other"]
+        assert [f.symbol for f in baselined] == ["W.status"]
+        assert [e["symbol"] for e in stale] == ["W.gone"]
+
+
+# -- e2e: the tier-1 gate ----------------------------------------------------
+
+@pytest.fixture(scope="module")
+def repo_result() -> dict:
+    return run_lint(REPO)
+
+
+class TestEndToEnd:
+    def test_repo_is_clean(self, repo_result):
+        """THE gate: zero non-baselined findings over the real package
+        (scripts/lint.sh enforces the same via the CLI)."""
+        assert repo_result["live"] == [], "\n".join(
+            f.render() for f in repo_result["live"])
+        assert repo_result["stale_baseline"] == []
+        assert repo_result["exit_code"] == 0
+        assert repo_result["files_scanned"] > 50
+
+    def test_runtime_budget(self, repo_result):
+        """bench.py's lint_probe records lint_runtime_s and its docstring
+        promises this pin: the analyzer must stay cheap enough to sit
+        inside tier-1."""
+        assert repo_result["runtime_s"] < 5.0
+
+    def test_checked_in_baseline_is_reviewed(self):
+        # load_baseline re-validates every justification; the register
+        # must also stay small enough to actually be a register.
+        entries = load_baseline(DEFAULT_BASELINE)
+        assert len(entries) <= 20
+        for e in entries:
+            assert len(e["justification"].strip()) >= 10
+
+    def test_cli_human_and_json_modes(self, capsys):
+        assert dpslint_main([]) == 0
+        human = capsys.readouterr().out
+        assert "dpslint:" in human and "files" in human
+        assert dpslint_main(["--json"]) == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["clean"] is True
+        assert report["findings"] == []
+
+    def test_cli_exit_1_on_stale_baseline(self, tmp_path, capsys):
+        entries = load_baseline(DEFAULT_BASELINE)
+        entries.append({
+            "rule": "thread-shared", "file": "pkg/gone.py",
+            "symbol": "Gone.field",
+            "justification": "matches nothing — must surface as stale"})
+        p = tmp_path / "baseline.json"
+        p.write_text(json.dumps(entries))
+        assert dpslint_main(["--baseline", str(p)]) == 1
+        assert "stale" in capsys.readouterr().out
+
+    def test_cli_exit_2_on_malformed_baseline(self, tmp_path, capsys):
+        p = tmp_path / "baseline.json"
+        p.write_text(json.dumps([{"rule": "thread-shared",
+                                  "file": "pkg/m.py", "symbol": "W.s",
+                                  "justification": "nope"}]))
+        assert dpslint_main(["--baseline", str(p)]) == 2
+        assert "error" in capsys.readouterr().err

@@ -1,0 +1,257 @@
+"""Regression tests for the races dpslint's lock-discipline pass surfaced.
+
+Each test pins ONE fixed true positive from the ISSUE 10 annotation sweep
+(docs/STATIC_ANALYSIS.md "Findings fixed in this PR"):
+
+1. ``_push_async`` computed staleness OUTSIDE ``_param_lock`` — a
+   concurrent apply could bump ``global_step`` between check and apply,
+   admitting (and under-down-weighting) a push already past the bound.
+2. ``last_seen`` stamps in fetch/push were bare dict writes racing the
+   reaper's iteration in ``expire_stale_workers``.
+3. ``ClusterMonitor.add_listener`` appended to ``_listeners`` unlocked
+   while ``evaluate`` iterated it (remediation attaches mid-flight).
+4. ``ParameterService._expire_tick``'s throttle stamp was an unlocked
+   read-modify-write: two handler threads passing the age check at once
+   ran duplicate expiry sweeps.
+5. ``WorkerSupervisor.stop`` snapshotted children while ``poll_once``
+   was mid-respawn: the fresh child missed the snapshot and leaked.
+
+The tests are deterministic: they block inside the critical section with
+events (never sleep-and-hope) or assert the lock discipline directly.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from distributed_parameter_server_for_ml_training_tpu.comms.service import (
+    ParameterService)
+from distributed_parameter_server_for_ml_training_tpu.ps.store import (
+    ParameterStore, StoreConfig)
+from distributed_parameter_server_for_ml_training_tpu.ps.supervisor import (
+    SupervisorConfig, WorkerSupervisor)
+from distributed_parameter_server_for_ml_training_tpu.telemetry import (
+    ClusterMonitor)
+
+
+def _async_store(**kw):
+    cfg = dict(mode="async", total_workers=2, push_codec="none")
+    cfg.update(kw)
+    return ParameterStore({"w": np.ones(4, np.float32)}, StoreConfig(**cfg))
+
+
+class TestPushAsyncStalenessUnderLock:
+    def test_concurrent_pushes_at_bound_zero_accept_exactly_one(self):
+        """Two pushes against the same fetched_step with staleness_bound=0:
+        whichever applies first bumps global_step, so the second is one
+        version stale and MUST be rejected. Pre-fix, both computed
+        staleness before either bumped and both were accepted."""
+        store = _async_store(staleness_bound=0)
+        original_apply = store._apply
+        first_inside = threading.Event()
+        release = threading.Event()
+        applies = []
+
+        def gated_apply(grads, lr, weight):
+            applies.append(weight)
+            if len(applies) == 1:
+                first_inside.set()
+                assert release.wait(5), "test deadlock: release never set"
+            return original_apply(grads, lr, weight)
+
+        store._apply = gated_apply
+        grads = {"w": np.zeros(4, np.float32)}
+        results = []
+
+        t1 = threading.Thread(
+            target=lambda: results.append(store.push(0, grads, 0)))
+        t1.start()
+        assert first_inside.wait(5), "first push never reached _apply"
+        # Second push races while the first holds _param_lock mid-apply.
+        t2 = threading.Thread(
+            target=lambda: results.append(store.push(1, grads, 0)))
+        t2.start()
+        release.set()
+        t1.join(5)
+        t2.join(5)
+        assert sorted(results) == [False, True]
+        assert store.global_step == 1
+        assert len(applies) == 1  # the stale push never reached _apply
+        assert store.stats.gradients_rejected == 1
+
+
+class _LockAssertingDict(dict):
+    """Dict whose writes assert a lock is held (lock-discipline probe)."""
+
+    def __init__(self, lock):
+        super().__init__()
+        self._probe_lock = lock
+        self.unlocked_writes = 0
+
+    def __setitem__(self, key, value):
+        if not self._probe_lock.locked():
+            self.unlocked_writes += 1
+        super().__setitem__(key, value)
+
+
+class TestLastSeenUnderRegistrationLock:
+    def test_fetch_and_push_stamp_under_lock(self):
+        store = _async_store(worker_timeout=100.0)
+        wid, _ = store.register_worker("w0")
+        probe = _LockAssertingDict(store._registration_lock)
+        probe.update(store.last_seen)
+        store.last_seen = probe
+
+        store.fetch(wid)
+        store.push(wid, {"w": np.zeros(4, np.float32)}, 0)
+        assert wid in store.last_seen
+        assert probe.unlocked_writes == 0, \
+            "last_seen written without _registration_lock held"
+
+
+class _LockAssertingList(list):
+    """List probe: records appends/iterations done without the lock."""
+
+    def __init__(self, lock):
+        super().__init__()
+        self._probe_lock = lock
+        self.unlocked_appends = 0
+        self.unlocked_iters = 0
+
+    def append(self, item):
+        if not self._probe_lock.locked():
+            self.unlocked_appends += 1
+        super().append(item)
+
+    def __iter__(self):
+        if not self._probe_lock.locked():
+            self.unlocked_iters += 1
+        return super().__iter__()
+
+
+class TestListenerRegistrationUnderMonitorLock:
+    def test_add_listener_and_evaluate_snapshot_hold_the_lock(self):
+        store = _async_store()
+        mon = ClusterMonitor(store)
+        probe = _LockAssertingList(mon._lock)
+        mon._listeners = probe
+
+        seen = []
+
+        def listener(events):
+            # Callbacks run on the SNAPSHOT, outside the monitor lock —
+            # a listener may re-enter add_listener without deadlocking.
+            mon.add_listener(lambda evs: None)
+            seen.extend(events)
+
+        mon.add_listener(listener)
+        wid, _ = store.register_worker("w0")
+        mon.ingest(wid, {"step": 1, "loss": None, "loss_finite": False})
+        mon.evaluate()
+
+        assert [ev["rule"] for ev in seen] == ["nonfinite_loss"]
+        assert probe.unlocked_appends == 0, \
+            "add_listener appended without the monitor lock"
+        assert probe.unlocked_iters == 0, \
+            "evaluate snapshotted listeners without the monitor lock"
+
+
+class TestExpireTickThrottleAtomicity:
+    def test_contended_ticks_run_exactly_one_sweep(self):
+        """N handler threads hit the throttle at once: the check+stamp is
+        atomic under _expire_lock, so exactly one runs the sweep. Pre-fix
+        every thread that read the old stamp before the first wrote it ran
+        its own duplicate sweep."""
+        store = _async_store(worker_timeout=100.0)
+        svc = ParameterService(store)
+        sweeps = []
+        store.expire_stale_workers = lambda: (sweeps.append(1), [])[1]
+
+        n = 8
+        barrier = threading.Barrier(n)
+
+        def tick():
+            barrier.wait()
+            svc._expire_tick()
+
+        threads = [threading.Thread(target=tick) for _ in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(5)
+        assert len(sweeps) == 1
+        # Inside the throttle window, later ticks stay quiet too.
+        svc._expire_tick()
+        assert len(sweeps) == 1
+
+
+class _FakeProc:
+    def __init__(self, pid):
+        self.pid = pid
+        self.rc = None
+        self.terminated = False
+
+    def poll(self):
+        return self.rc
+
+    def terminate(self):
+        self.terminated = True
+        self.rc = -15
+
+    def kill(self):
+        self.rc = -9
+
+    def wait(self, timeout=None):
+        if self.rc is None:
+            self.rc = -15
+        return self.rc
+
+
+class TestSupervisorStopRespawnRace:
+    def test_child_spawned_mid_pass_is_terminated_by_stop(self):
+        """stop() called while a supervision pass is mid-respawn: the
+        snapshot must wait for the pass (slots lock) and terminate the
+        fresh child. Pre-fix the snapshot ran between the reap and the
+        respawn, and the new child was never terminated — a leaked
+        worker process outliving its supervisor."""
+        now = [1000.0]
+        procs = []
+        respawn_entered = threading.Event()
+        release_respawn = threading.Event()
+
+        def spawn(argv, env):
+            p = _FakeProc(pid=100 + len(procs))
+            procs.append(p)
+            if len(procs) == 2:  # the respawn: stall inside the pass
+                respawn_entered.set()
+                assert release_respawn.wait(5), "test deadlock"
+            return p
+
+        sup = WorkerSupervisor(
+            lambda slot, attempt: ["worker-cmd"], 1,
+            SupervisorConfig(backoff_initial=0.0, healthy_after=0.0,
+                             graceful_timeout=0.5),
+            clock=lambda: now[0], spawn=spawn,
+            log=lambda *a, **k: None)
+        sup.start()
+        procs[0].rc = 1  # child died; rc nonzero => respawn path
+        sup.poll_once()  # reap + schedule the (zero-backoff) respawn
+
+        passer = threading.Thread(target=sup.poll_once)
+        passer.start()
+        assert respawn_entered.wait(5), "respawn never started"
+        stopper = threading.Thread(target=sup.stop)
+        stopper.start()
+        # Let stop() reach the slots lock while the pass holds it.
+        stopper.join(0.2)
+        assert stopper.is_alive(), \
+            "stop() finished while a pass was mid-respawn"
+        release_respawn.set()
+        passer.join(5)
+        stopper.join(5)
+        assert not stopper.is_alive()
+        assert len(procs) == 2
+        assert procs[1].terminated, \
+            "child spawned mid-pass leaked past stop()"

@@ -1,0 +1,13 @@
+"""dpslint: framework-aware static analysis for the DPS package.
+
+Five stdlib-``ast`` passes over the whole package (no jax import, no
+third-party deps — runs in the offline build environment inside
+tier-1): lock discipline, hot-path allocations, capability gating, JAX
+side-effect pitfalls, and catalog<->doc drift. See
+docs/STATIC_ANALYSIS.md for the rule catalog and suppression syntax.
+
+Run as ``python -m tools.dpslint`` or ``cli lint``.
+"""
+
+from .core import RULE_CATALOG, Finding  # noqa: F401  (public API)
+from .cli import main, run_lint  # noqa: F401

@@ -1,0 +1,178 @@
+"""Capability-gating pass (rules ``meta-key``, ``cap-gate``).
+
+The wire's envelope meta is the negotiation surface: every optional
+feature (delta fetch, trace context, health reports, compressed-domain
+scales, directives, shard maps) rides it, and the degradation discipline
+— either peer missing a capability degrades to the legacy wire — only
+holds if every key is (a) cataloged and (b) read behind its gate.
+
+:data:`META_KEY_CATALOG` pins the full set of envelope-meta keys READ
+anywhere in ``comms/`` (docs/WIRE_PROTOCOL.md carries the same table,
+pinned both directions by the doc-drift pass). Each key maps to a tuple
+of *gate tokens*: identifiers the enclosing function must reference
+(as a name, attribute, or string) for the read to count as gated. An
+empty tuple means the key is part of the core protocol (registration
+negotiation, push/fetch core fields) and needs no gate.
+
+Rules:
+
+- ``meta-key``: a read of an uncataloged key on an envelope receiver —
+  a new wire field skipped the catalog (and therefore the doc table and
+  the gating review).
+- ``cap-gate``: a read of a gated key in a function that references none
+  of its gate tokens — the degradation discipline was skipped.
+
+Only READS count: ``meta.get("k")`` calls and ``meta["k"]`` subscript
+loads on receivers named ``meta`` / ``rmeta`` / ``reply`` /
+``reply_meta``. Stores (``meta["k"] = v``) are the SEND side — building
+an envelope is how capabilities are exercised, not where gating is
+checked — and ``"k" in meta`` membership tests are themselves the
+presence-gate idiom. ``comms/wire.py`` is excluded: its ``meta`` is the
+per-tensor frame table (dtype/shape/name), a different namespace below
+the envelope.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceFile
+
+#: Envelope-meta key -> gate tokens (ANY one referenced in the enclosing
+#: function satisfies the gate; empty tuple = ungated core field).
+#: docs/WIRE_PROTOCOL.md's "Envelope meta keys" table is pinned to the
+#: KEYS of this dict in both directions by the doc-drift pass.
+META_KEY_CATALOG: dict[str, tuple[str, ...]] = {
+    # -- registration negotiation (server -> client, register reply) ----
+    "worker_id": (),
+    "total_workers": (),
+    "push_codec": (),
+    "fetch_codec": (),
+    "delta_fetch": (),
+    "trace_context": (),
+    "health_report": (),
+    "compressed_domain": (),
+    "elastic": (),
+    "mode": (),
+    "learning_rate": (),
+    "staleness_bound": (),
+    # -- client -> server request fields --------------------------------
+    "worker_name": (),
+    "capabilities": (),
+    "fetched_step": (),
+    "push_token": (),
+    "have_step": (),
+    "have_qscales": (),
+    "have_shard_map": (),
+    "directives_ack": (),
+    # piggybacked worker health report: the server only ingests it when
+    # it runs a cluster monitor (fetch/heartbeat path) or when nonfinite
+    # rejection is on (push path).
+    "health": ("monitor", "reject_nonfinite"),
+    # replica announce riding fetch meta: only meaningful on a sharded
+    # primary (ShardingState present).
+    "replica": ("sharding",),
+    # trace context on the envelope: attached/read only when tracing is
+    # enabled end to end.
+    "trace": ("trace_enabled", "supports_trace_context"),
+    # -- reply piggyback (server -> client, fetch/push reply meta) ------
+    "accepted": (),
+    "not_modified": (),
+    # global_step on a fetch reply is only trustworthy after the
+    # not_modified branch was considered — a NOT_MODIFIED reply carries
+    # no payload and the step echoes have_step.
+    "global_step": ("not_modified",),
+    "active_workers": (),
+    # directive stream: the client must have advertised (and the server
+    # echoed) the capability before adopting directives off reply meta.
+    "directives": ("supports_directives",),
+    # shared-scale table: compressed-domain capability gates adoption.
+    "qscales": ("supports_compressed_domain",),
+    "qscale_step": ("supports_compressed_domain",),
+    # shard map: presence IS the capability (docs/SHARDING.md) — an
+    # unsharded server never attaches one.
+    "shard_map": (),
+}
+
+#: Variable names treated as envelope-meta receivers in comms/.
+_RECEIVERS = {"meta", "rmeta", "reply", "reply_meta"}
+
+
+def _read_sites(tree: ast.AST) -> list[tuple[str, int, ast.AST]]:
+    """(key, line, node) for every envelope-meta READ in the module."""
+    sites = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (isinstance(f, ast.Attribute) and f.attr == "get"
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in _RECEIVERS
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                sites.append((node.args[0].value, node.lineno, node))
+        elif isinstance(node, ast.Subscript):
+            if (isinstance(node.ctx, ast.Load)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in _RECEIVERS
+                    and isinstance(node.slice, ast.Constant)
+                    and isinstance(node.slice.value, str)):
+                sites.append((node.slice.value, node.lineno, node))
+    return sites
+
+
+def _enclosing_functions(tree: ast.AST) -> dict[ast.AST, ast.FunctionDef]:
+    """node -> nearest enclosing function def, for every node."""
+    owner: dict[ast.AST, ast.FunctionDef] = {}
+
+    def walk(node: ast.AST, fn) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn = node
+        for child in ast.iter_child_nodes(node):
+            owner[child] = fn
+            walk(child, fn)
+
+    walk(tree, None)
+    return owner
+
+
+def _references(fn: ast.AST, tokens: tuple[str, ...]) -> bool:
+    """Does ``fn`` mention any gate token as a name/attribute/string?"""
+    want = set(tokens)
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and node.id in want:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in want:
+            return True
+        if isinstance(node, ast.Constant) \
+                and isinstance(node.value, str) and node.value in want:
+            return True
+    return False
+
+
+def run(sources: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in sources:
+        parts = src.rel.split("/")
+        if "comms" not in parts or parts[-1] == "wire.py":
+            continue
+        owner = _enclosing_functions(src.tree)
+        for key, line, node in _read_sites(src.tree):
+            fn = owner.get(node)
+            where = fn.name if fn is not None else "<module>"
+            if key not in META_KEY_CATALOG:
+                findings.append(Finding(
+                    "meta-key", src.rel, line, f"{where}:{key}",
+                    f"envelope-meta key {key!r} read in {where}() is not "
+                    f"in META_KEY_CATALOG — catalog it (with its gate) "
+                    f"before putting it on the wire"))
+                continue
+            gates = META_KEY_CATALOG[key]
+            if gates and (fn is None or not _references(fn, gates)):
+                findings.append(Finding(
+                    "cap-gate", src.rel, line, f"{where}:{key}",
+                    f"gated envelope-meta key {key!r} read in {where}() "
+                    f"which references none of its gate tokens "
+                    f"{sorted(gates)} — the capability degradation "
+                    f"discipline was skipped"))
+    return findings

@@ -1,0 +1,119 @@
+"""dpslint entry point: ``python -m tools.dpslint`` (and ``cli lint``).
+
+Exit codes:
+
+- ``0`` — no live findings (inline-suppressed and baselined ones are
+  reported as counts but don't fail the run);
+- ``1`` — live findings, or stale baseline entries (the debt register
+  may only shrink: an entry matching nothing must be deleted);
+- ``2`` — the analyzer itself failed (unparseable source, malformed
+  baseline) — loud, never a silent pass.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+from . import capability, catalog_drift, hot_path, jax_pitfalls, \
+    lock_discipline
+from .core import (BaselineError, apply_baseline, load_baseline,
+                   load_sources, split_suppressed)
+
+#: Repo root (tools/dpslint/cli.py -> tools/dpslint -> tools -> root).
+REPO_ROOT = Path(__file__).resolve().parents[2]
+PACKAGE = "distributed_parameter_server_for_ml_training_tpu"
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+_PASSES = (lock_discipline.run, hot_path.run, capability.run,
+           jax_pitfalls.run)
+
+
+def run_lint(root: Path | None = None,
+             baseline_path: Path | None = None) -> dict:
+    """Run every pass; returns the full result dict the CLI renders.
+
+    ``exit_code`` in the result follows the module contract above.
+    Importable (tests, bench.py, cli lint) so every consumer shares one
+    definition of "clean".
+    """
+    root = Path(root) if root is not None else REPO_ROOT
+    baseline_path = (Path(baseline_path) if baseline_path is not None
+                     else DEFAULT_BASELINE)
+    t0 = time.perf_counter()
+    sources = load_sources(root / PACKAGE, root)
+    findings = []
+    for run_pass in _PASSES:
+        findings.extend(run_pass(sources))
+    findings.extend(catalog_drift.run(sources, root))
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    live, suppressed = split_suppressed(findings, sources)
+    baseline = load_baseline(baseline_path)
+    live, baselined, stale = apply_baseline(live, baseline)
+    return {
+        "live": live,
+        "suppressed": suppressed,
+        "baselined": baselined,
+        "stale_baseline": stale,
+        "files_scanned": len(sources),
+        "runtime_s": round(time.perf_counter() - t0, 3),
+        "exit_code": 1 if (live or stale) else 0,
+    }
+
+
+def _render_human(result: dict, out) -> None:
+    for f in result["live"]:
+        print(f.render(), file=out)
+    for entry in result["stale_baseline"]:
+        print(f"{entry['file']}: [baseline] stale entry "
+              f"({entry['rule']} {entry['symbol']}) matches nothing — "
+              f"delete it", file=out)
+    n = len(result["live"])
+    print(f"dpslint: {n} finding{'s' if n != 1 else ''} "
+          f"({len(result['baselined'])} baselined, "
+          f"{len(result['suppressed'])} suppressed, "
+          f"{len(result['stale_baseline'])} stale baseline) across "
+          f"{result['files_scanned']} files in "
+          f"{result['runtime_s']}s", file=out)
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="dpslint",
+        description="Framework-aware static analysis for the DPS "
+                    "package (lock discipline, hot-path allocations, "
+                    "capability gating, JAX pitfalls, catalog drift).")
+    parser.add_argument("--root", type=Path, default=None,
+                        help="repo root (default: auto-detected)")
+    parser.add_argument("--baseline", type=Path, default=None,
+                        help=f"baseline file (default: "
+                             f"{DEFAULT_BASELINE.name} next to the tool)")
+    parser.add_argument("--json", action="store_true",
+                        help="emit a JSON report instead of human lines")
+    args = parser.parse_args(argv)
+    try:
+        result = run_lint(args.root, args.baseline)
+    except (BaselineError, SyntaxError, OSError, LookupError) as e:
+        print(f"dpslint: error: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        json.dump({
+            "findings": [f.to_json() for f in result["live"]],
+            "baselined": [f.to_json() for f in result["baselined"]],
+            "suppressed": [f.to_json() for f in result["suppressed"]],
+            "stale_baseline": result["stale_baseline"],
+            "files_scanned": result["files_scanned"],
+            "runtime_s": result["runtime_s"],
+            "clean": result["exit_code"] == 0,
+        }, sys.stdout, indent=2)
+        print()
+    else:
+        _render_human(result, sys.stdout)
+    return result["exit_code"]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

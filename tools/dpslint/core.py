@@ -1,0 +1,212 @@
+"""dpslint core: finding model, rule catalog, suppressions, baseline.
+
+The analyzer is stdlib-only (``ast`` + ``tokenize``): it must run in the
+offline build environment where neither ruff nor jax is guaranteed, and
+it must stay cheap enough to sit inside tier-1. Every rule lives in
+:data:`RULE_CATALOG` — the single source of truth docs/STATIC_ANALYSIS.md
+is pinned against (both directions, by the ``doc-drift`` pass itself).
+
+Suppression model, two tiers:
+
+- inline: ``# dpslint: ignore[rule]`` (comma list allowed) on the finding
+  line silences exactly those rules there — for accepted one-off
+  exceptions whose justification fits in the surrounding code comment;
+- baseline: ``tools/dpslint/baseline.json`` entries match findings by
+  ``(rule, file, symbol)`` — line numbers drift, symbols don't — and every
+  entry MUST carry a non-empty ``justification`` string: a baseline is a
+  reviewed debt register, not a mute button. Stale entries (matching
+  nothing) are reported so the register can only shrink.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import re
+import tokenize
+from dataclasses import dataclass
+from pathlib import Path
+
+#: rule id -> (severity, one-line rationale). docs/STATIC_ANALYSIS.md's
+#: rule table is pinned to this dict in both directions by the doc-drift
+#: pass (and tests/test_docs_drift.py).
+RULE_CATALOG = {
+    "lock-guard": (
+        "error", "a field declared `# guarded by: self._<lock>` is read "
+                 "or written outside a `with` block on that lock"),
+    "thread-shared": (
+        "warning", "an attribute is written from a threading.Thread/Timer "
+                   "target and touched by another method with no declared "
+                   "guard — an undeclared cross-thread contract"),
+    "hot-path-alloc": (
+        "error", "a `# dpslint: hot-path` function calls np.copy / "
+                 ".tobytes() / .astype without copy=False / np.array — "
+                 "allocations the zero-copy wire discipline forbids"),
+    "meta-key": (
+        "error", "an envelope-meta key read in comms/ is missing from "
+                 "META_KEY_CATALOG — new wire fields must be cataloged "
+                 "with their capability gate"),
+    "cap-gate": (
+        "error", "a capability-gated envelope-meta key is read in a "
+                 "function that never references its gate — the "
+                 "degradation discipline was skipped"),
+    "jax-side-effect": (
+        "error", "a side-effecting call (print / time.* / metric "
+                 "inc/observe / flight-recorder write) inside a "
+                 "jit/pjit/shard_map-compiled function runs at trace "
+                 "time, not per step"),
+    "doc-drift": (
+        "error", "a pinned catalog (metrics, spans, health rules, codecs, "
+                 "directives, actions, shard-map fields, lint rules) "
+                 "disagrees with its documentation"),
+}
+
+#: Annotation comment declaring a field's guard:  # guarded by: self._lock
+GUARD_RE = re.compile(r"#\s*guarded by:\s*(?:self\.)?(\w+)")
+
+#: Hot-path marker comment (same line as the def or the line above).
+HOT_PATH_RE = re.compile(r"#\s*dpslint:\s*hot-path\b")
+
+#: Inline suppression:  # dpslint: ignore[rule-a, rule-b]
+IGNORE_RE = re.compile(r"#\s*dpslint:\s*ignore\[([a-z\-,\s]+)\]")
+
+
+@dataclass
+class Finding:
+    """One diagnostic: rule id + location + a stable baseline anchor."""
+
+    rule: str
+    file: str      # repo-relative, '/'-separated
+    line: int
+    symbol: str    # e.g. 'Class.method.attr' — stable across line drift
+    message: str
+
+    @property
+    def severity(self) -> str:
+        return RULE_CATALOG[self.rule][0]
+
+    def key(self) -> tuple:
+        return (self.rule, self.file, self.symbol)
+
+    def render(self) -> str:
+        return (f"{self.file}:{self.line}: [{self.rule}] "
+                f"{self.severity}: {self.message}")
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "severity": self.severity,
+                "file": self.file, "line": self.line,
+                "symbol": self.symbol, "message": self.message}
+
+
+class SourceFile:
+    """One parsed module: AST + per-line comment map (tokenize, so
+    string literals containing '#' can't fake an annotation)."""
+
+    def __init__(self, path: Path, root: Path):
+        self.path = path
+        self.rel = path.relative_to(root).as_posix()
+        self.text = path.read_text()
+        self.tree = ast.parse(self.text, filename=str(path))
+        self.lines = self.text.splitlines()
+        self.comments: dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(
+                    io.StringIO(self.text).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string
+        except tokenize.TokenError:  # pragma: no cover - parse succeeded
+            pass
+
+    def comment_at(self, line: int) -> str:
+        return self.comments.get(line, "")
+
+    def own_line_comment(self, line: int) -> str:
+        """The comment at ``line`` ONLY if the line holds nothing else.
+        Annotations that accept a comment "on the line above" must use
+        this: a trailing comment up there belongs to THAT line's code
+        (e.g. a guard annotation on the previous field's assignment),
+        not to the statement below."""
+        if 1 <= line <= len(self.lines) \
+                and self.lines[line - 1].lstrip().startswith("#"):
+            return self.comments.get(line, "")
+        return ""
+
+    def suppressed_rules(self, line: int) -> set[str]:
+        m = IGNORE_RE.search(self.comment_at(line))
+        if not m:
+            return set()
+        return {r.strip() for r in m.group(1).split(",") if r.strip()}
+
+
+def load_sources(pkg_dir: Path, root: Path) -> list[SourceFile]:
+    out = []
+    for path in sorted(pkg_dir.rglob("*.py")):
+        if "__pycache__" in path.parts:
+            continue
+        out.append(SourceFile(path, root))
+    return out
+
+
+# -- baseline ----------------------------------------------------------------
+
+class BaselineError(ValueError):
+    """The baseline file itself is malformed (treated as exit code 2:
+    a broken debt register must fail loudly, not silently match)."""
+
+
+def load_baseline(path: Path) -> list[dict]:
+    if not path.exists():
+        return []
+    data = json.loads(path.read_text())
+    if not isinstance(data, list):
+        raise BaselineError(f"{path}: baseline must be a JSON list")
+    for i, entry in enumerate(data):
+        if not isinstance(entry, dict):
+            raise BaselineError(f"{path}: entry {i} is not an object")
+        for field in ("rule", "file", "symbol"):
+            if not isinstance(entry.get(field), str) or not entry[field]:
+                raise BaselineError(
+                    f"{path}: entry {i} missing {field!r}")
+        if entry["rule"] not in RULE_CATALOG:
+            raise BaselineError(
+                f"{path}: entry {i} names unknown rule "
+                f"{entry['rule']!r}")
+        just = entry.get("justification")
+        if not isinstance(just, str) or len(just.strip()) < 10:
+            raise BaselineError(
+                f"{path}: entry {i} ({entry['rule']} {entry['symbol']}) "
+                f"needs a real justification string (>= 10 chars) — a "
+                f"baseline is a reviewed register, not a mute button")
+    return data
+
+
+def apply_baseline(findings: list[Finding], baseline: list[dict]
+                   ) -> tuple[list[Finding], list[Finding], list[dict]]:
+    """-> (live, baselined, stale_entries). Matching is by
+    (rule, file, symbol) so findings survive line drift."""
+    index = {(e["rule"], e["file"], e["symbol"]): e for e in baseline}
+    live, matched = [], []
+    used = set()
+    for f in findings:
+        if f.key() in index:
+            matched.append(f)
+            used.add(f.key())
+        else:
+            live.append(f)
+    stale = [e for k, e in index.items() if k not in used]
+    return live, matched, stale
+
+
+def split_suppressed(findings: list[Finding], sources: list[SourceFile]
+                     ) -> tuple[list[Finding], list[Finding]]:
+    """Drop findings whose line carries a matching inline ignore."""
+    by_rel = {s.rel: s for s in sources}
+    live, suppressed = [], []
+    for f in findings:
+        src = by_rel.get(f.file)
+        if src is not None and f.rule in src.suppressed_rules(f.line):
+            suppressed.append(f)
+        else:
+            live.append(f)
+    return live, suppressed

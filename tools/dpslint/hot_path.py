@@ -1,0 +1,93 @@
+"""Hot-path allocation guard (rule ``hot-path-alloc``).
+
+Generalizes the zero-copy copy-count test (``tests/test_wire_zero_copy.py``
+pins the wire codec's at-most-one-copy invariant at runtime) into a static
+rule over every function marked ``# dpslint: hot-path`` — the wire codec,
+store push/fetch, replica serve, and NM-reply cache paths, where a stray
+whole-tensor copy silently doubles the host-side cost THC identifies as
+the post-codec bottleneck.
+
+Inside a marked function (marker on the ``def`` line or the line above),
+these calls are findings:
+
+- ``np.copy(...)`` and ``<x>.tobytes()`` — always a full copy;
+- ``<x>.astype(...)`` without ``copy=False`` — numpy copies by default
+  even for a same-dtype cast;
+- ``np.array(...)`` — copies existing arrays; ``np.asarray`` /
+  ``np.frombuffer`` are the no-copy spellings.
+
+The marker is opt-in per function: the rule is a contract for paths whose
+budget is "one copy per tensor or less", not a global style ban.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import HOT_PATH_RE, Finding, SourceFile
+
+_NP_NAMES = {"np", "numpy"}
+
+
+def _is_hot(src: SourceFile, node: ast.FunctionDef) -> bool:
+    deco_top = min((d.lineno for d in node.decorator_list),
+                   default=node.lineno)
+    return bool(HOT_PATH_RE.search(src.comment_at(node.lineno))
+                or HOT_PATH_RE.search(src.own_line_comment(deco_top - 1)))
+
+
+def _violation(node: ast.Call) -> str | None:
+    f = node.func
+    if isinstance(f, ast.Attribute):
+        if isinstance(f.value, ast.Name) and f.value.id in _NP_NAMES:
+            if f.attr == "copy":
+                return "np.copy() buffers a full copy"
+            if f.attr == "array":
+                return ("np.array() copies existing arrays — use "
+                        "np.asarray/np.frombuffer")
+        if f.attr == "tobytes":
+            return ".tobytes() copies the whole buffer"
+        if f.attr == "astype":
+            for kw in node.keywords:
+                if kw.arg == "copy" \
+                        and isinstance(kw.value, ast.Constant) \
+                        and kw.value.value is False:
+                    return None
+            return (".astype() without copy=False copies even on a "
+                    "same-dtype cast")
+    return None
+
+
+def run(sources: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in sources:
+        hot: list[tuple[str, ast.FunctionDef]] = []
+        parents = {src.tree: None}
+
+        def qualname(fn: ast.AST) -> str:
+            parts = []
+            cur = fn
+            while cur is not None and not isinstance(cur, ast.Module):
+                if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.ClassDef)):
+                    parts.append(cur.name)
+                cur = parents.get(cur)
+            return ".".join(reversed(parts))
+
+        for node in ast.walk(src.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and _is_hot(src, node):
+                hot.append((qualname(node), node))
+        for qual, fn in hot:
+            for sub in ast.walk(fn):
+                if not isinstance(sub, ast.Call):
+                    continue
+                why = _violation(sub)
+                if why is not None:
+                    findings.append(Finding(
+                        "hot-path-alloc", src.rel, sub.lineno,
+                        f"{qual}", f"hot-path {qual}(): {why}"))
+    return findings

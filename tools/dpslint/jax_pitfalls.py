@@ -1,0 +1,118 @@
+"""JAX-pitfall pass (rule ``jax-side-effect``).
+
+A call with Python-level side effects inside a ``jit``/``pjit``/
+``shard_map``-compiled function runs ONCE at trace time, then never
+again — a metrics counter bumped there records one increment per
+recompile instead of one per step, a ``print`` shows tracer reprs, and
+``time.*`` measures tracing, not execution. The classic symptom is a
+counter that works in eager tests and silently flatlines under jit.
+
+Detection, scoped to ``parallel/``, ``train/``, ``ops/``:
+
+- compiled functions: decorated ``@jax.jit`` / ``@jit`` / ``@pjit`` /
+  ``@partial(jax.jit, ...)`` / ``@shard_map(...)``, plus any local
+  ``def f`` later passed by name to ``jax.jit(f)`` / ``pjit(f)`` /
+  ``shard_map(f, ...)`` anywhere in the module;
+- side effects inside them: ``print(...)``, any ``time.<attr>(...)``
+  call, ``trace_span``/``get_recorder`` (flight-recorder writes),
+  ``.inc(...)`` / ``.observe(...)`` method calls (registry instruments),
+  and ``.set(...)`` only on ``_tm*``-named receivers (so JAX's
+  functional ``x.at[i].set(v)`` never matches).
+
+``jax.debug.print`` / ``jax.debug.callback`` / ``io_callback`` are the
+sanctioned spellings and are not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceFile
+
+_JIT_NAMES = {"jit", "pjit"}
+_WRAP_NAMES = _JIT_NAMES | {"shard_map"}
+_RECORDER_CALLS = {"trace_span", "get_recorder"}
+_METRIC_METHODS = {"inc", "observe"}
+_SCOPE_DIRS = {"parallel", "train", "ops"}
+
+
+def _tail(node: ast.AST) -> str:
+    """Trailing identifier of a Name/Attribute chain ('' otherwise)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return ""
+
+
+def _is_jit_decorator(deco: ast.AST) -> bool:
+    if _tail(deco) in _WRAP_NAMES:            # @jax.jit / @jit / @pjit
+        return True
+    if isinstance(deco, ast.Call):
+        if _tail(deco.func) in _WRAP_NAMES:   # @shard_map(...) / @jit(...)
+            return True
+        if _tail(deco.func) == "partial" and deco.args \
+                and _tail(deco.args[0]) in _WRAP_NAMES:
+            return True                       # @partial(jax.jit, ...)
+    return False
+
+
+def _wrapped_names(tree: ast.AST) -> set[str]:
+    """Local function names passed BY NAME to jit/pjit/shard_map calls
+    (``sharded = jax.jit(step_fn)`` / ``shard_map(step_fn, mesh, ...)``).
+    """
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _tail(node.func) in _WRAP_NAMES:
+            for arg in node.args[:1]:
+                if isinstance(arg, ast.Name):
+                    names.add(arg.id)
+    return names
+
+
+def _violation(node: ast.Call) -> str | None:
+    f = node.func
+    if isinstance(f, ast.Name) and f.id == "print":
+        return "print() runs at trace time (use jax.debug.print)"
+    if isinstance(f, ast.Attribute):
+        if isinstance(f.value, ast.Name) and f.value.id == "time":
+            return (f"time.{f.attr}() measures tracing, not execution "
+                    f"(time outside the compiled function)")
+        if f.attr in _METRIC_METHODS:
+            return (f".{f.attr}() on a registry instrument records once "
+                    f"per recompile, not per step")
+        if f.attr == "set" and isinstance(f.value, ast.Name) \
+                and f.value.id.startswith("_tm"):
+            return ".set() on a telemetry gauge records once per recompile"
+    if _tail(f) in _RECORDER_CALLS:
+        return (f"{_tail(f)}() writes the flight recorder at trace time "
+                f"(span durations would be tracing artifacts)")
+    return None
+
+
+def run(sources: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in sources:
+        parts = src.rel.split("/")
+        if not (set(parts[:-1]) & _SCOPE_DIRS):
+            continue
+        wrapped = _wrapped_names(src.tree)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            jitted = node.name in wrapped or any(
+                _is_jit_decorator(d) for d in node.decorator_list)
+            if not jitted:
+                continue
+            for sub in ast.walk(node):
+                # Nested defs still trace with the parent; walk them too.
+                if not isinstance(sub, ast.Call):
+                    continue
+                why = _violation(sub)
+                if why is not None:
+                    findings.append(Finding(
+                        "jax-side-effect", src.rel, sub.lineno,
+                        f"{node.name}",
+                        f"in compiled {node.name}(): {why}"))
+    return findings

@@ -1,0 +1,303 @@
+"""Lock-discipline race detector (rules ``lock-guard``, ``thread-shared``).
+
+Two complementary checks over every class in the package:
+
+1. **Declared guards** (``lock-guard``, error). A field annotated at its
+   assignment with ``# guarded by: self._lock`` (same line or the line
+   directly above) may only be touched — read OR written; a dict read
+   during another thread's resize is just as racy as a write — inside a
+   ``with`` block on that exact lock. Exemptions, both load-bearing
+   conventions of this codebase:
+
+   - ``__init__`` and ``_init*`` helpers (constructor-phase: no other
+     thread can hold a reference yet; ``ps/store.py``'s
+     ``_init_round_state`` et al), and
+   - methods whose name ends ``_locked`` (the caller holds the lock;
+     ``ps/store.py``'s ``_arm_deadline_locked`` et al).
+
+   Guards may be declared on a ``self.x = ...`` assignment in any
+   method, or on a class-body (ann-)assignment — mixins like
+   ``AggregationBase`` declare contracts for state their concrete
+   subclasses construct. Declarations inherit through MODULE-LOCAL base
+   classes (``ParameterStore`` is checked against ``AggregationBase``'s
+   contracts); a subclass in another module re-declares the inherited
+   contracts it touches.
+
+2. **Undeclared sharing** (``thread-shared``, warning). Any attribute
+   written outside ``__init__``/``start`` that is reachable both from a
+   ``threading.Thread``/``Timer`` entry point (``target=self.x``, the
+   ``Timer`` function argument, or ``run`` on a Thread subclass —
+   transitively through ``self.method()`` calls) and from a method no
+   thread entry reaches, with no declared guard. Attributes that ARE the
+   synchronization (locks, events, conditions), thread/timer handles, and
+   telemetry instruments (internally locked) are recognized by their
+   ``__init__`` assignment and skipped.
+
+``start`` is treated like ``__init__`` on the write side because this
+codebase's lifecycle convention is bind-then-spawn: ``start()`` fills
+fields (bound port, advertise address) strictly before the thread it
+starts can observe them.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import GUARD_RE, Finding, SourceFile
+
+#: Constructors whose result makes an attribute "synchronization, not
+#: state" for the thread-shared heuristic.
+_SYNC_TYPES = {"Lock", "RLock", "Event", "Condition", "Semaphore",
+               "BoundedSemaphore", "Barrier", "local", "Thread", "Timer",
+               "Queue", "deque"}
+
+#: Registry factory methods whose products carry their own locks.
+_INSTRUMENT_FACTORIES = {"counter", "gauge", "histogram"}
+
+#: Container methods that mutate their receiver: ``self.x.append(...)``
+#: is a write of ``self.x`` for race purposes.
+_MUTATORS = {"append", "appendleft", "add", "clear", "discard", "extend",
+             "insert", "pop", "popleft", "popitem", "remove", "setdefault",
+             "update"}
+
+_WRITE_EXEMPT = {"__init__", "start"}
+
+
+def _call_name(node: ast.Call) -> str:
+    """Trailing identifier of the called object: Thread, Timer, counter…"""
+    f = node.func
+    if isinstance(f, ast.Name):
+        return f.id
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    return ""
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+class _ClassInfo:
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        self.name = node.name
+        self.guards: dict[str, tuple[str, int]] = {}  # field -> (lock, ln)
+        self.sync_attrs: set[str] = set()
+        self.methods: dict[str, ast.FunctionDef] = {}
+        self.thread_entries: set[str] = set()
+        # method -> attr -> [lines], split by access kind
+        self.reads: dict[str, dict[str, list[int]]] = {}
+        self.writes: dict[str, dict[str, list[int]]] = {}
+        self.calls: dict[str, set[str]] = {}  # method -> self.m() callees
+
+
+def _collect_class(src: SourceFile, node: ast.ClassDef) -> _ClassInfo:
+    info = _ClassInfo(node)
+    # Class-body declarations: `x: T  # guarded by: self._lock` lets a
+    # mixin declare the contract for attributes its subclasses assign.
+    for item in node.body:
+        if isinstance(item, (ast.Assign, ast.AnnAssign)):
+            targets = item.targets if isinstance(item, ast.Assign) \
+                else [item.target]
+            names = [t.id for t in targets if isinstance(t, ast.Name)]
+            if not names:
+                continue
+            comment = src.comment_at(item.lineno) or \
+                src.own_line_comment(item.lineno - 1)
+            m = GUARD_RE.search(comment)
+            if m:
+                for name in names:
+                    info.guards[name] = (m.group(1), item.lineno)
+    for base in node.bases:
+        tail = base.attr if isinstance(base, ast.Attribute) else \
+            base.id if isinstance(base, ast.Name) else ""
+        if tail in ("Thread", "Timer"):
+            info.thread_entries.add("run")
+    for item in node.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info.methods[item.name] = item
+    for meth_name, meth in info.methods.items():
+        reads = info.reads.setdefault(meth_name, {})
+        writes = info.writes.setdefault(meth_name, {})
+        callees = info.calls.setdefault(meth_name, set())
+        for sub in ast.walk(meth):
+            if isinstance(sub, ast.Attribute):
+                attr = _self_attr(sub)
+                if attr is None:
+                    continue
+                bucket = writes if isinstance(
+                    sub.ctx, (ast.Store, ast.Del)) else reads
+                bucket.setdefault(attr, []).append(sub.lineno)
+            elif isinstance(sub, ast.Subscript) and isinstance(
+                    sub.ctx, (ast.Store, ast.Del)):
+                # self.d[k] = v rebinds an ITEM: a write of self.d for
+                # race purposes even though the attribute load is a read.
+                attr = _self_attr(sub.value)
+                if attr is not None:
+                    writes.setdefault(attr, []).append(sub.lineno)
+            elif isinstance(sub, ast.Call):
+                callee = _self_attr(sub.func)
+                if callee is not None:
+                    callees.add(callee)
+                if isinstance(sub.func, ast.Attribute) \
+                        and sub.func.attr in _MUTATORS:
+                    attr = _self_attr(sub.func.value)
+                    if attr is not None:
+                        writes.setdefault(attr, []).append(sub.lineno)
+                name = _call_name(sub)
+                if name in ("Thread", "Timer"):
+                    for kw in sub.keywords:
+                        if kw.arg in ("target", "function"):
+                            t = _self_attr(kw.value)
+                            if t:
+                                info.thread_entries.add(t)
+                    if name == "Timer" and len(sub.args) >= 2:
+                        t = _self_attr(sub.args[1])
+                        if t:
+                            info.thread_entries.add(t)
+            # Guard annotations + sync-typed attributes, from assignments.
+            if isinstance(sub, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = sub.targets if isinstance(sub, ast.Assign) \
+                    else [sub.target]
+                attrs = [a for a in (_self_attr(t) for t in targets) if a]
+                if not attrs:
+                    continue
+                comment = src.comment_at(sub.lineno) or \
+                    src.own_line_comment(sub.lineno - 1)
+                m = GUARD_RE.search(comment)
+                if m:
+                    for a in attrs:
+                        info.guards[a] = (m.group(1), sub.lineno)
+                value = getattr(sub, "value", None)
+                if isinstance(value, ast.Call):
+                    cname = _call_name(value)
+                    if cname in _SYNC_TYPES \
+                            or cname in _INSTRUMENT_FACTORIES:
+                        info.sync_attrs.update(attrs)
+    return info
+
+
+def _thread_reachable(info: _ClassInfo) -> set[str]:
+    seen = set(info.thread_entries & set(info.methods))
+    frontier = list(seen)
+    while frontier:
+        m = frontier.pop()
+        for callee in info.calls.get(m, ()):
+            if callee in info.methods and callee not in seen:
+                seen.add(callee)
+                frontier.append(callee)
+    return seen
+
+
+class _GuardChecker(ast.NodeVisitor):
+    """Walk one method tracking which ``with self.<lock>:`` blocks the
+    current node is lexically inside."""
+
+    def __init__(self, info: _ClassInfo, meth_name: str,
+                 src: SourceFile, out: list[Finding]):
+        self.info = info
+        self.meth = meth_name
+        self.src = src
+        self.out = out
+        self.held: list[str] = []
+
+    def visit_With(self, node: ast.With):
+        locks = []
+        for item in node.items:
+            attr = _self_attr(item.context_expr)
+            if attr is not None:
+                locks.append(attr)
+            self.visit(item.context_expr)
+            if item.optional_vars is not None:
+                self.visit(item.optional_vars)
+        self.held.extend(locks)
+        for stmt in node.body:
+            self.visit(stmt)
+        del self.held[len(self.held) - len(locks):]
+
+    def visit_Attribute(self, node: ast.Attribute):
+        attr = _self_attr(node)
+        if attr is not None and attr in self.info.guards:
+            lock = self.info.guards[attr][0]
+            if lock not in self.held:
+                verb = "written" if isinstance(
+                    node.ctx, (ast.Store, ast.Del)) else "read"
+                self.out.append(Finding(
+                    "lock-guard", self.src.rel, node.lineno,
+                    f"{self.info.name}.{self.meth}.{attr}",
+                    f"{self.info.name}.{attr} is declared guarded by "
+                    f"self.{lock} but is {verb} in {self.meth}() outside "
+                    f"a `with self.{lock}:` block"))
+        self.generic_visit(node)
+
+
+def _inherit_guards(infos_by_name: dict[str, _ClassInfo],
+                    info: _ClassInfo, seen: set[str]) -> dict:
+    """Base-class guard declarations, module-local only (an imported base
+    is invisible — its subclass re-declares what it touches)."""
+    out: dict = {}
+    for base in info.node.bases:
+        if isinstance(base, ast.Name) and base.id in infos_by_name \
+                and base.id not in seen:
+            seen.add(base.id)
+            out.update(_inherit_guards(
+                infos_by_name, infos_by_name[base.id], seen))
+    out.update(info.guards)
+    return out
+
+
+def run(sources: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    for src in sources:
+        infos: list[_ClassInfo] = []
+        by_name: dict[str, _ClassInfo] = {}
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                ci = _collect_class(src, node)
+                infos.append(ci)
+                by_name.setdefault(ci.name, ci)
+        for info in infos:
+            info.guards = _inherit_guards(by_name, info, {info.name})
+            if info.guards:
+                for meth_name, meth in info.methods.items():
+                    if meth_name == "__init__" \
+                            or meth_name.startswith("_init") \
+                            or meth_name.endswith("_locked"):
+                        continue
+                    _GuardChecker(info, meth_name, src, findings).visit(
+                        meth)
+            if not info.thread_entries:
+                continue
+            reachable = _thread_reachable(info)
+            others = set(info.methods) - reachable - _WRITE_EXEMPT
+            for attr in sorted(
+                    {a for m in info.methods
+                     for a in (*info.reads.get(m, ()),
+                               *info.writes.get(m, ()))}):
+                if attr in info.guards or attr in info.sync_attrs:
+                    continue
+                writers = {m for m, w in info.writes.items() if attr in w}
+                if not writers - _WRITE_EXEMPT:
+                    continue  # config: filled before any thread exists
+                touched = {m for m in info.methods
+                           if attr in info.reads.get(m, ())
+                           or attr in info.writes.get(m, ())}
+                t_side = touched & reachable
+                o_side = touched & others
+                if not t_side or not o_side:
+                    continue
+                lines = sorted(
+                    ln for m in (t_side | o_side) - _WRITE_EXEMPT
+                    for ln in (*info.reads.get(m, {}).get(attr, ()),
+                               *info.writes.get(m, {}).get(attr, ())))
+                findings.append(Finding(
+                    "thread-shared", src.rel, lines[0],
+                    f"{info.name}.{attr}",
+                    f"{info.name}.{attr} is shared between thread "
+                    f"target(s) {sorted(t_side)} and {sorted(o_side)} "
+                    f"with no `# guarded by:` declaration"))
+    return findings
