@@ -354,6 +354,15 @@ def run_bench(args) -> dict:
             "shard_count": 1,
             "replica_count": 0,
             "fetch_qps": fetch_qps,
+            # Elastic serve-tier attribution (ISSUE 11): the bench runs
+            # against a static in-process topology, so these are zero by
+            # construction — the elastic numbers live in
+            # experiments/results/elastic_serve/. Non-zero values in a
+            # record mean the topology moved DURING the measurement.
+            "replica_count_live": 0,
+            "autoscale_actions": 0,
+            "canary_promotions": 0,
+            "reshard_events": 0,
         }
         # Static-analysis attribution (ISSUE 10 satellite): whether the
         # tree this number was measured from passed dpslint, and what the

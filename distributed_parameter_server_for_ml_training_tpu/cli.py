@@ -18,6 +18,9 @@ Commands::
     python -m ....cli status --url http://host:9400   # cluster health view
     python -m ....cli replica --primary host:8000     # read-only fetch replica
     python -m ....cli loadgen --targets host:8000     # fetch-path QPS probe
+    python -m ....cli reshard --primaries a,b --donor 0 --recipient 1 \
+        --slots 24:32                                 # live shard migration
+    python -m ....cli infer --target host:8001        # serve-tier inference
 
 The in-process ``train`` command replaces the reference's entire
 terraform/ECS deployment for single-host experiments: what took a Fargate
@@ -350,6 +353,25 @@ def build_parser() -> argparse.ArgumentParser:
                         "shard order (host:port, length --shard-count); "
                         "published to workers as the shard map at "
                         "registration. Required when --shard-count > 1")
+    s.add_argument("--autoscale", action="store_true",
+                   help="grow/shrink a local replica fleet from measured "
+                        "fetch QPS (telemetry/autoscale.py): spawns "
+                        "`cli replica` children against this primary, "
+                        "ticked by the health monitor")
+    s.add_argument("--autoscale-min", type=int, default=0,
+                   help="replica floor the autoscaler keeps alive")
+    s.add_argument("--autoscale-max", type=int, default=4,
+                   help="replica ceiling")
+    s.add_argument("--autoscale-qps-high", type=float, default=50.0,
+                   help="windowed fetch QPS above which the fleet grows")
+    s.add_argument("--autoscale-qps-low", type=float, default=5.0,
+                   help="windowed fetch QPS below which it shrinks "
+                        "(hysteresis band with --autoscale-qps-high)")
+    s.add_argument("--autoscale-cooldown", type=float, default=10.0,
+                   help="minimum seconds between scaling actions")
+    s.add_argument("--autoscale-dry-run", action="store_true",
+                   help="decide and record scaling actions without "
+                        "spawning or retiring anything")
     add_platform(s)
     add_telemetry(s)
 
@@ -496,6 +518,20 @@ def build_parser() -> argparse.ArgumentParser:
                    help="max seconds since the last successful refresh "
                         "before fetches are refused with a redirect to "
                         "the primary")
+    r.add_argument("--canary", action="store_true",
+                   help="serve the canary-gated inference workload "
+                        "(docs/SHARDING.md \"Serve tier\"): keep a step "
+                        "history, split `infer` fetches stable/canary, "
+                        "promote or roll back on client quality feedback")
+    r.add_argument("--canary-fraction", type=float, default=0.05,
+                   help="share of infer requests routed to the canary "
+                        "step (default 5%%)")
+    r.add_argument("--canary-min-samples", type=int, default=20,
+                   help="quality samples each arm needs before a "
+                        "promote/rollback decision")
+    r.add_argument("--canary-tolerance", type=float, default=0.0,
+                   help="promote while canary mean quality >= stable "
+                        "mean - tolerance; below that, roll back")
     add_telemetry(r)
 
     lg = sub.add_parser(
@@ -512,11 +548,52 @@ def build_parser() -> argparse.ArgumentParser:
     lg.add_argument("--concurrency", type=int, default=4,
                     help="total client threads (each with its own "
                          "channel)")
-    lg.add_argument("--fetch-mode", choices=["full", "delta"],
+    lg.add_argument("--fetch-mode", choices=["full", "delta", "infer"],
                     default="full",
                     help="full = whole model every fetch; delta = poll "
                          "at the current step (header-only NOT_MODIFIED "
-                         "steady state)")
+                         "steady state); infer = the inference-serving "
+                         "workload against a canary replica, with "
+                         "per-arm counts/latency/quality in the result")
+
+    rs = sub.add_parser(
+        "reshard",
+        help="live shard migration coordinator (docs/SHARDING.md "
+             "\"Migration protocol\"): move a slot range between two "
+             "ADJACENT primaries — export+journal on the donor, import "
+             "on the recipient, apply the bumped map everywhere, commit "
+             "the drop — with zero downtime and exactly-once preserved")
+    rs.add_argument("--primaries", required=True,
+                    help="ordered comma list of ALL shard primaries "
+                         "(index = shard id), the same list the serve "
+                         "processes were given as --shard-peers")
+    rs.add_argument("--donor", type=int, required=True,
+                    help="shard id giving up the slot range")
+    rs.add_argument("--recipient", type=int, required=True,
+                    help="shard id receiving it (must be donor±1: ranges "
+                         "stay contiguous per shard)")
+    rs.add_argument("--slots", required=True, metavar="LO:HI",
+                    help="slot range [LO,HI) to move; must sit at the "
+                         "donor's boundary facing the recipient")
+    rs.add_argument("--json", action="store_true",
+                    help="print only the RESHARD_JSON line")
+
+    inf = sub.add_parser(
+        "infer",
+        help="one-shot inference client against the serve tier "
+             "(docs/SHARDING.md \"Serve tier\"): send `infer` fetches, "
+             "print which arm and step served each, optionally report a "
+             "quality score back")
+    inf.add_argument("--target", required=True,
+                     help="replica (or primary) address, host:port")
+    inf.add_argument("--count", type=int, default=1,
+                     help="number of inference requests to send")
+    inf.add_argument("--quality", type=float, default=None,
+                     help="quality score to report for each served "
+                          "response (feeds the canary decision); omit to "
+                          "send no feedback")
+    inf.add_argument("--json", action="store_true",
+                     help="print only the INFER_JSON line")
 
     st = sub.add_parser(
         "status",
@@ -868,6 +945,9 @@ def _cmd_serve(args) -> int:
         print(f"remediation: engine on "
               f"(dry_run={engine.policy.dry_run})", file=sys.stderr,
               flush=True)
+    if getattr(args, "autoscale", False) and monitor is None:
+        raise SystemExit("--autoscale needs the health monitor "
+                         "(drop --no-health-monitor)")
     ckpt_dir = getattr(args, "checkpoint_dir", None)
     ckpt = None
     restored = None
@@ -920,6 +1000,33 @@ def _cmd_serve(args) -> int:
         install_shutdown_hooks(role="server")
         add_shutdown_flush(ckpt.flush_now)
     server, port = serve(store, port=args.port, service=svc)
+    pool = None
+    if getattr(args, "autoscale", False):
+        # Replica autoscaler (docs/SHARDING.md "Serve tier"): the policy
+        # head rides the monitor's background tick; the pool spawns
+        # `cli replica` children against THIS primary's bound port.
+        from .ps.supervisor import ReplicaPool, build_replica_argv
+        from .telemetry import AutoscalePolicy, ReplicaAutoscaler
+        primary_addr = f"localhost:{port}"
+        replica_args = ["--shard-id", str(shard_index)]
+        pool = ReplicaPool(
+            lambda idx: build_replica_argv(primary_addr, replica_args,
+                                           idx))
+        monitor.autoscaler = ReplicaAutoscaler(
+            pool,
+            AutoscalePolicy(
+                qps_high=getattr(args, "autoscale_qps_high", 50.0),
+                qps_low=getattr(args, "autoscale_qps_low", 5.0),
+                min_replicas=getattr(args, "autoscale_min", 0),
+                max_replicas=getattr(args, "autoscale_max", 4),
+                cooldown_s=getattr(args, "autoscale_cooldown", 10.0),
+                dry_run=bool(getattr(args, "autoscale_dry_run", False))),
+            sharding=sharding)
+        print(f"autoscale: on (replicas "
+              f"{monitor.autoscaler.policy.min_replicas}.."
+              f"{monitor.autoscaler.policy.max_replicas}, "
+              f"dry_run={monitor.autoscaler.policy.dry_run})",
+              file=sys.stderr, flush=True)
     print(f"parameter server up on :{port} "
           f"(mode={store.config.mode}, workers={args.workers}, "
           f"backend={args.store_backend}"
@@ -945,6 +1052,8 @@ def _cmd_serve(args) -> int:
         pass
     finally:
         server.stop(grace=2.0)
+        if pool is not None:
+            pool.stop()
         if monitor is not None:
             from .telemetry import set_cluster_monitor
             monitor.stop(final=True)
@@ -1298,12 +1407,21 @@ def _cmd_replica(args) -> int:
                         shard_id=args.shard_id,
                         advertise=args.advertise,
                         poll_interval=args.poll_interval,
-                        staleness_bound_s=args.staleness_bound)
+                        staleness_bound_s=args.staleness_bound,
+                        canary=bool(getattr(args, "canary", False)),
+                        canary_fraction=getattr(args, "canary_fraction",
+                                                0.05),
+                        canary_min_samples=getattr(
+                            args, "canary_min_samples", 20),
+                        canary_tolerance=getattr(args, "canary_tolerance",
+                                                 0.0))
     port = rep.start()
     print(f"replica up on :{port} (primary={args.primary}, "
           f"shard={args.shard_id}, "
-          f"staleness_bound={args.staleness_bound:g}s)", file=sys.stderr,
-          flush=True)
+          f"staleness_bound={args.staleness_bound:g}s"
+          + (f", canary=1/{rep.canary.period}" if rep.canary is not None
+             else "")
+          + ")", file=sys.stderr, flush=True)
     try:
         while True:
             time.sleep(1.0)
@@ -1323,11 +1441,156 @@ def cmd_loadgen(args) -> int:
                          concurrency=args.concurrency,
                          mode=args.fetch_mode)
     print("LOADGEN_JSON " + _json.dumps(result), flush=True)
+    lat = result["latency_ms"]
     print(f"{result['qps']:.1f} fetch/s aggregate over "
           f"{len(result['targets'])} target(s) "
           f"({result['fetches_err']} errors, "
-          f"{result['mb_per_s']:.2f} MB/s in)", file=sys.stderr)
+          f"{result['mb_per_s']:.2f} MB/s in, latency p50/p95/p99 "
+          f"{lat['p50']:g}/{lat['p95']:g}/{lat['p99']:g} ms)",
+          file=sys.stderr)
+    for arm, row in (result.get("arms") or {}).items():
+        print(f"  arm={arm}: {row['ok']} served, "
+              f"quality={row['quality_mean']}, steps="
+              f"{row['serving_steps']}", file=sys.stderr)
     return 0 if result["fetches_ok"] > 0 else 1
+
+
+def cmd_reshard(args) -> int:
+    """Live migration coordinator (docs/SHARDING.md \"Migration
+    protocol\"): export -> import -> apply_ranges everywhere -> commit.
+    Stateless — all state lives in the primaries; rerunning a failed
+    attempt is safe (export freezes again, import re-adopts, the map
+    version only moves forward)."""
+    import json as _json
+
+    from .comms.client import RemoteStore
+
+    try:
+        lo, hi = (int(x) for x in args.slots.split(":"))
+    except ValueError:
+        raise SystemExit(f"--slots must be LO:HI, got {args.slots!r}")
+    primaries = [a for a in args.primaries.split(",") if a]
+    donor, recipient = int(args.donor), int(args.recipient)
+    n = len(primaries)
+    if not (0 <= donor < n and 0 <= recipient < n):
+        raise SystemExit(f"--donor/--recipient out of range for "
+                         f"{n} primaries")
+    if abs(donor - recipient) != 1:
+        raise SystemExit("recipient must be adjacent to donor "
+                         "(donor±1): per-shard slot ranges stay "
+                         "contiguous (docs/SHARDING.md)")
+    stores = [RemoteStore(a) for a in primaries]
+    try:
+        # 1. Export: the donor freezes [lo,hi) (pushes touching those
+        #    slots are disowned from this instant), hands back a
+        #    consistent params subset + its push journal.
+        emeta, payload = stores[donor].reshard_op("export", slot_lo=lo,
+                                                  slot_hi=hi)
+        live = emeta.get("shard_map") or {}
+        ranges = [tuple(sh["slot_range"])
+                  for sh in live.get("shards", [])]
+        if len(ranges) != n:
+            raise SystemExit(f"donor's shard map lists "
+                             f"{len(ranges)} shards, expected {n}")
+        dlo, dhi = ranges[donor]
+        rlo, rhi = ranges[recipient]
+        if not dlo <= lo < hi <= dhi:
+            raise SystemExit(f"slots [{lo},{hi}) not owned by donor "
+                             f"{donor} (owns [{dlo},{dhi}))")
+        # The moved range must sit at the donor boundary FACING the
+        # recipient, so both stay contiguous after the handoff.
+        if recipient == donor + 1:
+            if hi != dhi:
+                raise SystemExit(f"moving to shard {recipient} needs "
+                                 f"HI == donor's upper bound {dhi}")
+            ranges[donor] = (dlo, lo)
+            ranges[recipient] = (lo, rhi)
+        else:
+            if lo != dlo:
+                raise SystemExit(f"moving to shard {recipient} needs "
+                                 f"LO == donor's lower bound {dlo}")
+            ranges[donor] = (hi, dhi)
+            ranges[recipient] = (rlo, hi)
+        version = int(live.get("version", 0)) + 1
+        # 2. Import: recipient adopts the params AND the donor's journal
+        #    entries, so a worker replaying a pre-handoff push token
+        #    against the new owner still answers `duplicate`.
+        imeta, _ = stores[recipient].reshard_op(
+            "import", payload=payload, journal=emeta.get("journal"))
+        # 3. Publish the bumped map to EVERY primary (each refreshes its
+        #    clients through the have_shard_map delta handshake).
+        for s in stores:
+            s.reshard_op("apply_ranges",
+                         ranges=[list(r) for r in ranges],
+                         map_version=version)
+        # 4. Commit: the donor drops the handed-off params.
+        cmeta, _ = stores[donor].reshard_op("commit", slot_lo=lo,
+                                            slot_hi=hi)
+    finally:
+        for s in stores:
+            s.close()
+    result = {"donor": donor, "recipient": recipient,
+              "slots": [lo, hi], "map_version": version,
+              "export_step": emeta.get("export_step"),
+              "exported": emeta.get("exported"),
+              "adopted": imeta.get("adopted"),
+              "journal_loaded": imeta.get("journal_loaded"),
+              "dropped": cmeta.get("dropped"),
+              "ranges": [list(r) for r in ranges]}
+    print("RESHARD_JSON " + _json.dumps(result), flush=True)
+    if not args.json:
+        print(f"moved slots [{lo},{hi}) shard {donor} -> {recipient} "
+              f"at step {result['export_step']} "
+              f"({result['adopted']} tensors, "
+              f"{result['journal_loaded']} journal entries; "
+              f"map v{version})", file=sys.stderr)
+    return 0
+
+
+def cmd_infer(args) -> int:
+    """One-shot inference client: raw stub like loadgen (no RemoteStore
+    — the reply's tensor payload is deliberately never decoded)."""
+    import json as _json
+    import time
+
+    import grpc as _grpc
+
+    from .comms.service import (GRPC_OPTIONS, SERVICE_NAME, pack_msg,
+                                unpack_msg)
+
+    ident = lambda b: b  # noqa: E731
+    channel = _grpc.insecure_channel(args.target, options=GRPC_OPTIONS)
+    stub = channel.unary_unary(f"/{SERVICE_NAME}/FetchParameters",
+                               request_serializer=ident,
+                               response_deserializer=ident)
+    served = []
+    meta: dict = {"infer": True}
+    try:
+        for _ in range(max(1, int(args.count))):
+            t0 = time.perf_counter()
+            reply = stub(pack_msg(meta), timeout=10.0)
+            dt = time.perf_counter() - t0
+            rmeta, payload = unpack_msg(reply)
+            arm = rmeta.get("arm") or "stable"
+            step = rmeta.get("serving_step",
+                             rmeta.get("global_step"))
+            served.append({"arm": arm, "serving_step": step,
+                           "bytes": len(payload),
+                           "latency_ms": round(dt * 1e3, 3)})
+            meta = {"infer": True}
+            if args.quality is not None and step is not None:
+                meta["quality"] = {"arm": arm, "step": int(step),
+                                   "value": float(args.quality)}
+    finally:
+        channel.close()
+    print("INFER_JSON " + _json.dumps({"target": args.target,
+                                       "served": served}), flush=True)
+    if not args.json:
+        for row in served:
+            print(f"arm={row['arm']} step={row['serving_step']} "
+                  f"{row['bytes']}B {row['latency_ms']}ms",
+                  file=sys.stderr)
+    return 0 if served else 1
 
 
 def cmd_lint(args) -> int:
@@ -1357,7 +1620,8 @@ def main(argv=None) -> int:
     return {"train": cmd_train, "serve": cmd_serve, "worker": cmd_worker,
             "experiments": cmd_experiments, "supervise": cmd_supervise,
             "status": cmd_status, "replica": cmd_replica,
-            "loadgen": cmd_loadgen, "lint": cmd_lint}[args.command](args)
+            "loadgen": cmd_loadgen, "reshard": cmd_reshard,
+            "infer": cmd_infer, "lint": cmd_lint}[args.command](args)
 
 
 if __name__ == "__main__":

@@ -181,6 +181,14 @@ class RemoteStore:
         #: unsharded server — the wire stays single-server.
         self.shard_map = None
         self._shard_map_version = 0
+        #: Keys the last push reply reported DISOWNED (docs/SHARDING.md
+        #: "Migration protocol"): the primary's map moved while this
+        #: client pushed on a cached one, so that slice never applied
+        #: there. The fan-out store re-routes it to the current owner
+        #: under a fresh token; a plain RemoteStore caller may re-push or
+        #: drop (one async gradient slice, same cost as a staleness
+        #: reject).
+        self.last_disowned: list[str] = []
         self.config = _RemoteConfig()
         # Last membership seen on the wire (elastic servers piggyback it on
         # Register/Fetch replies). Workers fetch at least once per K-step
@@ -214,7 +222,7 @@ class RemoteStore:
         reg = self._telemetry = get_registry()
         self._tm_rpc: dict[str, tuple] = {}
         for name in ["RegisterWorker", "PushGradrients", "FetchParameters",
-                     "JobFinished"]:
+                     "JobFinished", "Reshard"]:
             self._tm_rpc[name] = (
                 reg.histogram("dps_rpc_client_seconds", rpc=name),
                 reg.counter("dps_rpc_client_bytes_total", rpc=name,
@@ -305,7 +313,7 @@ class RemoteStore:
                 f"/{SERVICE_NAME}/{name}",
                 request_serializer=ident, response_deserializer=ident)
             for name in ["RegisterWorker", "PushGradrients",
-                         "FetchParameters", "JobFinished"]
+                         "FetchParameters", "JobFinished", "Reshard"]
         }
         if self.faults is not None:
             from .faults import install_client_faults
@@ -649,7 +657,30 @@ class RemoteStore:
         reply = self._invoke("PushGradrients", pack_msg(meta, payload))
         rmeta, _ = unpack_msg(reply)
         self._note_directives(rmeta)
+        # A push that raced a live migration (docs/SHARDING.md "Migration
+        # protocol") comes back with the PRIMARY'S fresh map plus the list
+        # of keys it disowned rather than applied. Adopt the map first so
+        # any re-route below already targets the new owner.
+        self._note_shard_map(rmeta)
+        if self.shard_map is not None:
+            d = rmeta.get("disowned")
+            self.last_disowned = \
+                [str(k) for k in d] if isinstance(d, list) else []
         return bool(rmeta["accepted"])
+
+    def reshard_op(self, op: str, payload: bytes = b"",
+                   **fields) -> tuple[dict, bytes]:
+        """Admin-plane Reshard RPC (docs/SHARDING.md "Migration
+        protocol"): ``export`` / ``import`` / ``apply_ranges`` /
+        ``commit`` against ONE primary. Returns the raw reply
+        ``(meta, payload)`` — the coordinator (``cli reshard``) owns the
+        protocol ordering and interprets the fields; this client only
+        carries the envelope. Extra keyword fields (``slot_lo``,
+        ``slot_hi``, ``ranges``, ``map_version``, ``journal``) pass
+        through to the request meta verbatim."""
+        request = pack_msg({"op": op, **fields}, payload)
+        reply = self._invoke("Reshard", request)
+        return unpack_msg(reply)
 
     def repush_last(self, worker_id: int) -> bool | None:
         """Re-send the most recent push — same token, same payload, same

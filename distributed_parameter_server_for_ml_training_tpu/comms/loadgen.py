@@ -2,9 +2,9 @@
 
 Drives ``FetchParameters`` at open-throttle concurrency against one or
 more targets (shard primaries and/or replicas) and reports aggregate
-QPS — the measurement tool behind the recorded ≥10× serve-path claim
-(experiments/run_shard_scale.py) and the ``fetch_qps`` field bench.py
-records.
+QPS plus client-observed latency percentiles — the measurement tool
+behind the recorded ≥10× serve-path claim (experiments/run_shard_scale.py)
+and the ``fetch_qps`` field bench.py records.
 
 Deliberately NOT built on RemoteStore: the generator unpacks only the
 reply envelope and never decodes tensors, so the client side stays far
@@ -18,6 +18,12 @@ Modes:
 - ``delta`` — fetches carry ``have_step`` at the target's current step,
   so an idle server answers header-only NOT_MODIFIED (the replica-
   refresh / heartbeat workload).
+- ``infer`` — the inference-serving workload against a canary-enabled
+  replica tier (docs/SHARDING.md "Serve tier"): each request carries
+  ``infer`` and piggybacks a quality score for the PREVIOUS response
+  (``quality_fn(serving_step)``), and the result breaks fetch counts,
+  latency, and mean quality out per serving arm — the canary split is
+  directly visible in the numbers.
 """
 
 from __future__ import annotations
@@ -27,6 +33,7 @@ import time
 
 import grpc
 
+from ..telemetry.stats import latency_summary as _latency_summary
 from .service import GRPC_OPTIONS, SERVICE_NAME, pack_msg, unpack_msg
 
 __all__ = ["run_loadgen"]
@@ -40,20 +47,31 @@ def _fetch_stub(channel):
 
 
 def run_loadgen(targets, duration_s: float = 5.0, concurrency: int = 4,
-                mode: str = "full", rpc_timeout: float = 10.0) -> dict:
+                mode: str = "full", rpc_timeout: float = 10.0,
+                quality_fn=None) -> dict:
     """Hammer ``targets`` with fetches for ``duration_s`` using
     ``concurrency`` threads; returns the aggregate result dict (also the
-    ``LOADGEN_JSON`` schema ``cli loadgen`` emits)."""
+    ``LOADGEN_JSON`` schema ``cli loadgen`` emits). In ``infer`` mode
+    ``quality_fn(serving_step) -> float`` scores each served response
+    (default: constant 1.0); the score rides the NEXT request as canary
+    feedback."""
     if isinstance(targets, str):
         targets = [t for t in targets.split(",") if t]
     if not targets:
         raise ValueError("loadgen needs at least one target")
-    if mode not in ("full", "delta"):
-        raise ValueError(f"mode must be full|delta, got {mode!r}")
+    if mode not in ("full", "delta", "infer"):
+        raise ValueError(f"mode must be full|delta|infer, got {mode!r}")
 
     lock = threading.Lock()
     per_target = {t: {"ok": 0, "err": 0, "bytes_in": 0,
                       "not_modified": 0} for t in targets}
+    latencies: list[float] = []  # guarded by: lock
+    # Per-arm accounting (infer mode; guarded by: lock). Literal arm
+    # names: these ARE the wire values a canary replica stamps replies
+    # with.
+    arms = {a: {"ok": 0, "quality_sum": 0.0, "quality_n": 0,
+                "latency_s": [], "steps": set()}
+            for a in ("stable", "canary")}
     stop = threading.Event()
 
     def worker(idx: int) -> None:
@@ -61,6 +79,10 @@ def run_loadgen(targets, duration_s: float = 5.0, concurrency: int = 4,
         channel = grpc.insecure_channel(target, options=GRPC_OPTIONS)
         stub = _fetch_stub(channel)
         ok = err = nbytes = nm = 0
+        lat: list[float] = []
+        arm_local = {a: {"ok": 0, "quality_sum": 0.0, "quality_n": 0,
+                         "latency_s": [], "steps": set()}
+                     for a in ("stable", "canary")}
         have = None
         if mode == "delta":
             # Learn the target's current step once, then poll at it so
@@ -71,15 +93,22 @@ def run_loadgen(targets, duration_s: float = 5.0, concurrency: int = 4,
                 have = int(meta["global_step"])
             except Exception:  # noqa: BLE001 — count as errors below
                 have = 0
-        request = pack_msg({} if have is None else {"have_step": have})
+        if mode == "infer":
+            request = pack_msg({"infer": True})
+        else:
+            request = pack_msg({} if have is None
+                               else {"have_step": have})
         while not stop.is_set():
+            t0 = time.perf_counter()
             try:
                 reply = stub(request, timeout=rpc_timeout)
             except Exception:  # noqa: BLE001 — grpc errors only
                 err += 1
                 continue
+            dt = time.perf_counter() - t0
             ok += 1
             nbytes += len(reply)
+            lat.append(dt)
             if mode == "delta":
                 rmeta, _ = unpack_msg(reply)
                 if rmeta.get("not_modified"):
@@ -89,6 +118,32 @@ def run_loadgen(targets, duration_s: float = 5.0, concurrency: int = 4,
                     # loop keeps measuring the NM path, not full ships.
                     have = int(rmeta["global_step"])
                     request = pack_msg({"have_step": have})
+            elif mode == "infer":
+                rmeta, _ = unpack_msg(reply)
+                arm = str(rmeta.get("arm") or "stable")
+                if arm not in arm_local:
+                    arm = "stable"
+                step = rmeta.get("serving_step")
+                row = arm_local[arm]
+                row["ok"] += 1
+                row["latency_s"].append(dt)
+                meta: dict = {"infer": True}
+                if step is not None:
+                    row["steps"].add(int(step))
+                    try:
+                        q = (1.0 if quality_fn is None
+                             else float(quality_fn(int(step))))
+                    except Exception:  # noqa: BLE001 — scorer bug only
+                        q = None       # costs one feedback sample
+                    if q is not None:
+                        row["quality_sum"] += q
+                        row["quality_n"] += 1
+                        # Feedback rides the NEXT request: arm + step
+                        # identify which window the score lands in.
+                        meta["quality"] = {"arm": arm,
+                                           "step": int(step),
+                                           "value": q}
+                request = pack_msg(meta)
         channel.close()
         with lock:
             row = per_target[target]
@@ -96,6 +151,14 @@ def run_loadgen(targets, duration_s: float = 5.0, concurrency: int = 4,
             row["err"] += err
             row["bytes_in"] += nbytes
             row["not_modified"] += nm
+            latencies.extend(lat)
+            for a, src in arm_local.items():
+                dst = arms[a]
+                dst["ok"] += src["ok"]
+                dst["quality_sum"] += src["quality_sum"]
+                dst["quality_n"] += src["quality_n"]
+                dst["latency_s"].extend(src["latency_s"])
+                dst["steps"] |= src["steps"]
 
     threads = [threading.Thread(target=worker, args=(i,), daemon=True)
                for i in range(int(concurrency))]
@@ -110,7 +173,7 @@ def run_loadgen(targets, duration_s: float = 5.0, concurrency: int = 4,
     total_ok = sum(r["ok"] for r in per_target.values())
     total_err = sum(r["err"] for r in per_target.values())
     total_bytes = sum(r["bytes_in"] for r in per_target.values())
-    return {
+    result = {
         "targets": list(targets),
         "mode": mode,
         "concurrency": int(concurrency),
@@ -123,5 +186,16 @@ def run_loadgen(targets, duration_s: float = 5.0, concurrency: int = 4,
         "qps": round(total_ok / elapsed, 1) if elapsed > 0 else 0.0,
         "mb_per_s": round(total_bytes / elapsed / 1e6, 2)
         if elapsed > 0 else 0.0,
+        "latency_ms": _latency_summary(latencies),
+        "errors_by_target": {t: r["err"] for t, r in per_target.items()},
         "per_target": per_target,
     }
+    if mode == "infer":
+        result["arms"] = {
+            a: {"ok": r["ok"],
+                "quality_mean": (round(r["quality_sum"] / r["quality_n"], 4)
+                                 if r["quality_n"] else None),
+                "latency_ms": _latency_summary(r["latency_s"]),
+                "serving_steps": sorted(r["steps"])}
+            for a, r in arms.items()}
+    return result

@@ -29,19 +29,130 @@ Each poll announces ``replica: {shard_id, address}`` in the fetch meta;
 the primary's ShardInfo (ps/sharding.py) turns that plus ``have_step``
 into the published replica membership and the ``dps_replica_lag_*``
 gauges.
+
+**Inference serving (canary-gated)**: with ``canary=True`` the replica
+keeps a short HISTORY of per-step reply bytes instead of only the
+latest, and splits ``infer`` fetches across two pinned steps — the
+STABLE step serves ~95% of requests, the newest candidate (CANARY)
+~5%. Clients report a quality score for responses they served
+(``quality`` request meta); once both arms have enough samples the
+:class:`CanaryController` either PROMOTES the candidate (its quality is
+within tolerance of stable's) or ROLLS IT BACK (marks the step bad, so
+it is never offered again). Training-path fetches are untouched — they
+always serve the newest synced step (docs/SHARDING.md "Serve tier").
 """
 
 from __future__ import annotations
 
 import threading
 import time
+from collections import deque
 from concurrent import futures
 
 import grpc
 
 from .service import GRPC_OPTIONS, SERVICE_NAME, pack_msg, unpack_msg
 
-__all__ = ["ReplicaServer"]
+__all__ = ["CanaryController", "ReplicaServer"]
+
+
+class CanaryController:
+    """Promote/rollback state machine over (stable_step, canary_step).
+
+    Pure decision logic — no wire, no locks (the owner serializes calls
+    under its own lock). Steps flow in via :meth:`offer` (each newer
+    primary step becomes the candidate, unless previously rolled back),
+    quality samples via :meth:`note_quality`, and :meth:`decide` resolves
+    the race once BOTH arms have ``min_samples``: promote when the
+    canary's mean quality is no worse than stable's minus ``tolerance``,
+    roll back otherwise. Rolled-back steps land in ``bad_steps`` and are
+    never re-offered — the regression stays fenced even though the
+    training run that produced it keeps publishing newer steps."""
+
+    def __init__(self, fraction: float = 0.05, min_samples: int = 20,
+                 tolerance: float = 0.0, window: int = 256):
+        if not 0.0 < fraction <= 0.5:
+            raise ValueError(f"canary fraction must be in (0, 0.5], "
+                             f"got {fraction}")
+        #: Every ``period``-th infer request serves the canary arm —
+        #: deterministic, so a test (or an operator reading loadgen
+        #: percentiles) sees exactly the configured split.
+        self.period = max(2, round(1.0 / float(fraction)))
+        self.min_samples = max(1, int(min_samples))
+        self.tolerance = float(tolerance)
+        self.stable_step: int | None = None
+        self.canary_step: int | None = None
+        self.bad_steps: set[int] = set()
+        self.promotions = 0
+        self.rollbacks = 0
+        self._requests = 0
+        self._quality = {"stable": deque(maxlen=window),
+                         "canary": deque(maxlen=window)}
+
+    def offer(self, step: int) -> None:
+        """A newly synced step: first ever becomes stable outright;
+        anything newer becomes (or replaces) the canary candidate, with
+        a fresh quality window — samples for an older candidate say
+        nothing about this one."""
+        step = int(step)
+        if self.stable_step is None:
+            self.stable_step = step
+            return
+        if step <= max(self.stable_step, self.canary_step or 0) \
+                or step in self.bad_steps:
+            return
+        self.canary_step = step
+        self._quality["canary"].clear()
+
+    def pick_arm(self) -> str:
+        """Route one infer request. Counter-based: request k goes to the
+        canary iff a candidate exists and k % period == 0."""
+        self._requests += 1
+        if self.canary_step is not None \
+                and self._requests % self.period == 0:
+            return "canary"
+        return "stable"
+
+    def note_quality(self, arm: str, step: int, value: float) -> None:
+        """Ingest one client-reported score. Stamped with the step the
+        client was SERVED — feedback for a step that is no longer the
+        arm's current step is dropped (it would pollute the window that
+        decides a different step's fate)."""
+        current = (self.stable_step if arm == "stable"
+                   else self.canary_step)
+        if current is not None and int(step) == current:
+            self._quality[arm].append(float(value))
+
+    def decide(self) -> str | None:
+        """Resolve the candidate once both windows are full enough.
+        Returns "promote" / "rollback" / None (still collecting)."""
+        if self.canary_step is None:
+            return None
+        sq, cq = self._quality["stable"], self._quality["canary"]
+        if len(sq) < self.min_samples or len(cq) < self.min_samples:
+            return None
+        stable_mean = sum(sq) / len(sq)
+        canary_mean = sum(cq) / len(cq)
+        if canary_mean >= stable_mean - self.tolerance:
+            self.stable_step = self.canary_step
+            self._quality["stable"] = deque(cq, maxlen=cq.maxlen)
+            self.promotions += 1
+            outcome = "promote"
+        else:
+            self.bad_steps.add(self.canary_step)
+            self.rollbacks += 1
+            outcome = "rollback"
+        self.canary_step = None
+        self._quality["canary"].clear()
+        return outcome
+
+    def view(self) -> dict:
+        return {"stable_step": self.stable_step,
+                "canary_step": self.canary_step,
+                "promotions": self.promotions,
+                "rollbacks": self.rollbacks,
+                "bad_steps": sorted(self.bad_steps),
+                "period": self.period}
 
 
 class ReplicaServer:
@@ -52,7 +163,12 @@ class ReplicaServer:
                  poll_interval: float = 0.05,
                  staleness_bound_s: float = 5.0,
                  rpc_timeout: float = 10.0,
-                 clock=time.time):
+                 clock=time.time,
+                 canary: bool = False,
+                 canary_fraction: float = 0.05,
+                 canary_min_samples: int = 20,
+                 canary_tolerance: float = 0.0,
+                 history: int = 8):
         self.primary = primary
         self.port = int(port)
         self.shard_id = int(shard_id)
@@ -69,6 +185,16 @@ class ReplicaServer:
         self._reply: bytes = b""          # guarded by: self._lock
         self._nm_reply: bytes = b""       # guarded by: self._lock
         self._last_sync: float | None = None  # guarded by: self._lock
+        #: Canary serve state (all guarded by: self._lock). ``canary``
+        #: is the controller or None (training-path replicas carry no
+        #: history and serve infer fetches like plain fetches).
+        self.canary = CanaryController(
+            fraction=canary_fraction, min_samples=canary_min_samples,
+            tolerance=canary_tolerance) if canary else None
+        self._history = max(2, int(history))
+        # step -> primary payload; guarded by: self._lock
+        self._payloads: dict[int, bytes] = {}
+        self._arm_replies: dict[str, bytes] = {}  # guarded by: self._lock
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._server: grpc.Server | None = None
@@ -81,6 +207,12 @@ class ReplicaServer:
         self._tm_stale = reg.counter("dps_replica_stale_rejects_total")
         self._tm_redirects = reg.counter("dps_replica_redirects_total")
         self._tm_step = reg.gauge("dps_replica_step")
+        self._tm_infer = {arm: reg.counter("dps_infer_requests_total",
+                                           arm=arm)
+                          for arm in ("stable", "canary")}
+        self._tm_promote = reg.counter("dps_canary_promotions_total")
+        self._tm_rollback = reg.counter("dps_canary_rollbacks_total")
+        self._tm_stable_step = reg.gauge("dps_canary_stable_step")
 
     # -- subscription (replica -> primary) -----------------------------------
 
@@ -116,8 +248,41 @@ class ReplicaServer:
             self._reply = reply
             self._nm_reply = nm_reply
             self._last_sync = now
+            if self.canary is not None:
+                self._payloads[step] = bytes(payload)
+                self.canary.offer(step)
+                self._evict_history_locked()
+                self._repack_arms_locked()
         self._tm_refreshes.inc()
         self._tm_step.set(step)
+
+    def _evict_history_locked(self) -> None:
+        """Cap the step history, never evicting a step an arm is pinned
+        to — the stable payload must survive arbitrarily many newer
+        steps."""
+        pinned = {self.canary.stable_step, self.canary.canary_step}
+        for step in sorted(self._payloads):
+            if len(self._payloads) <= self._history:
+                break
+            if step not in pinned:
+                del self._payloads[step]
+
+    def _repack_arms_locked(self) -> None:
+        """Pre-encode one full reply PER ARM (same once-per-change
+        discipline as the train-path cache): serving an infer request is
+        then a dict lookup regardless of model size."""
+        arms: dict[str, bytes] = {}
+        for arm, step in (("stable", self.canary.stable_step),
+                          ("canary", self.canary.canary_step)):
+            payload = self._payloads.get(step) if step is not None else None
+            if payload is not None:
+                arms[arm] = pack_msg(
+                    {"global_step": step, "serving_step": step,
+                     "arm": arm, "replica": True,
+                     "shard_id": self.shard_id}, payload)
+        self._arm_replies = arms
+        if self.canary.stable_step is not None:
+            self._tm_stable_step.set(self.canary.stable_step)
 
     def _poll_loop(self) -> None:
         while not self._stop.is_set():
@@ -144,6 +309,8 @@ class ReplicaServer:
     def _fetch_parameters(self, request: bytes, ctx) -> bytes:
         self._fresh_or_abort(ctx)
         meta, _ = unpack_msg(request)
+        if self.canary is not None and meta.get("infer"):
+            return self._serve_infer(meta)
         have = meta.get("have_step")
         self._tm_fetches.inc()
         with self._lock:
@@ -151,6 +318,35 @@ class ReplicaServer:
                     and int(have) == self._step:
                 return self._nm_reply
             return self._reply
+
+    def _serve_infer(self, meta: dict) -> bytes:
+        """One inference request against the canary-split serve tier
+        (docs/SHARDING.md "Serve tier"): ingest any piggybacked quality
+        feedback, resolve the candidate if both windows filled, then
+        route this request to an arm and answer its pre-encoded reply.
+        Freshness was already gated by the caller."""
+        q = meta.get("quality")
+        with self._lock:
+            if isinstance(q, dict):
+                try:
+                    self.canary.note_quality(str(q["arm"]),
+                                             int(q["step"]),
+                                             float(q["value"]))
+                except (KeyError, TypeError, ValueError):
+                    pass  # malformed feedback never fails the serve
+                outcome = self.canary.decide()
+                if outcome is not None:
+                    (self._tm_promote if outcome == "promote"
+                     else self._tm_rollback).inc()
+                    self._evict_history_locked()
+                    self._repack_arms_locked()
+            arm = self.canary.pick_arm()
+            reply = self._arm_replies.get(arm) \
+                or self._arm_replies.get("stable")
+            if arm == "canary" and "canary" not in self._arm_replies:
+                arm = "stable"  # candidate vanished between pick and pack
+            self._tm_infer[arm].inc()
+            return reply if reply is not None else self._reply
 
     def _redirect(self, request: bytes, ctx) -> bytes:
         self._tm_redirects.inc()
@@ -205,9 +401,12 @@ class ReplicaServer:
         now = self.clock()
         with self._lock:
             last = self._last_sync
-            return {"primary": self.primary, "shard_id": self.shard_id,
-                    "address": self.advertise, "step": self._step,
-                    "synced": last is not None,
-                    "sync_age_s": (None if last is None
-                                   else round(max(0.0, now - last), 3)),
-                    "staleness_bound_s": self.staleness_bound_s}
+            out = {"primary": self.primary, "shard_id": self.shard_id,
+                   "address": self.advertise, "step": self._step,
+                   "synced": last is not None,
+                   "sync_age_s": (None if last is None
+                                  else round(max(0.0, now - last), 3)),
+                   "staleness_bound_s": self.staleness_bound_s}
+            if self.canary is not None:
+                out["canary"] = self.canary.view()
+            return out

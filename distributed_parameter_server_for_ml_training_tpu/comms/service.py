@@ -30,10 +30,17 @@ from concurrent import futures
 
 import grpc
 
+from ..ps.sharding import key_slot
 from ..ps.store import ParameterStore
 from .wire import decode_tensor_dict, encode_tensor_dict
 
 SERVICE_NAME = "ps.ParameterServer"
+
+#: Admin reshard sub-operations (docs/SHARDING.md "Migration protocol").
+#: The 5th RPC is admin-plane: only shard PRIMARIES register it, so a
+#: replica answers it UNIMPLEMENTED and can never be talked into a
+#: handoff.
+RESHARD_OPS = ("export", "import", "commit", "apply_ranges")
 
 #: Completed push-token outcomes kept for dedupe (and persisted in store
 #: snapshots, checkpoint/manager.py). One entry per client nonce; 4x the
@@ -230,8 +237,20 @@ class ParameterService:
                                direction="out"),
                    reg.counter("dps_rpc_handler_calls_total", rpc=name))
             for name in ["RegisterWorker", "PushGradrients",
-                         "FetchParameters", "JobFinished"]
+                         "FetchParameters", "JobFinished", "Reshard"]
         }
+        # Live-reshard state (docs/SHARDING.md "Migration protocol"):
+        # slots this primary froze at export and is handing away. A push
+        # touching a draining slot is disowned — dropped from the apply
+        # and named in the reply so the client re-routes it — which is
+        # what makes the exported snapshot authoritative: nothing can
+        # land on the donor's copy after export.
+        self._reshard_lock = threading.Lock()
+        self._draining: set[int] = set()  # guarded by: self._reshard_lock
+        self._tm_reshard = {
+            op: reg.counter("dps_reshard_events_total", op=op)
+            for op in RESHARD_OPS}
+        self._tm_disowned = reg.counter("dps_push_disowned_keys_total")
         # Pushes refused while their worker was quarantined (remediation
         # action; docs/ROBUSTNESS.md).
         self._tm_quarantined = reg.counter(
@@ -419,6 +438,99 @@ class ParameterService:
                                        self.store.global_step)
         except Exception:  # noqa: BLE001
             pass
+
+    def _disowned_keys(self, names) -> list[str]:
+        """Pushed keys whose slot this primary does not currently own
+        (map moved under the client) or is draining away (mid-handoff).
+        Routed on the BASE tensor name so codec companions
+        (``name::int8scale`` etc.) travel with their tensor."""
+        if self.sharding is None:
+            return []
+        lo, hi = self.sharding.my_range()
+        with self._reshard_lock:
+            draining = set(self._draining)
+        out = []
+        for k in names:
+            slot = key_slot(str(k).split("::", 1)[0])
+            if not lo <= slot < hi or slot in draining:
+                out.append(k)
+        return out
+
+    def _keys_in_slots(self, lo: int, hi: int) -> list[str]:
+        """This store's parameter names living in ``[lo, hi)`` — the
+        donor's export subset, derived from slots at call time so the
+        admin never has to know key names."""
+        return [k for k in self.store.param_names()
+                if lo <= key_slot(k) < hi]
+
+    def reshard(self, request: bytes, ctx) -> bytes:
+        """Admin-plane slot-range handoff (docs/SHARDING.md "Migration
+        protocol"). Four sub-operations, driven by ``cli reshard``:
+
+        - ``export``: freeze ``[slot_lo, slot_hi)`` (pushes touching it
+          are disowned from this instant) and return a consistent params
+          subset + the completed push-token journal + the step — the
+          donor half. Nothing is dropped yet.
+        - ``import``: graft a transferred subset + journal into this
+          store — the recipient half. Exactly-once survives the handoff
+          because the donor's journal seeds this service's dedupe table
+          BEFORE any client is re-routed here.
+        - ``apply_ranges``: install the coordinator's new slot partition
+          + map version (every primary converges to the same revision);
+          clears any draining slots this shard no longer owns.
+        - ``commit``: drop the donor's copy of the migrated range after
+          the recipient confirmed adoption; clears the drain markers.
+        """
+        meta, payload = unpack_msg(request)
+        if self.sharding is None:
+            if ctx is not None:
+                ctx.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          "reshard: this server is not a shard primary")
+            raise ValueError("reshard on unsharded server")
+        op = str(meta.get("op"))
+        if op not in RESHARD_OPS:
+            if ctx is not None:
+                ctx.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          f"reshard: unknown op {op!r}")
+            raise ValueError(f"unknown reshard op {op!r}")
+        self._tm_reshard[op].inc()
+        # Every reply carries the CURRENT map (full, never delta-gated):
+        # the coordinator derives the new partition from the donor's live
+        # ranges instead of trusting its own stale picture.
+        if op == "export":
+            lo, hi = int(meta["slot_lo"]), int(meta["slot_hi"])
+            with self._reshard_lock:
+                self._draining.update(range(lo, hi))
+            keys = self._keys_in_slots(lo, hi)
+            params, step = self.store.export_params(keys)
+            return pack_msg({"export_step": step,
+                             "journal": self.journal_snapshot(),
+                             "exported": len(params),
+                             **self._shard_fields()},
+                            encode_tensor_dict(params))
+        if op == "import":
+            params = decode_tensor_dict(payload)
+            adopted = self.store.adopt_params(params)
+            loaded = self.load_journal(meta.get("journal"))
+            return pack_msg({"adopted": adopted, "journal_loaded": loaded,
+                             **self._shard_fields()})
+        if op == "apply_ranges":
+            version = self.sharding.adopt_ranges(
+                meta["ranges"], meta.get("map_version"))
+            # The adopted map is now the sole ownership authority: drain
+            # markers for slots handed away are redundant (the range
+            # check disowns), and markers for slots the map says we KEEP
+            # would contradict it (an aborted handoff must un-freeze).
+            with self._reshard_lock:
+                self._draining.clear()
+            return pack_msg({"map_version": version,
+                             **self._shard_fields()})
+        # commit: the recipient holds the range; release the donor copy.
+        lo, hi = int(meta["slot_lo"]), int(meta["slot_hi"])
+        dropped = self.store.drop_params(self._keys_in_slots(lo, hi))
+        with self._reshard_lock:
+            self._draining -= set(range(lo, hi))
+        return pack_msg({"dropped": dropped, **self._shard_fields()})
 
     def register_worker(self, request: bytes, ctx) -> bytes:
         meta, _ = unpack_msg(request)
@@ -609,6 +721,20 @@ class ParameterService:
                              "global_step": self.store.global_step,
                              **self._directive_fields(wid, meta)})
         grads = decode_tensor_dict(payload)
+        # Ownership filter (docs/SHARDING.md "Migration protocol"): keys
+        # whose slot this primary no longer owns — the map moved while
+        # the client pushed on a cached one, or the slot is mid-handoff
+        # (draining) — are dropped from the apply and NAMED in the reply
+        # beside a fresh map, so the client re-routes that slice to the
+        # current owner under a fresh token. The rest of the push applies
+        # normally: round accounting must see the worker either way.
+        disowned = self._disowned_keys(grads)
+        shard_extra: dict = {}
+        if disowned:
+            for k in disowned:
+                grads.pop(k, None)
+            self._tm_disowned.inc(len(disowned))
+            shard_extra = {"disowned": disowned, **self._shard_fields()}
         accepted = False
         try:
             accepted = self.store.push(wid, grads, int(meta["fetched_step"]))
@@ -623,6 +749,7 @@ class ParameterService:
                 entry[2].set()
         return pack_msg({"received": True, "accepted": accepted,
                          "global_step": self.store.global_step,
+                         **shard_extra,
                          **self._directive_fields(wid, meta)})
 
     # -- durable push-token journal (docs/ROBUSTNESS.md) ---------------------
@@ -776,6 +903,9 @@ class ParameterService:
             "PushGradrients": self.push_gradrients,  # quirk 1, on purpose
             "FetchParameters": self.fetch_parameters,
             "JobFinished": self.job_finished,
+            # Admin plane (docs/SHARDING.md "Migration protocol"): only
+            # primaries register it; replicas answer UNIMPLEMENTED.
+            "Reshard": self.reshard,
         }
         def wire(name, fn):
             # Fault injection sits INSIDE the instrumentation wrapper, so

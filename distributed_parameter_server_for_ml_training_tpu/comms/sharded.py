@@ -6,8 +6,11 @@ against a consistent-hash-partitioned parameter tier (docs/SHARDING.md)
 completely unchanged. One :class:`~.client.RemoteStore` per shard
 primary underneath; this layer only routes and reassembles:
 
-- **push** partitions the gradient dict with
-  :func:`~..ps.sharding.shard_for_key` and sends each shard its slice,
+- **push** partitions the gradient dict by slot owner — through the
+  live shard map once one is adopted (slot ranges move under live
+  migration), falling back to the canonical
+  :func:`~..ps.sharding.shard_for_key` partition before any map is
+  seen — and sends each shard its slice,
   with that shard's OWN last-fetched step (staleness accounting is
   per-shard) and that store's OWN push token (each shard keeps its own
   exactly-once journal, so dedupe/crash recovery/session resume shard
@@ -32,7 +35,7 @@ import threading
 
 import numpy as np
 
-from ..ps.sharding import shard_for_key
+from ..ps.sharding import key_slot, shard_for_key, shard_for_slot
 from .client import RemoteStore
 
 
@@ -190,26 +193,91 @@ class ShardedRemoteStore:
                 merged.update(cache)
             return merged, gstep
 
+    def _route_ranges(self) -> list | None:
+        """Slot ranges from the freshest adopted shard map, or None when
+        no usable map exists (pre-registration, or a map whose shard
+        count disagrees with the fan-out). With None the router falls
+        back to the canonical boot-time partition — correct until the
+        first live migration, which always publishes a map first."""
+        best = None
+        for s in self._stores:
+            m = s.shard_map
+            if m is not None and (best is None
+                                  or m["version"] > best["version"]):
+                best = m
+        if best is None or best["shard_count"] != len(self._stores):
+            return None
+        return [tuple(sh["slot_range"]) for sh in best["shards"]]
+
+    def _owner(self, name, n: int, ranges) -> int:
+        """Key -> shard id, through the LIVE map when one is adopted
+        (slot ranges move under migration; docs/SHARDING.md). Companion
+        keys (``w::int8scale`` etc.) route on the base tensor name so a
+        quantized slice never splits from its scales."""
+        if ranges is None:
+            return shard_for_key(name, n)
+        base = str(name).split("::", 1)[0]
+        return shard_for_slot(key_slot(base), ranges)
+
     def push(self, worker_id: int, gradients: dict,
              fetched_step: int) -> bool:
-        """Partition by key owner and push each shard its slice against
-        that shard's own fetched step. Every shard gets a push even when
-        its slice is empty — in sync mode a round only closes when all
-        workers report, so skipping a keyless shard would wedge its
-        rounds behind everyone else's."""
+        """Partition by key owner (live map when adopted, canonical
+        otherwise) and push each shard its slice against that shard's own
+        fetched step. Every shard gets a push even when its slice is
+        empty — in sync mode a round only closes when all workers report,
+        so skipping a keyless shard would wedge its rounds behind
+        everyone else's. A slice the target DISOWNED (it pushed on a map
+        that moved mid-flight) is re-routed once to the new owner under a
+        fresh token in async mode; in sync mode it is dropped — a second
+        push into the new owner's round would double-report this worker
+        and skew the round barrier, and a dropped async-equivalent slice
+        costs the same as one staleness reject."""
         with self._lock:
             stores = list(self._stores)
             wids = list(self._wids) or [worker_id] * len(stores)
             shard_steps = list(self._shard_steps)
         n = len(stores)
+        ranges = self._route_ranges()
         slices: list[dict] = [{} for _ in range(n)]
         for name, g in gradients.items():
-            slices[shard_for_key(name, n)][name] = g
+            slices[self._owner(name, n, ranges)][name] = g
         ok = True
         for i, s in enumerate(stores):
             step = shard_steps[i] if shard_steps[i] is not None \
                 else fetched_step
             ok = s.push(wids[i], slices[i], int(step)) and ok
+            disowned = s.last_disowned
+            if disowned:
+                s.last_disowned = []
+                ok = self._reroute_disowned(
+                    i, disowned, slices[i], stores, wids, shard_steps,
+                    fetched_step) and ok
+        return ok
+
+    def _reroute_disowned(self, src: int, disowned, src_slice: dict,
+                          stores, wids, shard_steps,
+                          fetched_step: int) -> bool:
+        """One re-route attempt for a disowned slice, against the map
+        the reply carried (already adopted by the per-shard client). No
+        recursion: a slice disowned AGAIN mid-re-route is dropped, the
+        same worst case as a stale async push. Sync mode drops outright
+        (see push's docstring)."""
+        if getattr(self.config, "mode", "sync") != "async":
+            return True
+        ranges = self._route_ranges()
+        if ranges is None:
+            return True
+        regroup: dict[int, dict] = {}
+        for k in disowned:
+            if k in src_slice:
+                j = self._owner(k, len(stores), ranges)
+                if j != src:
+                    regroup.setdefault(j, {})[k] = src_slice[k]
+        ok = True
+        for j, grads in regroup.items():
+            step = shard_steps[j] if shard_steps[j] is not None \
+                else fetched_step
+            ok = stores[j].push(wids[j], grads, int(step)) and ok
         return ok
 
     def repush_last(self, worker_id: int):

@@ -36,9 +36,12 @@ __all__ = [
     "SHARD_MAP_FIELDS",
     "SHARD_SLOTS",
     "ShardInfo",
+    "key_slot",
     "partition_keys",
     "shard_for_key",
+    "shard_for_slot",
     "slot_range",
+    "validate_ranges",
     "validate_shard_map",
 ]
 
@@ -68,20 +71,70 @@ SHARD_MAP_FIELDS = {
 }
 
 
+def key_slot(name: str, slots: int = SHARD_SLOTS) -> int:
+    """The consistent-hash slot a parameter name lives in — forever.
+    Every routing decision (canonical or live-resharded) starts here."""
+    return zlib.crc32(str(name).encode("utf-8")) % slots
+
+
 def shard_for_key(name: str, shard_count: int,
                   slots: int = SHARD_SLOTS) -> int:
-    """Owning shard index for a parameter name.
+    """Owning shard index for a parameter name under the CANONICAL
+    launch-time partition (equal contiguous ranges).
 
     crc32 over the name, folded into the fixed slot space, then mapped to
     the shard owning that slot's range. Pure and stable: every layer
     (worker fan-out, shard key filter, checkpoint identity) computes the
     same answer forever, and adding shards moves only whole slot ranges.
+    After a live reshard the authoritative answer is the published map's
+    ranges (:func:`shard_for_slot`); this stays the boot-time seed.
     """
     if shard_count < 1:
         raise ValueError(f"shard_count must be >= 1, got {shard_count}")
-    slot = zlib.crc32(str(name).encode("utf-8")) % slots
+    slot = key_slot(name, slots)
     # Contiguous ranges: shard i owns [i*slots//N, (i+1)*slots//N).
     return min(shard_count - 1, slot * shard_count // slots)
+
+
+def shard_for_slot(slot: int, ranges) -> int:
+    """Owning shard index for a slot under LIVE (possibly resharded)
+    ranges — one ``[lo, hi)`` pair per shard, contiguous and ordered
+    (what :func:`validate_ranges` guarantees). Raises ``ValueError`` if
+    no range covers the slot (a malformed map that validation rejects
+    anyway)."""
+    for i, (lo, hi) in enumerate(ranges):
+        if lo <= slot < hi:
+            return i
+    raise ValueError(f"slot {slot} not covered by ranges {list(ranges)}")
+
+
+def validate_ranges(ranges, shard_count: int,
+                    slots: int = SHARD_SLOTS) -> list[tuple[int, int]]:
+    """Validate a live slot-range partition: one ``[lo, hi)`` per shard,
+    ordered, contiguous (entry i starts where i-1 ended), first at 0,
+    last at ``slots`` — together: disjoint and covering. Empty ranges
+    (``lo == hi``) are legal: a merge can leave a shard owning nothing.
+    Returns normalized tuples; raises ``ValueError`` on anything else."""
+    if len(ranges) != shard_count:
+        raise ValueError(f"need one slot range per shard: got "
+                         f"{len(ranges)} for shard_count={shard_count}")
+    norm: list[tuple[int, int]] = []
+    prev_hi = 0
+    for i, pair in enumerate(ranges):
+        try:
+            lo, hi = (int(x) for x in pair)
+        except (TypeError, ValueError) as e:
+            raise ValueError(f"bad slot range {i}: {pair!r}") from e
+        if lo != prev_hi or hi < lo:
+            raise ValueError(f"slot ranges must be an ordered contiguous "
+                             f"partition: entry {i} is [{lo}, {hi}) after "
+                             f"[.., {prev_hi})")
+        norm.append((lo, hi))
+        prev_hi = hi
+    if prev_hi != slots:
+        raise ValueError(f"slot ranges cover [0, {prev_hi}), "
+                         f"want [0, {slots})")
+    return norm
 
 
 def slot_range(shard_id: int, shard_count: int,
@@ -136,10 +189,14 @@ def validate_shard_map(m) -> dict:
             replicas = [str(r) for r in s.get("replicas", [])]
         except (KeyError, TypeError, ValueError) as e:
             raise ValueError(f"bad shard entry {i}: {e}") from e
-        if sid != i or (lo, hi) != slot_range(i, shard_count, slots):
-            raise ValueError(f"bad shard entry {i}: id/range mismatch")
+        if sid != i:
+            raise ValueError(f"bad shard entry {i}: id mismatch")
         norm.append({"shard_id": sid, "slot_range": [lo, hi],
                      "primary": primary, "replicas": replicas})
+    # Ranges need not be the canonical equal split — live resharding
+    # moves boundaries — but they MUST still tile the slot space: any
+    # gap/overlap would orphan or double-own keys.
+    validate_ranges([s["slot_range"] for s in norm], shard_count, slots)
     return {"version": version, "slots": slots,
             "shard_count": shard_count, "shards": norm}
 
@@ -179,6 +236,11 @@ class ShardInfo:
         self.clock = clock
         self._lock = threading.Lock()
         self._version = 1
+        # Live slot ownership, seeded canonical; a reshard moves these
+        # boundaries (adopt_ranges) and bumps the version so every
+        # cached client map refreshes. guarded by: self._lock
+        self._ranges: list[tuple[int, int]] = [
+            slot_range(i, self.shard_count) for i in range(self.shard_count)]
         #: replica address -> {"step": int, "ts": float, "lag_steps": int}
         self._replicas: dict[str, dict] = {}
         from ..telemetry import get_registry
@@ -196,6 +258,36 @@ class ShardInfo:
     @property
     def version(self) -> int:
         with self._lock:
+            return self._version
+
+    def my_range(self) -> tuple[int, int]:
+        """The ``[lo, hi)`` slot interval THIS shard currently owns."""
+        with self._lock:
+            return self._ranges[self.shard_id]
+
+    def owns_slot(self, slot: int) -> bool:
+        with self._lock:
+            lo, hi = self._ranges[self.shard_id]
+        return lo <= slot < hi
+
+    def ranges(self) -> list[tuple[int, int]]:
+        with self._lock:
+            return list(self._ranges)
+
+    def adopt_ranges(self, ranges, version: int | None = None) -> int:
+        """Install a new live slot partition (a reshard commit or the
+        admin's post-migration broadcast). ``version``, when given, is
+        the coordinator-chosen map revision — floored at one past the
+        current version so the map NEVER goes backwards and every
+        ``have_shard_map`` client refreshes. Returns the new version.
+        Raises ``ValueError`` on a malformed partition (nothing adopted).
+        """
+        norm = validate_ranges(ranges, self.shard_count)
+        with self._lock:
+            self._ranges = norm
+            bump = self._version + 1
+            self._version = max(bump, int(version or 0))
+            self._tm_map_version.set(self._version)
             return self._version
 
     def note_replica(self, address: str, step, global_step: int) -> None:
@@ -231,6 +323,12 @@ class ShardInfo:
                 if now - r["ts"] > self.REPLICA_EXPIRE_S]
         for a in dead:
             del self._replicas[a]
+            # The departed replica's lag series must go with it — a
+            # frozen dps_replica_lag_* gauge reads as a live replica
+            # that stopped syncing, the opposite of what happened.
+            self._tm_lag.pop(a, None)
+            self._reg.remove("dps_replica_lag_steps", replica=a)
+            self._reg.remove("dps_replica_lag_seconds", replica=a)
         if dead:
             self._version += 1
             self._tm_map_version.set(self._version)
@@ -245,7 +343,7 @@ class ShardInfo:
             self._expire_locked(now)
             shards = []
             for i, primary in enumerate(self.primaries):
-                lo, hi = slot_range(i, self.shard_count)
+                lo, hi = self._ranges[i]
                 shards.append({
                     "shard_id": i, "slot_range": [lo, hi],
                     "primary": primary,
@@ -270,5 +368,6 @@ class ShardInfo:
             return {"shard_id": self.shard_id,
                     "shard_count": self.shard_count,
                     "map_version": self._version,
+                    "slot_range": list(self._ranges[self.shard_id]),
                     "primaries": list(self.primaries),
                     "replicas": replicas}

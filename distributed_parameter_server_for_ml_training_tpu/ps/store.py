@@ -719,6 +719,56 @@ class AggregationBase(TelemetryMixin, MembershipMixin):
             self.parameters = new
             self.global_step = int(step)
 
+    # -- live-migration surface (docs/SHARDING.md "Migration protocol") ------
+
+    def param_names(self) -> list[str]:
+        """Current parameter names (a migration derives the slot-range
+        subset from these; cheap — no tensor copies)."""
+        with self._param_lock:
+            return list(self.parameters.keys())
+
+    def export_params(self, names) -> tuple[dict[str, np.ndarray], int]:
+        """Consistent (subset copy, global_step) for a slot-range handoff
+        — the donor half of a live reshard. Unknown names are skipped
+        (the admin derives the subset from slots, not from this store's
+        key list). Same host-conversion discipline as :meth:`snapshot`.
+        """
+        wanted = set(names)
+        device_arrays = getattr(self, "keeps_device_arrays", False)
+        with self._param_lock:
+            params = {k: (v if device_arrays else v.copy())
+                      for k, v in self.parameters.items() if k in wanted}
+            step = self.global_step
+        if device_arrays:
+            params = {k: np.asarray(v) for k, v in params.items()}
+        return params, step
+
+    def adopt_params(self, params: Mapping[str, np.ndarray]) -> int:
+        """Graft migrated tensors into this store (the recipient half of
+        a handoff). Existing names are overwritten — the donor's copy is
+        newer by protocol (it stopped applying to the range at export).
+        Returns how many tensors were adopted."""
+        if getattr(self, "keeps_device_arrays", False):
+            import jax.numpy as jnp
+            new = {k: jnp.asarray(v, jnp.float32)
+                   for k, v in params.items()}
+        else:
+            new = {k: np.array(v, np.float32) for k, v in params.items()}
+        with self._param_lock:
+            self.parameters.update(new)
+        return len(new)
+
+    def drop_params(self, names) -> int:
+        """Release tensors this shard no longer owns (the donor's commit
+        step, after the recipient confirmed adoption). Returns how many
+        were dropped."""
+        wanted = set(names)
+        with self._param_lock:
+            mine = [k for k in self.parameters if k in wanted]
+            for k in mine:
+                del self.parameters[k]
+        return len(mine)
+
     # -- observability -------------------------------------------------------
 
     def metrics(self) -> dict:

@@ -35,6 +35,7 @@ from .registry import (
     get_registry,
     register_build_info,
 )
+from .autoscale import AutoscalePolicy, ReplicaAutoscaler
 from .cluster import (
     ClusterMonitor,
     get_cluster_monitor,
@@ -77,6 +78,7 @@ from .trace import (
 __all__ = [
     "ACTION_CATALOG",
     "Alert",
+    "AutoscalePolicy",
     "BYTES_BUCKETS",
     "ClusterMonitor",
     "ClusterState",
@@ -91,6 +93,7 @@ __all__ = [
     "RULE_CATALOG",
     "RemediationEngine",
     "RemediationPolicy",
+    "ReplicaAutoscaler",
     "STALENESS_BUCKETS",
     "SPAN_CATALOG",
     "SnapshotEmitter",

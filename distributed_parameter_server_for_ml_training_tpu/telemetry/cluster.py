@@ -171,6 +171,11 @@ class ClusterMonitor:
         #: --shard-count wires it) — the surface the remediation engine
         #: and `cli status` read to act on a lagging replica.
         self.sharding = None
+        #: Optional ReplicaAutoscaler (telemetry/autoscale.py); when set,
+        #: the background tick drives its control loop and
+        #: cluster_view() carries its state under "autoscale" (cli serve
+        #: --autoscale wires it).
+        self.autoscaler = None
 
         reg = registry or get_registry()
         # Alert counters pre-created for every rule so a scrape shows the
@@ -414,6 +419,11 @@ class ClusterMonitor:
                 out["sharding"] = self.sharding.view()
             except Exception:  # noqa: BLE001
                 pass
+        if self.autoscaler is not None:
+            try:
+                out["autoscale"] = self.autoscaler.view()
+            except Exception:  # noqa: BLE001
+                pass
         return out
 
     # -- snapshot-stream record ---------------------------------------------
@@ -445,6 +455,11 @@ class ClusterMonitor:
                     self.evaluate()
             except Exception:  # noqa: BLE001
                 pass  # the monitor must never take the server down
+            if self.autoscaler is not None:
+                try:
+                    self.autoscaler.tick()
+                except Exception:  # noqa: BLE001
+                    pass  # scaling must never take the server down
 
     def start(self) -> "ClusterMonitor":
         if self._thread is not None:

@@ -230,6 +230,20 @@ class MetricsRegistry:
                   **labels) -> Histogram:
         return self._get_or_create(Histogram, name, labels, buckets=buckets)
 
+    def remove(self, name: str, **labels) -> bool:
+        """Drop one labelled series from both read surfaces. Returns
+        whether anything was removed. This is the lifecycle half the
+        get-or-create idiom lacks: a label set keyed on a DYNAMIC member
+        (``dps_replica_lag_steps{replica=...}``) outlives the member and
+        serves its last value forever unless the owner that learned of
+        the departure removes the series. Holders keeping a stale
+        reference can still record into it; it just stops being
+        collected — and a later get-or-create mints a fresh instrument.
+        """
+        key = name + _label_key(labels)
+        with self._lock:
+            return self._instruments.pop(key, None) is not None
+
     def collect(self) -> list:
         """All live instruments, sorted by key (stable output ordering)."""
         with self._lock:

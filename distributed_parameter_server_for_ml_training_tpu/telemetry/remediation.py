@@ -74,6 +74,12 @@ ACTION_CATALOG = {
                   "feedback)",
     "refetch": "post a `refetch_params` directive: drop the delta "
                "basis, take a full fresh fetch",
+    "replica_grow": "spawn one read replica — decided by the "
+                    "autoscaler (telemetry/autoscale.py) from windowed "
+                    "fetch QPS, executed by the ReplicaPool",
+    "replica_shrink": "retire the youngest read replica when fetch "
+                      "load stays under the low-water mark and no "
+                      "replica lags",
 }
 
 #: Every outcome an action decision can record. Counters are pre-created

@@ -1,0 +1,28 @@
+"""Latency-summary math shared by the load generator and `cli infer`.
+
+Kept separate from the load generator so the math has fast unit tests:
+the slow-marker audit (scripts/lint.sh) slow-marks any test file that
+touches the generator itself, and percentile arithmetic should not need
+a gRPC fleet to verify.
+"""
+
+from __future__ import annotations
+
+__all__ = ["latency_summary", "percentile"]
+
+
+def percentile(sorted_vals: list[float], p: float) -> float:
+    """Nearest-rank percentile over an already-sorted sample."""
+    if not sorted_vals:
+        return 0.0
+    k = round(p / 100.0 * (len(sorted_vals) - 1))
+    return sorted_vals[min(len(sorted_vals) - 1, max(0, k))]
+
+
+def latency_summary(lat_s: list[float]) -> dict:
+    """p50/p95/p99 in milliseconds (the LOADGEN_JSON convention)."""
+    s = sorted(lat_s)
+    return {"p50": round(percentile(s, 50) * 1e3, 3),
+            "p95": round(percentile(s, 95) * 1e3, 3),
+            "p99": round(percentile(s, 99) * 1e3, 3),
+            "samples": len(s)}

@@ -1,0 +1,652 @@
+"""Recorded elastic-serve-tier demo (ISSUE 11 acceptance evidence).
+
+Three cells under ``experiments/results/elastic_serve/``, every check
+exit-code-verified (the PR 4-9 recorded-demo format). All processes are
+real ``cli`` subprocesses; the driver talks to them only over the wire.
+
+**Cell A — live slot-range migration under client load.** Two shard
+primaries (``--shard-count 2``) take a continuous ``cli loadgen`` full-
+fetch stream while ``cli reshard`` moves the upper half of shard 0's
+slot range to shard 1 (export -> import -> apply_ranges -> commit).
+Checks: the loadgen window spanning the migration records ZERO failed
+fetches; a push token applied on the donor BEFORE the handoff, replayed
+byte-identical against the recipient AFTER it, answers ``duplicate``
+with params and step unmoved (the journal travelled with the range —
+exactly-once across the handoff); a client still on the stale map has
+its push disowned by the donor and re-routed exactly once — the moved
+tensor shows exactly ONE SGD application; both primaries publish the
+bumped map to their clients through the delta handshake.
+
+**Cell B — replica autoscaler closes the loop.** One primary with
+``--autoscale`` (max 2, short cooldown, fast health tick). A delta-mode
+loadgen ramp drives windowed fetch QPS over the high-water mark: the
+fleet must grow to max, the grown ``cli replica`` children must announce
+themselves into the shard map, and after the ramp ends the fleet must
+shrink back to min — all read live from ``GET /cluster``'s ``autoscale``
+block (grow/shrink action counts, bounded event log, live count).
+
+**Cell C — canary-gated inference serving.** One primary + one
+``--canary`` replica (50% split, 5-sample windows). The driver pushes
+step 1 (candidate) and runs ``cli loadgen --fetch-mode infer``: constant
+quality promotes the candidate (promotions counter, stable step gauge),
+with both arms' request counts and latency percentiles visible in
+LOADGEN_JSON. Then it pushes step 2 and scores it 0.0 via an in-process
+``run_loadgen(quality_fn=...)``: the replica must ROLL BACK (rollback
+counter), keep serving the promoted step 1, and fence step 2. A final
+``cli infer`` confirms post-rollback requests all serve the stable arm.
+
+Artifacts: ``elastic_serve.json`` (summary + PASS/FAIL checks), per-cell
+loadgen/reshard/autoscale JSON, cluster captures, and process logs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT_DIR = os.path.join(REPO, "experiments", "results", "elastic_serve")
+PKG = "distributed_parameter_server_for_ml_training_tpu"
+sys.path.insert(0, REPO)
+
+MODEL = "vit_tiny"
+LR = 0.1                     # serve default (StoreConfig.learning_rate)
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _env(**extra) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def _http(url: str, timeout: float = 5.0) -> str | None:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.read().decode()
+    except Exception:
+        return None
+
+
+def _cluster(port: int) -> dict | None:
+    raw = _http(f"http://127.0.0.1:{port}/cluster")
+    if raw is None:
+        return None
+    try:
+        return json.loads(raw)
+    except ValueError:
+        return None
+
+
+def _metric_value(metrics_text: str | None, name: str,
+                  labels: str = "") -> float | None:
+    if not metrics_text:
+        return None
+    import re
+    pat = re.compile(rf"^{re.escape(name)}{re.escape(labels)} (\S+)$",
+                     re.M)
+    m = pat.search(metrics_text)
+    return float(m.group(1)) if m else None
+
+
+def _spawn(argv: list, log_path: str, **env_extra):
+    log = open(log_path, "w")
+    proc = subprocess.Popen(argv, stdout=log, stderr=subprocess.STDOUT,
+                            env=_env(**env_extra), cwd=REPO)
+    return proc, log
+
+
+def _stop(proc, log, grace: float = 15.0) -> int | None:
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=grace)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=grace)
+    log.close()
+    return proc.returncode
+
+
+def _serve_argv(*, port: int, metrics_port: int, mode: str = "async",
+                extra: list[str] | None = None) -> list:
+    return [sys.executable, "-m", f"{PKG}.cli", "serve",
+            "--mode", mode, "--workers", "1",
+            "--port", str(port), "--model", MODEL, "--num-classes", "100",
+            "--image-size", "32", "--platform", "cpu",
+            "--metrics-port", str(metrics_port)] + (extra or [])
+
+
+def _wait_up(metrics_port: int, proc, what: str,
+             timeout: float = 180.0) -> None:
+    deadline = time.time() + timeout
+    while _cluster(metrics_port) is None:
+        if time.time() > deadline or proc.poll() is not None:
+            raise RuntimeError(f"{what} never came up (rc={proc.poll()})")
+        time.sleep(0.25)
+
+
+def _grpc_up(addr: str, timeout: float = 60.0) -> None:
+    from distributed_parameter_server_for_ml_training_tpu.comms.loadgen \
+        import run_loadgen
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        r = run_loadgen([addr], duration_s=0.2, concurrency=1,
+                        rpc_timeout=2.0)
+        if r["fetches_ok"] > 0:
+            return
+        time.sleep(0.5)
+    raise RuntimeError(f"no PS answering at {addr}")
+
+
+def _loadgen_proc(targets: list[str], mode: str, duration: float,
+                  concurrency: int = 4) -> subprocess.Popen:
+    return subprocess.Popen(
+        [sys.executable, "-m", f"{PKG}.cli", "loadgen",
+         "--targets", ",".join(targets), "--duration", str(duration),
+         "--concurrency", str(concurrency), "--fetch-mode", mode],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=_env(), cwd=REPO)
+
+
+def _json_line(text: str, prefix: str) -> dict | None:
+    out = None
+    for line in (text or "").splitlines():
+        if line.startswith(prefix):
+            out = json.loads(line[len(prefix):])
+    return out
+
+
+def _raw_stub(addr: str, method: str):
+    import grpc
+    from distributed_parameter_server_for_ml_training_tpu.comms.service \
+        import GRPC_OPTIONS, SERVICE_NAME
+    ident = lambda b: b  # noqa: E731
+    channel = grpc.insecure_channel(addr, options=GRPC_OPTIONS)
+    return channel, channel.unary_unary(
+        f"/{SERVICE_NAME}/{method}",
+        request_serializer=ident, response_deserializer=ident)
+
+
+# ---------------------------------------------------------------------------
+# Cell A: live migration under client load
+# ---------------------------------------------------------------------------
+
+def cell_a() -> tuple[dict, dict]:
+    import numpy as np
+
+    from distributed_parameter_server_for_ml_training_tpu.comms.client \
+        import RemoteStore
+    from distributed_parameter_server_for_ml_training_tpu.comms.service \
+        import pack_msg, unpack_msg
+    from distributed_parameter_server_for_ml_training_tpu.comms.sharded \
+        import ShardedRemoteStore
+    from distributed_parameter_server_for_ml_training_tpu.comms.wire \
+        import encode_tensor_dict
+    from distributed_parameter_server_for_ml_training_tpu.ps.sharding \
+        import key_slot
+
+    procs = []
+    try:
+        ports = [_free_port(), _free_port()]
+        mports = [_free_port(), _free_port()]
+        peers = ",".join(f"localhost:{p}" for p in ports)
+        for i in range(2):
+            sp, slog = _spawn(
+                _serve_argv(port=ports[i], metrics_port=mports[i],
+                            extra=["--shard-index", str(i),
+                                   "--shard-count", "2",
+                                   "--shard-peers", peers]),
+                os.path.join(OUT_DIR, f"a_shard{i}_server.log"))
+            procs.append((sp, slog))
+        for i in range(2):
+            _wait_up(mports[i], procs[i][0], f"cell A shard {i}")
+
+        # Stale-map client: registers NOW (map v1), pushes only after the
+        # migration bumped the map — its moved-key slice must be disowned
+        # by the donor and re-routed exactly once.
+        stale = ShardedRemoteStore(peers)
+        wid, _ = stale.register_worker("elastic-stale")
+        params, step0 = stale.fetch(wid)
+        old_version = (stale.shard_map or {}).get("version")
+
+        slots0 = sorted({key_slot(n) for n in params if key_slot(n) < 32})
+        lo = slots0[len(slots0) // 2]
+        if lo == 0:
+            lo = next(s for s in slots0 if s > 0)
+        moved = sorted(n for n in params if lo <= key_slot(n) < 32)
+        kept = sorted(n for n in params if key_slot(n) < lo)
+        k_parity, k_route = moved[0], moved[-1]
+
+        # Pre-handoff tokened push on the donor: its journal entry must
+        # survive the migration.
+        rs0 = RemoteStore(f"localhost:{ports[0]}")
+        rs1 = RemoteStore(f"localhost:{ports[1]}")
+        widp, _ = rs0.register_worker("elastic-parity")
+        rs1.register_worker("elastic-parity")
+        pparams, pstep = rs0.fetch(widp)
+        g_parity = np.full_like(pparams[k_parity], 0.25)
+        parity_req = pack_msg(
+            {"worker_id": widp, "fetched_step": pstep,
+             "push_token": "elastic-parity:1"},
+            encode_tensor_dict({k_parity: g_parity}))
+        ch0, push0 = _raw_stub(f"localhost:{ports[0]}", "PushGradrients")
+        first, _ = unpack_msg(push0(parity_req, timeout=10.0))
+        v_parity_donor = rs0.fetch(widp)[0][k_parity].copy()
+
+        # Client load spanning the whole migration window.
+        lg = _loadgen_proc([f"localhost:{p}" for p in ports], "full",
+                           duration=12.0, concurrency=4)
+        time.sleep(1.5)
+        rp = subprocess.run(
+            [sys.executable, "-m", f"{PKG}.cli", "reshard",
+             "--primaries", peers, "--donor", "0", "--recipient", "1",
+             "--slots", f"{lo}:32", "--json"],
+            capture_output=True, text=True, env=_env(), cwd=REPO,
+            timeout=120)
+        reshard = _json_line(rp.stdout, "RESHARD_JSON ")
+        with open(os.path.join(OUT_DIR, "a_reshard.json"), "w") as f:
+            json.dump({"rc": rp.returncode, "result": reshard,
+                       "stderr": rp.stderr[-2000:]}, f, indent=2)
+
+        # Journal parity: byte-identical replay against the RECIPIENT.
+        r1_before, r1_step_before = rs1.fetch(None)
+        ch1, push1 = _raw_stub(f"localhost:{ports[1]}", "PushGradrients")
+        replay, _ = unpack_msg(push1(parity_req, timeout=10.0))
+        r1_after, r1_step_after = rs1.fetch(None)
+        ch0.close(), ch1.close()
+
+        # Stale-map push: donor disowns the moved key, the sharded client
+        # re-routes it once; exactly one SGD application must land.
+        v_route_before = r1_after[k_route].copy()
+        grads = {k_route: np.full_like(params[k_route], 0.5)}
+        if kept:
+            grads[kept[0]] = np.full_like(params[kept[0]], 0.5)
+        push_ok = stale.push(wid, grads, step0)
+        v_route_after = rs1.fetch(None)[0][k_route]
+        new_version = (stale.shard_map or {}).get("version")
+
+        lg_out, _ = lg.communicate(timeout=60)
+        lg_rc = lg.returncode
+        loadgen = _json_line(lg_out, "LOADGEN_JSON ")
+        with open(os.path.join(OUT_DIR, "a_loadgen.json"), "w") as f:
+            json.dump({"rc": lg_rc, "result": loadgen}, f, indent=2)
+
+        # Both primaries publish the bumped map through the delta
+        # handshake (have_shard_map rode the fetches above for rs1; rs0
+        # needs one more fetch to learn it).
+        rs0.fetch(None)
+        maps = [rs0.shard_map, rs1.shard_map]
+        for s in (rs0, rs1):
+            s.close()
+        stale.close()
+
+        want_ranges = [[0, lo], [lo, 64]]
+        record = {
+            "slots_moved": [lo, 32],
+            "moved_params": len(moved),
+            "kept_params": len(kept),
+            "reshard_rc": rp.returncode,
+            "reshard": reshard,
+            "loadgen": {k: (loadgen or {}).get(k)
+                        for k in ("fetches_ok", "fetches_err", "qps",
+                                  "latency_ms", "errors_by_target")},
+            "parity_first": {k: first.get(k)
+                             for k in ("accepted", "duplicate")},
+            "parity_replay": {k: replay.get(k)
+                              for k in ("accepted", "duplicate")},
+            "recipient_step_around_replay": [r1_step_before,
+                                             r1_step_after],
+            "stale_push_ok": bool(push_ok),
+            "map_versions_after": [(m or {}).get("version")
+                                   for m in maps],
+            "old_map_version": old_version,
+        }
+        checks = {
+            "A_reshard_protocol_completed":
+                rp.returncode == 0 and reshard is not None
+                and reshard["exported"] >= 1
+                and reshard["adopted"] == reshard["exported"]
+                and reshard["journal_loaded"] >= 1
+                and reshard["dropped"] >= 1
+                and reshard["ranges"] == want_ranges,
+            "A_zero_failed_fetches_under_migration":
+                lg_rc == 0 and loadgen is not None
+                and loadgen["fetches_ok"] > 0
+                and loadgen["fetches_err"] == 0,
+            "A_params_travelled_with_range":
+                np.array_equal(r1_before[k_parity], v_parity_donor),
+            "A_journal_parity_replay_deduped":
+                bool(first.get("accepted"))
+                and not first.get("duplicate")
+                and bool(replay.get("duplicate"))
+                and bool(replay.get("accepted"))
+                and np.array_equal(r1_before[k_parity],
+                                   r1_after[k_parity])
+                and r1_step_before == r1_step_after,
+            "A_stale_push_rerouted_exactly_once":
+                push_ok
+                and bool(np.allclose(v_route_after,
+                                     v_route_before - LR * 0.5,
+                                     atol=1e-6)),
+            "A_bumped_map_published_to_clients":
+                record["map_versions_after"]
+                == [reshard["map_version"]] * 2 if reshard else False,
+        }
+        return record, checks
+    finally:
+        for proc, log in procs:
+            _stop(proc, log)
+
+
+# ---------------------------------------------------------------------------
+# Cell B: replica autoscaler grow/shrink from measured QPS
+# ---------------------------------------------------------------------------
+
+def cell_b() -> tuple[dict, dict]:
+    port, mport = _free_port(), _free_port()
+    # The pool's `cli replica` children inherit the primary's env:
+    # DPS_REPLICA_POLL=0.5 keeps their delta polls (2 Hz each) far below
+    # the qps_low water mark, so an idle fleet can actually shrink.
+    proc, log = _spawn(
+        _serve_argv(port=port, metrics_port=mport,
+                    extra=["--shard-count", "1",
+                           "--shard-peers", f"localhost:{port}",
+                           "--autoscale",
+                           "--autoscale-min", "0",
+                           "--autoscale-max", "2",
+                           "--autoscale-qps-high", "100",
+                           "--autoscale-qps-low", "10",
+                           "--autoscale-cooldown", "1.5",
+                           "--health-interval", "0.5"]),
+        os.path.join(OUT_DIR, "b_primary.log"),
+        DPS_REPLICA_POLL=0.5)
+    try:
+        _wait_up(mport, proc, "cell B primary")
+        lg = _loadgen_proc([f"localhost:{port}"], "delta",
+                           duration=14.0, concurrency=4)
+        samples = []
+        max_live = max_announced = 0
+        while lg.poll() is None:
+            view = _cluster(mport) or {}
+            asc = view.get("autoscale") or {}
+            live = int(asc.get("live") or 0)
+            announced = len((view.get("sharding") or {})
+                            .get("replicas") or [])
+            max_live = max(max_live, live)
+            max_announced = max(max_announced, announced)
+            samples.append({"t": round(time.time(), 2), "live": live,
+                            "announced": announced})
+            time.sleep(0.5)
+        lg_out, _ = lg.communicate(timeout=30)
+        loadgen = _json_line(lg_out, "LOADGEN_JSON ")
+
+        # Ramp over: QPS collapses to replica polls; the fleet must
+        # shrink back to min. Keep sampling (announce can trail spawn).
+        shrunk_to_min = False
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            view = _cluster(mport) or {}
+            asc = view.get("autoscale") or {}
+            live = int(asc.get("live") or 0)
+            announced = len((view.get("sharding") or {})
+                            .get("replicas") or [])
+            max_live = max(max_live, live)
+            max_announced = max(max_announced, announced)
+            samples.append({"t": round(time.time(), 2), "live": live,
+                            "announced": announced})
+            if live == 0 and (asc.get("actions") or {}) \
+                    .get("replica_shrink", 0) >= 2:
+                shrunk_to_min = True
+                break
+            time.sleep(0.5)
+        final_view = _cluster(mport) or {}
+        asc = final_view.get("autoscale") or {}
+        live_gauge = _metric_value(
+            _http(f"http://127.0.0.1:{mport}/metrics"),
+            "dps_replicas_live")
+        with open(os.path.join(OUT_DIR, "b_autoscale.json"), "w") as f:
+            json.dump({"final_view": asc, "samples": samples,
+                       "loadgen": loadgen}, f, indent=2)
+
+        actions = asc.get("actions") or {}
+        record = {
+            "ramp_qps": (loadgen or {}).get("qps"),
+            "max_live_observed": max_live,
+            "max_replicas_announced": max_announced,
+            "final_live": asc.get("live"),
+            "final_replicas_live_gauge": live_gauge,
+            "actions": actions,
+            "events_tail": (asc.get("events") or [])[-8:],
+        }
+        checks = {
+            "B_ramp_loadgen_clean":
+                lg.returncode == 0 and loadgen is not None
+                and loadgen["fetches_err"] == 0
+                and (loadgen["qps"] or 0) > 100,
+            "B_grew_to_max_under_ramp": max_live == 2,
+            "B_grown_replicas_announced_into_shard_map":
+                max_announced >= 1,
+            "B_shrank_to_min_after_ramp":
+                shrunk_to_min and asc.get("live") == 0
+                and live_gauge == 0,
+            "B_actions_counted":
+                actions.get("replica_grow", 0) >= 2
+                and actions.get("replica_shrink", 0) >= 2,
+        }
+        return record, checks
+    finally:
+        _stop(proc, log)
+
+
+# ---------------------------------------------------------------------------
+# Cell C: canary-gated inference — promote, then forced rollback
+# ---------------------------------------------------------------------------
+
+def cell_c() -> tuple[dict, dict]:
+    import numpy as np
+
+    from distributed_parameter_server_for_ml_training_tpu.comms.client \
+        import RemoteStore
+    from distributed_parameter_server_for_ml_training_tpu.comms.loadgen \
+        import run_loadgen
+
+    procs = []
+    try:
+        port, mport = _free_port(), _free_port()
+        primary, plog = _spawn(
+            _serve_argv(port=port, metrics_port=mport,
+                        extra=["--shard-count", "1",
+                               "--shard-peers", f"localhost:{port}"]),
+            os.path.join(OUT_DIR, "c_primary.log"))
+        procs.append((primary, plog))
+        _wait_up(mport, primary, "cell C primary")
+
+        rp, rmport = _free_port(), _free_port()
+        rep, rlog = _spawn(
+            [sys.executable, "-m", f"{PKG}.cli", "replica",
+             "--primary", f"localhost:{port}", "--port", str(rp),
+             "--poll-interval", "0.02", "--staleness-bound", "30",
+             "--canary", "--canary-fraction", "0.5",
+             "--canary-min-samples", "5",
+             "--metrics-port", str(rmport)],
+            os.path.join(OUT_DIR, "c_replica.log"))
+        procs.append((rep, rlog))
+        _grpc_up(f"localhost:{rp}")
+
+        rs = RemoteStore(f"localhost:{port}")
+        wid, _ = rs.register_worker("elastic-canary")
+        params, step = rs.fetch(wid)
+        name = sorted(params)[0]
+        g = np.full_like(params[name], 0.01)
+
+        def advance() -> int:
+            nonlocal step
+            rs.push(wid, {name: g}, step)
+            step = rs.fetch(wid)[1]
+            return step
+
+        def rep_metric(mname: str, labels: str = "") -> float | None:
+            return _metric_value(
+                _http(f"http://127.0.0.1:{rmport}/metrics"),
+                mname, labels)
+
+        def wait_replica_at(want: int, timeout: float = 20.0) -> None:
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                if (rep_metric("dps_replica_step") or -1) >= want:
+                    return
+                time.sleep(0.1)
+            raise RuntimeError(f"replica never reached step {want}")
+
+        # Phase 1 — candidate step 1, constant quality => PROMOTE.
+        advance()
+        wait_replica_at(1)
+        p1 = subprocess.run(
+            [sys.executable, "-m", f"{PKG}.cli", "loadgen",
+             "--targets", f"localhost:{rp}", "--duration", "4",
+             "--concurrency", "2", "--fetch-mode", "infer"],
+            capture_output=True, text=True, env=_env(), cwd=REPO,
+            timeout=120)
+        promote_lg = _json_line(p1.stdout, "LOADGEN_JSON ")
+        with open(os.path.join(OUT_DIR, "c_loadgen_promote.json"),
+                  "w") as f:
+            json.dump({"rc": p1.returncode, "result": promote_lg},
+                      f, indent=2)
+        promotions = rep_metric("dps_canary_promotions_total")
+        stable_after_promote = rep_metric("dps_canary_stable_step")
+
+        # Phase 2 — candidate step 2 scored 0.0 => ROLLBACK.
+        advance()
+        wait_replica_at(2)
+        rollback_lg = run_loadgen(
+            [f"localhost:{rp}"], duration_s=4.0, concurrency=2,
+            mode="infer",
+            quality_fn=lambda s: 0.0 if s >= 2 else 1.0)
+        with open(os.path.join(OUT_DIR, "c_loadgen_rollback.json"),
+                  "w") as f:
+            json.dump(rollback_lg, f, indent=2)
+        rollbacks = rep_metric("dps_canary_rollbacks_total")
+        stable_after_rollback = rep_metric("dps_canary_stable_step")
+
+        # Post-rollback: `cli infer` must see only the stable arm at the
+        # promoted step (step 2 is fenced).
+        pi = subprocess.run(
+            [sys.executable, "-m", f"{PKG}.cli", "infer",
+             "--target", f"localhost:{rp}", "--count", "6", "--json"],
+            capture_output=True, text=True, env=_env(), cwd=REPO,
+            timeout=60)
+        infer = _json_line(pi.stdout, "INFER_JSON ")
+        with open(os.path.join(OUT_DIR, "c_infer.json"), "w") as f:
+            json.dump({"rc": pi.returncode, "result": infer}, f,
+                      indent=2)
+        rs.close()
+
+        arms1 = (promote_lg or {}).get("arms") or {}
+        arms2 = rollback_lg.get("arms") or {}
+        record = {
+            "promotions_total": promotions,
+            "rollbacks_total": rollbacks,
+            "stable_step_after_promote": stable_after_promote,
+            "stable_step_after_rollback": stable_after_rollback,
+            "promote_arms": {a: {k: r.get(k) for k in
+                                 ("ok", "quality_mean", "latency_ms",
+                                  "serving_steps")}
+                             for a, r in arms1.items()},
+            "rollback_arms": {a: {k: r.get(k) for k in
+                                  ("ok", "quality_mean", "latency_ms",
+                                   "serving_steps")}
+                              for a, r in arms2.items()},
+            "post_rollback_served": (infer or {}).get("served"),
+        }
+        served = (infer or {}).get("served") or []
+        checks = {
+            "C_promoted_on_quality":
+                p1.returncode == 0 and (promotions or 0) >= 1
+                and stable_after_promote == 1,
+            "C_split_visible_in_loadgen":
+                arms1.get("stable", {}).get("ok", 0) > 0
+                and arms1.get("canary", {}).get("ok", 0) > 0
+                and arms1.get("canary", {}).get(
+                    "latency_ms", {}).get("samples", 0) > 0,
+            "C_rollback_on_regression":
+                (rollbacks or 0) >= 1 and stable_after_rollback == 1
+                and arms2.get("canary", {}).get("serving_steps") == [2]
+                and arms2.get("stable", {}).get("serving_steps") == [1],
+            "C_post_rollback_serves_stable_only":
+                pi.returncode == 0 and len(served) == 6
+                and all(r["arm"] == "stable" and r["serving_step"] == 1
+                        for r in served),
+        }
+        return record, checks
+    finally:
+        for proc, log in procs:
+            _stop(proc, log)
+
+
+def main(argv=None) -> int:
+    import argparse
+    global OUT_DIR
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out-dir", default=OUT_DIR,
+                    help="artifact directory (default: the recorded "
+                         "experiments/results/elastic_serve)")
+    args = ap.parse_args(argv)
+    OUT_DIR = args.out_dir
+    os.makedirs(OUT_DIR, exist_ok=True)
+    t0 = time.time()
+    checks: dict = {}
+
+    a_rec, a_checks = cell_a()
+    checks.update(a_checks)
+    print(f"cell A: moved slots {a_rec['slots_moved']} "
+          f"({a_rec['moved_params']} tensors) under "
+          f"{a_rec['loadgen']['fetches_ok']} live fetches, "
+          f"{a_rec['loadgen']['fetches_err']} failed", flush=True)
+
+    b_rec, b_checks = cell_b()
+    checks.update(b_checks)
+    print(f"cell B: ramp {b_rec['ramp_qps']} qps -> fleet peaked at "
+          f"{b_rec['max_live_observed']}, settled at "
+          f"{b_rec['final_live']} ({b_rec['actions']})", flush=True)
+
+    c_rec, c_checks = cell_c()
+    checks.update(c_checks)
+    print(f"cell C: promotions={c_rec['promotions_total']} "
+          f"rollbacks={c_rec['rollbacks_total']}, stable step held at "
+          f"{c_rec['stable_step_after_rollback']}", flush=True)
+
+    record = {
+        "demo": "elastic serve tier: live resharding, replica "
+                "autoscaling, canary-gated inference (ISSUE 11)",
+        "elapsed_seconds": round(time.time() - t0, 1),
+        "environment": {"cpus": os.cpu_count()},
+        "checks": checks,
+        "all_pass": all(checks.values()),
+        "cell_a": a_rec,
+        "cell_b": b_rec,
+        "cell_c": c_rec,
+    }
+    with open(os.path.join(OUT_DIR, "elastic_serve.json"), "w") as f:
+        json.dump(record, f, indent=2)
+    n_pass = sum(bool(v) for v in checks.values())
+    print(f"elastic serve demo: {n_pass}/{len(checks)} checks PASS "
+          f"({record['elapsed_seconds']}s)")
+    for cname, ok in checks.items():
+        print(f"  [{'PASS' if ok else 'FAIL'}] {cname}")
+    return 0 if record["all_pass"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -540,6 +540,67 @@ class TestShardedExactlyOnce:
             assert store.global_step == 2
             np.testing.assert_array_equal(store.parameters["w"], w_after)
 
+    def test_push_token_survives_handoff_and_recipient_restart(
+            self, tmp_path):
+        """ISSUE 11: exactly-once must span a LIVE slot-range handoff
+        (docs/SHARDING.md "Migration protocol") and then the recipient's
+        own crash — the donor's journal travels with the params, the
+        recipient snapshots it as its own, and the pre-handoff token
+        still answers ``duplicate`` after the recipient restarts."""
+        from distributed_parameter_server_for_ml_training_tpu.ps.sharding \
+            import ShardInfo, key_slot
+        i = 0
+        while not 16 <= key_slot(f"hk{i}") < 32:
+            i += 1
+        k = f"hk{i}"
+
+        def shard(idx, params):
+            store = ParameterStore(params, StoreConfig(
+                mode="sync", total_workers=1, push_codec="none",
+                shard_index=idx, shard_count=2))
+            store.register_worker()
+            svc = ParameterService(store, sharding=ShardInfo(
+                idx, 2, ["a:1", "b:2"]))
+            return store, svc
+
+        donor_store, donor_svc = shard(0, {k: np.ones(4, np.float32)})
+        req = pack_msg(
+            {"worker_id": 0, "fetched_step": 0, "push_token": "hand:1"},
+            encode_tensor_dict({k: np.full(4, 0.5, np.float32)}))
+        m1, _ = unpack_msg(donor_svc.push_gradrients(req, None))
+        assert m1["accepted"] and donor_store.global_step == 1
+        applied = donor_store.parameters[k].copy()
+
+        # Handoff [16,32) to shard 1: params + journal move together.
+        emeta, payload = unpack_msg(donor_svc.reshard(
+            pack_msg({"op": "export", "slot_lo": 16, "slot_hi": 32}),
+            None))
+        rec_store, rec_svc = shard(1, {})
+        imeta, _ = unpack_msg(rec_svc.reshard(
+            pack_msg({"op": "import", "journal": emeta["journal"]},
+                     payload), None))
+        assert imeta["adopted"] == 1 and imeta["journal_loaded"] >= 1
+        for svc in (donor_svc, rec_svc):
+            svc.reshard(pack_msg({"op": "apply_ranges",
+                                  "ranges": [[0, 16], [16, 64]],
+                                  "map_version": 9}), None)
+        donor_svc.reshard(pack_msg({"op": "commit", "slot_lo": 16,
+                                    "slot_hi": 32}), None)
+
+        # The recipient dies and restores from ITS snapshot — which now
+        # journals the donor's pre-handoff outcome as its own.
+        save_store(rec_store, str(tmp_path),
+                   journal_fn=rec_svc.journal_snapshot)
+        rec_store2, rec_svc2 = shard(1, {})
+        step, journal_n = restore_server_state(rec_store2, rec_svc2,
+                                               str(tmp_path))
+        assert journal_n >= 1
+
+        m2, _ = unpack_msg(rec_svc2.push_gradrients(req, None))
+        assert m2.get("duplicate") is True and m2["accepted"]
+        np.testing.assert_array_equal(rec_store2.parameters[k], applied)
+        assert rec_store2.global_step == step   # replay moved nothing
+
 
 class TestFaultInjection:
     def test_same_seed_same_schedule(self):

@@ -1,0 +1,690 @@
+"""Elastic serve tier tests (docs/SHARDING.md "Migration protocol" /
+"Elastic serve tier", tier-1).
+
+Layers covered, cheapest first:
+
+- live-range pure functions: ``validate_ranges`` / ``shard_for_slot`` /
+  ``key_slot`` and ``ShardInfo.adopt_ranges`` version/boundary semantics;
+- metric-series lifecycle: ``MetricsRegistry.remove`` and the
+  ``dps_replica_lag_*`` series dying WITH the replica that owned them;
+- latency math: the nearest-rank percentile summary the load generator
+  and ``cli infer`` report (telemetry/stats.py);
+- ``CanaryController``: deterministic split, promote/rollback state
+  machine, rolled-back steps stay fenced, stale feedback dropped;
+- canary serving end-to-end: a canary replica over in-process gRPC
+  promotes on good quality and rolls back an injected regression;
+- the migration protocol over direct service calls AND over the wire:
+  params move, the map version converges everywhere, exactly-once
+  journal parity survives the handoff;
+- ``ReplicaAutoscaler`` against a fake pool/QPS source/clock: grow on
+  load, shrink on idle, lag blocks shrink, cooldown and dry-run record
+  without acting;
+- ``ReplicaPool`` with fake processes: grow/shrink/reap/stop.
+"""
+
+import time
+
+import grpc
+import numpy as np
+import pytest
+
+from distributed_parameter_server_for_ml_training_tpu.comms import (
+    RemoteStore, ReplicaServer, encode_tensor_dict, serve)
+from distributed_parameter_server_for_ml_training_tpu.comms.replica import (
+    CanaryController)
+from distributed_parameter_server_for_ml_training_tpu.comms.service import (
+    GRPC_OPTIONS, SERVICE_NAME, ParameterService, pack_msg, unpack_msg)
+from distributed_parameter_server_for_ml_training_tpu.ps import (
+    ParameterStore, StoreConfig)
+from distributed_parameter_server_for_ml_training_tpu.ps.sharding import (
+    SHARD_SLOTS, ShardInfo, key_slot, shard_for_slot, validate_ranges)
+from distributed_parameter_server_for_ml_training_tpu.ps.supervisor import (
+    ReplicaPool, build_replica_argv)
+from distributed_parameter_server_for_ml_training_tpu.telemetry import (
+    get_registry)
+from distributed_parameter_server_for_ml_training_tpu.telemetry.autoscale \
+    import AutoscalePolicy, ReplicaAutoscaler
+from distributed_parameter_server_for_ml_training_tpu.telemetry.registry \
+    import MetricsRegistry
+from distributed_parameter_server_for_ml_training_tpu.telemetry.stats import (
+    latency_summary, percentile)
+
+
+class TestLiveRanges:
+    def test_key_slot_pure_and_in_space(self):
+        for name in ("w", "layer0/kernel", "layer9/bias"):
+            s = key_slot(name)
+            assert s == key_slot(name)
+            assert 0 <= s < SHARD_SLOTS
+
+    def test_validate_ranges_accepts_canonical_and_empty(self):
+        assert validate_ranges([(0, 32), (32, 64)], 2) \
+            == [(0, 32), (32, 64)]
+        # A merge can leave a shard owning nothing.
+        assert validate_ranges([(0, 0), (0, 64)], 2) == [(0, 0), (0, 64)]
+
+    def test_validate_ranges_rejects_malformed(self):
+        with pytest.raises(ValueError):
+            validate_ranges([(0, 64)], 2)             # wrong count
+        with pytest.raises(ValueError):
+            validate_ranges([(0, 30), (32, 64)], 2)   # gap
+        with pytest.raises(ValueError):
+            validate_ranges([(0, 40), (32, 64)], 2)   # overlap
+        with pytest.raises(ValueError):
+            validate_ranges([(0, 32), (32, 60)], 2)   # short of the space
+        with pytest.raises(ValueError):
+            validate_ranges([(0, 32), (40, 32)], 2)   # hi < lo
+
+    def test_shard_for_slot_skips_empty_ranges(self):
+        ranges = [(0, 16), (16, 16), (16, 64)]
+        assert shard_for_slot(0, ranges) == 0
+        assert shard_for_slot(16, ranges) == 2        # empty range 1
+        assert shard_for_slot(63, ranges) == 2
+        with pytest.raises(ValueError):
+            shard_for_slot(64, ranges)
+
+    def test_adopt_ranges_moves_boundary_and_version_forward(self):
+        si = ShardInfo(0, 2, ["a:1", "b:2"])
+        v0 = si.version
+        assert si.my_range() == (0, 32)
+        v1 = si.adopt_ranges([(0, 20), (20, 64)])
+        assert v1 > v0 and si.my_range() == (0, 20)
+        assert si.shard_map()["shards"][1]["slot_range"] == [20, 64]
+        # Coordinator-chosen revision wins when ahead...
+        assert si.adopt_ranges([(0, 24), (24, 64)], version=100) == 100
+        # ...but the map NEVER goes backwards.
+        assert si.adopt_ranges([(0, 28), (28, 64)], version=5) == 101
+
+    def test_adopt_ranges_rejects_and_keeps_current(self):
+        si = ShardInfo(0, 2, ["a:1", "b:2"])
+        with pytest.raises(ValueError):
+            si.adopt_ranges([(0, 10), (12, 64)])
+        assert si.my_range() == (0, 32)
+
+
+class TestMetricSeriesLifecycle:
+    def test_remove_drops_series_then_recreate_mints_fresh(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("dps_t_lag", replica="r:1")
+        g.set(7.0)
+        assert "dps_t_lag{replica=r:1}" in reg.snapshot()["gauges"]
+        assert reg.remove("dps_t_lag", replica="r:1") is True
+        assert "dps_t_lag{replica=r:1}" not in reg.snapshot()["gauges"]
+        assert reg.remove("dps_t_lag", replica="r:1") is False
+        # A holder keeping the stale handle can still record; it just
+        # stops being collected. Re-creation starts clean.
+        g.set(9.0)
+        g2 = reg.gauge("dps_t_lag", replica="r:1")
+        assert g2 is not g
+        assert reg.snapshot()["gauges"]["dps_t_lag{replica=r:1}"] == 0.0
+
+    def test_expired_replica_takes_its_lag_series_with_it(self):
+        """ISSUE 11 satellite: a frozen dps_replica_lag_* gauge for a
+        departed replica reads as a live replica that stopped syncing —
+        the expiry that drops the member must drop its series."""
+        t = [0.0]
+        si = ShardInfo(0, 1, ["a:1"], clock=lambda: t[0])
+        addr = "expire-me:9941"
+        si.note_replica(addr, 3, 5)
+        keys = (f"dps_replica_lag_steps{{replica={addr}}}",
+                f"dps_replica_lag_seconds{{replica={addr}}}")
+        gauges = get_registry().snapshot()["gauges"]
+        assert all(k in gauges for k in keys)
+        t[0] = ShardInfo.REPLICA_EXPIRE_S + 1.0
+        assert si.shard_map()["shards"][0]["replicas"] == []
+        gauges = get_registry().snapshot()["gauges"]
+        assert all(k not in gauges for k in keys)
+
+
+class TestLatencyStats:
+    def test_percentile_nearest_rank(self):
+        assert percentile([], 99) == 0.0
+        vals = [float(i) for i in range(1, 101)]
+        assert percentile(vals, 0) == 1.0
+        # Nearest-rank on an even-sized sample rounds up the midpoint.
+        assert percentile(vals, 50) == 51.0
+        assert percentile(vals, 95) == 95.0
+        assert percentile(vals, 100) == 100.0
+        assert percentile([42.0], 99) == 42.0
+
+    def test_latency_summary_reports_ms(self):
+        s = latency_summary([0.001, 0.002, 0.100])
+        assert s["samples"] == 3
+        assert s["p50"] == 2.0
+        assert s["p99"] == 100.0
+        assert latency_summary([]) == {"p50": 0.0, "p95": 0.0,
+                                       "p99": 0.0, "samples": 0}
+
+
+class TestCanaryController:
+    def test_fraction_validation_and_period(self):
+        assert CanaryController(fraction=0.05).period == 20
+        assert CanaryController(fraction=0.5).period == 2
+        for bad in (0.0, -0.1, 0.6):
+            with pytest.raises(ValueError):
+                CanaryController(fraction=bad)
+
+    def test_first_step_is_stable_newer_becomes_candidate(self):
+        c = CanaryController(fraction=0.5, min_samples=2)
+        c.offer(3)
+        assert (c.stable_step, c.canary_step) == (3, None)
+        assert c.pick_arm() == "stable"        # no candidate: all stable
+        c.offer(5)
+        assert c.canary_step == 5
+        arms = [c.pick_arm() for _ in range(8)]
+        assert arms.count("canary") == 4       # deterministic 1/2 split
+        c.offer(4)                             # older than candidate
+        assert c.canary_step == 5
+
+    def test_promote_adopts_candidate_and_its_window(self):
+        c = CanaryController(fraction=0.5, min_samples=2)
+        c.offer(1)
+        c.offer(2)
+        for _ in range(2):
+            c.note_quality("stable", 1, 0.8)
+            c.note_quality("canary", 2, 0.9)
+        assert c.decide() == "promote"
+        assert (c.stable_step, c.canary_step) == (2, None)
+        assert c.promotions == 1 and c.rollbacks == 0
+        assert c.pick_arm() == "stable"
+
+    def test_rollback_fences_the_step_forever(self):
+        c = CanaryController(fraction=0.5, min_samples=2)
+        c.offer(1)
+        c.offer(2)
+        for _ in range(2):
+            c.note_quality("stable", 1, 0.9)
+            c.note_quality("canary", 2, 0.1)
+        assert c.decide() == "rollback"
+        assert c.stable_step == 1 and c.canary_step is None
+        assert c.bad_steps == {2} and c.rollbacks == 1
+        c.offer(2)                              # never re-offered
+        assert c.canary_step is None
+        c.offer(3)                              # a NEW step still can
+        assert c.canary_step == 3
+
+    def test_stale_feedback_dropped_and_decide_waits(self):
+        c = CanaryController(fraction=0.5, min_samples=2, tolerance=0.05)
+        c.offer(1)
+        c.offer(2)
+        c.note_quality("canary", 99, 0.0)       # not the current step
+        c.note_quality("stable", 1, 1.0)
+        c.note_quality("stable", 1, 1.0)
+        assert c.decide() is None               # canary window not full
+        # Within tolerance counts as good enough to promote.
+        c.note_quality("canary", 2, 0.97)
+        c.note_quality("canary", 2, 0.97)
+        assert c.decide() == "promote"
+
+
+def _infer_stub(addr):
+    ident = lambda b: b  # noqa: E731
+    channel = grpc.insecure_channel(addr, options=GRPC_OPTIONS)
+    stub = channel.unary_unary(f"/{SERVICE_NAME}/FetchParameters",
+                               request_serializer=ident,
+                               response_deserializer=ident)
+    return channel, stub
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+class TestCanaryServing:
+    """Canary-gated inference against a real primary + replica pair."""
+
+    def _tier(self):
+        store = ParameterStore(
+            {"w": np.zeros(8, np.float32)},
+            StoreConfig(mode="async", total_workers=1, push_codec="none"))
+        store.register_worker()
+        svc = ParameterService(store,
+                               sharding=ShardInfo(0, 1, ["pending"]))
+        server, port = serve(store, port=0, service=svc)
+        rep = ReplicaServer(f"localhost:{port}", poll_interval=0.02,
+                            staleness_bound_s=30.0, canary=True,
+                            canary_fraction=0.5, canary_min_samples=3)
+        rport = rep.start()
+        return store, server, rep, rport
+
+    def _drive(self, stub, quality_of, n):
+        """n infer requests; each carries feedback for the previous
+        reply, scored by ``quality_of(arm, step)``. Returns per-arm
+        serve counts."""
+        counts = {"stable": 0, "canary": 0}
+        meta: dict = {"infer": True}
+        for _ in range(n):
+            rmeta, _ = unpack_msg(stub(pack_msg(meta), timeout=10.0))
+            arm = rmeta["arm"]
+            step = int(rmeta["serving_step"])
+            counts[arm] += 1
+            meta = {"infer": True,
+                    "quality": {"arm": arm, "step": step,
+                                "value": quality_of(arm, step)}}
+        return counts
+
+    def test_promote_then_forced_rollback(self):
+        store, server, rep, rport = self._tier()
+        channel = None
+        try:
+            assert _wait(lambda: rep.view()["synced"])
+            assert rep.canary.stable_step == 0    # first sync = stable
+            channel, stub = _infer_stub(f"localhost:{rport}")
+
+            # A new primary step becomes the canary candidate.
+            store.push(0, {"w": np.ones(8, np.float32)}, 0)
+            assert _wait(lambda: rep.view()["step"] == 1)
+            assert rep.canary.canary_step == 1
+
+            # Equal quality on both arms -> promote; serving_step moves.
+            counts = self._drive(stub, lambda arm, step: 1.0, 40)
+            assert counts["canary"] > 0
+            assert rep.canary.promotions == 1
+            assert rep.canary.stable_step == 1
+            rmeta, payload = unpack_msg(stub(pack_msg({"infer": True}),
+                                             timeout=10.0))
+            assert int(rmeta["serving_step"]) == 1 and len(payload) > 0
+
+            # An injected regression on the next step -> rollback, and
+            # the stable arm keeps serving the promoted step.
+            store.push(0, {"w": np.ones(8, np.float32)}, 1)
+            assert _wait(lambda: rep.canary.canary_step == 2)
+            bad = lambda arm, step: 0.0 if arm == "canary" else 1.0  # noqa: E731
+            self._drive(stub, bad, 40)
+            assert rep.canary.rollbacks == 1
+            assert rep.canary.stable_step == 1
+            assert rep.canary.bad_steps == {2}
+            assert rep.view()["canary"]["stable_step"] == 1
+            # Every subsequent infer serves stable at the good step.
+            for _ in range(4):
+                rmeta, _ = unpack_msg(stub(pack_msg({"infer": True}),
+                                           timeout=10.0))
+                assert rmeta["arm"] == "stable"
+                assert int(rmeta["serving_step"]) == 1
+        finally:
+            if channel is not None:
+                channel.close()
+            rep.stop()
+            server.stop(grace=None)
+
+    def test_plain_fetch_unchanged_and_noncanary_ignores_infer(self):
+        store = ParameterStore(
+            {"w": np.zeros(4, np.float32)},
+            StoreConfig(mode="async", total_workers=1, push_codec="none"))
+        svc = ParameterService(store,
+                               sharding=ShardInfo(0, 1, ["pending"]))
+        server, port = serve(store, port=0, service=svc)
+        rep = ReplicaServer(f"localhost:{port}", poll_interval=0.02,
+                            staleness_bound_s=30.0)   # canary OFF
+        client = None
+        try:
+            rport = rep.start()
+            assert _wait(lambda: rep.view()["synced"])
+            client = RemoteStore(f"localhost:{rport}")
+            params, step = client.fetch()
+            assert step == 0 and "w" in params
+            channel, stub = _infer_stub(f"localhost:{rport}")
+            try:
+                rmeta, payload = unpack_msg(
+                    stub(pack_msg({"infer": True}), timeout=10.0))
+                # Served like a plain fetch: no arm stamping.
+                assert "arm" not in rmeta and len(payload) > 0
+            finally:
+                channel.close()
+            assert "canary" not in rep.view()
+        finally:
+            if client is not None:
+                client.close()
+            rep.stop()
+            server.stop(grace=None)
+
+
+def _sharded_pair(keys, mode="sync"):
+    """Two in-process shard primaries over gRPC, canonical partition."""
+    servers, addrs, stores, svcs = [], [], [], []
+    for i in range(2):
+        store = ParameterStore(
+            {k: np.full(4, 1.0, np.float32)
+             for k in keys if key_slot(k) // 32 == i},
+            StoreConfig(mode=mode, total_workers=1, push_codec="none",
+                        shard_index=i, shard_count=2))
+        svc = ParameterService(store,
+                               sharding=ShardInfo(i, 2, ["pending"] * 2))
+        server, port = serve(store, port=0, service=svc)
+        servers.append(server)
+        addrs.append(f"localhost:{port}")
+        stores.append(store)
+        svcs.append(svc)
+    return servers, addrs, stores, svcs
+
+
+def _pick_keys(lo_shard_n=3, hi_shard_n=3):
+    """Parameter names with known canonical owners: ``lo`` keys hash
+    into [0,32) (shard 0), ``hi`` keys into [32,64) (shard 1)."""
+    lo, hi = [], []
+    i = 0
+    while len(lo) < lo_shard_n or len(hi) < hi_shard_n:
+        k = f"layer{i}/kernel"
+        (lo if key_slot(k) < 32 else hi).append(k)
+        i += 1
+    return lo[:lo_shard_n], hi[:hi_shard_n]
+
+
+class TestMigrationProtocol:
+    def test_export_import_apply_commit_moves_range(self):
+        lo_keys, hi_keys = _pick_keys()
+        keys = lo_keys + hi_keys
+        servers, addrs, stores, svcs = _sharded_pair(keys)
+        admin = [RemoteStore(a) for a in addrs]
+        try:
+            # Move shard 0's upper half [16,32) to shard 1 over the wire.
+            emeta, payload = admin[0].reshard_op("export", slot_lo=16,
+                                                 slot_hi=32)
+            moved = [k for k in lo_keys if 16 <= key_slot(k) < 32]
+            assert emeta["exported"] == len(moved)
+            live = emeta["shard_map"]
+            assert [tuple(s["slot_range"]) for s in live["shards"]] \
+                == [(0, 32), (32, 64)]
+            imeta, _ = admin[1].reshard_op("import", payload=payload,
+                                           journal=emeta.get("journal"))
+            assert imeta["adopted"] == len(moved)
+            version = live["version"] + 1
+            for a in admin:
+                ameta, _ = a.reshard_op(
+                    "apply_ranges", ranges=[[0, 16], [16, 64]],
+                    map_version=version)
+                assert ameta["map_version"] == version
+            cmeta, _ = admin[0].reshard_op("commit", slot_lo=16,
+                                           slot_hi=32)
+            assert cmeta["dropped"] == len(moved)
+            # Params moved exactly; both primaries publish the new map.
+            for k in moved:
+                assert k in stores[1].parameters
+                assert k not in stores[0].parameters
+            for svc in svcs:
+                m = svc.sharding.shard_map()
+                assert m["version"] == version
+                assert [tuple(s["slot_range"]) for s in m["shards"]] \
+                    == [(0, 16), (16, 64)]
+            # The full model still reassembles through the fan-out.
+            merged = dict(stores[0].parameters)
+            merged.update(stores[1].parameters)
+            assert sorted(merged) == sorted(keys)
+        finally:
+            for a in admin:
+                a.close()
+            for s in servers:
+                s.stop(grace=None)
+
+    def test_export_freezes_range_until_apply(self):
+        """Between export and apply_ranges the donor still OWNS the
+        range by the map, but pushes touching it are disowned (the
+        draining freeze) — and an apply that keeps the range un-freezes
+        it (aborted handoff)."""
+        lo_keys, _ = _pick_keys(2, 0)
+        k = next(k for k in lo_keys if key_slot(k) < 16)
+        store = ParameterStore(
+            {k: np.ones(4, np.float32)},
+            StoreConfig(mode="sync", total_workers=1, push_codec="none",
+                        shard_index=0, shard_count=2))
+        store.register_worker()
+        svc = ParameterService(store,
+                               sharding=ShardInfo(0, 2, ["a:1", "b:2"]))
+        svc.reshard(pack_msg({"op": "export", "slot_lo": 0,
+                              "slot_hi": 16}), None)
+        req = pack_msg(
+            {"worker_id": 0, "fetched_step": 0},
+            encode_tensor_dict({k: np.ones(4, np.float32)}))
+        m, _ = unpack_msg(svc.push_gradrients(req, None))
+        assert m["disowned"] == [k]
+        assert "shard_map" in m
+        np.testing.assert_array_equal(store.parameters[k],
+                                      np.ones(4, np.float32))
+        # Abort: re-apply the CURRENT ranges -> freeze cleared. (The
+        # disowned push still reported this worker, so its round closed
+        # with an empty apply — the retry pushes at the new step.)
+        svc.reshard(pack_msg({"op": "apply_ranges",
+                              "ranges": [[0, 32], [32, 64]]}), None)
+        req2 = pack_msg(
+            {"worker_id": 0, "fetched_step": store.global_step},
+            encode_tensor_dict({k: np.ones(4, np.float32)}))
+        m2, _ = unpack_msg(svc.push_gradrients(req2, None))
+        assert "disowned" not in m2 and m2["accepted"]
+
+    def test_journal_parity_across_handoff(self):
+        """A push token consumed on the donor BEFORE the migration must
+        answer ``duplicate`` on the recipient AFTER it — exactly-once
+        survives the handoff because the journal travels with the
+        params."""
+        lo_keys, _ = _pick_keys(2, 0)
+        k = next(k for k in lo_keys if 16 <= key_slot(k) < 32)
+        mk = lambda i: ParameterStore(  # noqa: E731
+            {k: np.ones(4, np.float32)} if i == 0 else {},
+            StoreConfig(mode="sync", total_workers=1, push_codec="none",
+                        shard_index=i, shard_count=2))
+        stores = [mk(0), mk(1)]
+        svcs = [ParameterService(s, sharding=ShardInfo(
+            i, 2, ["a:1", "b:2"])) for i, s in enumerate(stores)]
+        for s in stores:
+            s.register_worker()
+        req = pack_msg(
+            {"worker_id": 0, "fetched_step": 0, "push_token": "mig:1"},
+            encode_tensor_dict({k: np.full(4, 0.5, np.float32)}))
+        m1, _ = unpack_msg(svcs[0].push_gradrients(req, None))
+        assert m1["accepted"] and stores[0].global_step == 1
+        applied = stores[0].parameters[k].copy()
+
+        emeta, payload = unpack_msg(svcs[0].reshard(
+            pack_msg({"op": "export", "slot_lo": 16, "slot_hi": 32}),
+            None))
+        imeta, _ = unpack_msg(svcs[1].reshard(
+            pack_msg({"op": "import", "journal": emeta["journal"]},
+                     payload), None))
+        assert imeta["adopted"] == 1 and imeta["journal_loaded"] >= 1
+        for svc in svcs:
+            svc.reshard(pack_msg({"op": "apply_ranges",
+                                  "ranges": [[0, 16], [16, 64]],
+                                  "map_version": 7}), None)
+        svcs[0].reshard(pack_msg({"op": "commit", "slot_lo": 16,
+                                  "slot_hi": 32}), None)
+
+        # The client's retry of the pre-handoff token lands on the NEW
+        # owner: replayed from the journal, never re-applied.
+        m2, _ = unpack_msg(svcs[1].push_gradrients(req, None))
+        assert m2.get("duplicate") is True and m2["accepted"]
+        np.testing.assert_array_equal(stores[1].parameters[k], applied)
+        assert stores[1].global_step == 0   # replay closed no round
+
+
+class _FakePool:
+    def __init__(self, live=0):
+        self.live = live
+        self.grown = 0
+        self.shrunk = 0
+
+    def count(self):
+        return self.live
+
+    def grow(self):
+        self.live += 1
+        self.grown += 1
+        return self.live - 1
+
+    def shrink(self):
+        if self.live == 0:
+            return None
+        self.live -= 1
+        self.shrunk += 1
+        return self.live
+
+
+class TestReplicaAutoscaler:
+    def _scaler(self, pool, policy, qps_source, t, sharding=None):
+        return ReplicaAutoscaler(
+            pool, policy, sharding=sharding, registry=MetricsRegistry(),
+            clock=lambda: t[0], fetch_total_fn=lambda: qps_source[0])
+
+    def test_grow_on_load_then_cooldown_then_grow_to_max(self):
+        pool = _FakePool()
+        t, fetches = [0.0], [0.0]
+        asc = self._scaler(pool, AutoscalePolicy(
+            qps_high=10.0, qps_low=1.0, cooldown_s=10.0,
+            max_replicas=2), fetches, t)
+        assert asc.tick() is None               # first tick anchors
+        t[0] += 1.0
+        fetches[0] += 100.0                     # 100 qps > high
+        ev = asc.tick()
+        assert ev["action"] == "replica_grow" and ev["outcome"] == "ok"
+        assert pool.grown == 1
+        t[0] += 1.0
+        fetches[0] += 100.0
+        ev = asc.tick()                         # still hot, but cooling
+        assert ev["outcome"] == "rate_limited" and pool.grown == 1
+        t[0] += 20.0
+        fetches[0] += 400.0                     # 20 qps over the window
+        ev = asc.tick()
+        assert ev["outcome"] == "ok" and pool.live == 2
+        t[0] += 20.0
+        fetches[0] += 800.0
+        assert asc.tick() is None               # at max: hold
+        assert asc.actions == {"replica_grow": 2, "replica_shrink": 0}
+
+    def test_shrink_on_idle_blocked_by_lag(self):
+        class _Lagged:
+            def __init__(self, lag):
+                self.lag = lag
+
+            def view(self):
+                return {"replicas": [{"lag_steps": self.lag}]}
+
+        pool = _FakePool(live=2)
+        t, fetches = [0.0], [0.0]
+        lagged = _Lagged(50.0)
+        asc = self._scaler(pool, AutoscalePolicy(
+            qps_high=10.0, qps_low=1.0, cooldown_s=0.0,
+            lag_high_steps=10.0), fetches, t, sharding=lagged)
+        asc.tick()
+        t[0] += 10.0                            # 0 qps: idle
+        assert asc.tick() is None               # lag blocks the shrink
+        assert pool.shrunk == 0
+        lagged.lag = 0.0
+        t[0] += 10.0
+        ev = asc.tick()
+        assert ev["action"] == "replica_shrink" and ev["outcome"] == "ok"
+        assert pool.live == 1
+
+    def test_min_floor_grows_regardless_of_qps(self):
+        pool = _FakePool()
+        t, fetches = [0.0], [0.0]
+        asc = self._scaler(pool, AutoscalePolicy(
+            qps_high=10.0, qps_low=1.0, cooldown_s=0.0,
+            min_replicas=1), fetches, t)
+        asc.tick()
+        t[0] += 10.0                            # idle, but under floor
+        ev = asc.tick()
+        assert ev["action"] == "replica_grow" and pool.live == 1
+        t[0] += 10.0
+        assert asc.tick() is None               # at floor, idle: hold
+
+    def test_dry_run_records_without_touching_pool(self):
+        pool = _FakePool()
+        t, fetches = [0.0], [0.0]
+        asc = self._scaler(pool, AutoscalePolicy(
+            qps_high=10.0, qps_low=1.0, cooldown_s=0.0, dry_run=True),
+            fetches, t)
+        asc.tick()
+        t[0] += 1.0
+        fetches[0] += 100.0
+        ev = asc.tick()
+        assert ev["outcome"] == "dry_run" and pool.grown == 0
+        assert asc.actions == {"replica_grow": 0, "replica_shrink": 0}
+        view = asc.view()
+        assert view["dry_run"] and view["events"][-1] is not None
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            AutoscalePolicy(qps_high=5.0, qps_low=5.0)
+        with pytest.raises(ValueError):
+            AutoscalePolicy(min_replicas=3, max_replicas=1)
+
+    def test_fetch_total_scans_fetch_shaped_counters(self):
+        reg = MetricsRegistry()
+        reg.counter("dps_rpc_handler_calls_total",
+                    rpc="FetchParameters").inc(5)
+        reg.counter("dps_rpc_handler_calls_total",
+                    rpc="PushGradrients").inc(50)   # not fetch-shaped
+        reg.counter("dps_replica_fetches_total").inc(7)
+        asc = ReplicaAutoscaler(_FakePool(), AutoscalePolicy(),
+                                registry=reg)
+        assert asc._fetch_total() == 12.0
+
+
+class _FakeProc:
+    def __init__(self, argv, env):
+        self.argv, self.env = argv, env
+        self.rc = None
+        self.terminated = False
+
+    def poll(self):
+        return self.rc
+
+    def terminate(self):
+        self.terminated = True
+        self.rc = 0
+
+    def wait(self, timeout=None):
+        return self.rc if self.rc is not None else 0
+
+    def kill(self):
+        self.rc = -9
+
+
+class TestReplicaPool:
+    def _pool(self):
+        spawned = []
+
+        def spawn(argv, env):
+            p = _FakeProc(argv, env)
+            spawned.append(p)
+            return p
+
+        pool = ReplicaPool(
+            lambda idx: build_replica_argv(
+                "localhost:9999", ["--shard-id", "0"], idx),
+            spawn=spawn, log=lambda *a, **k: None)
+        return pool, spawned
+
+    def test_build_replica_argv_shape(self):
+        argv, env = build_replica_argv("h:1", ["--shard-id", "3"], 2)
+        assert env is None
+        assert argv[1:3] == ["-m",
+                             "distributed_parameter_server_for_ml_"
+                             "training_tpu.cli"]
+        assert argv[3:6] == ["replica", "--primary", "h:1"]
+        assert argv[6:8] == ["--port", "0"]     # always ephemeral
+        assert argv[8:] == ["--shard-id", "3"]
+
+    def test_grow_shrink_youngest_and_reap(self):
+        pool, spawned = self._pool()
+        assert pool.grow() == 0 and pool.grow() == 1
+        assert pool.count() == 2
+        assert pool.shrink() == 1               # youngest goes first
+        assert spawned[1].terminated and not spawned[0].terminated
+        assert pool.count() == 1
+        spawned[0].rc = 3                       # dies on its own: reaped
+        assert pool.count() == 0
+        assert pool.shrink() is None            # empty pool
+        assert pool.status()["spawned_total"] == 2
+
+    def test_stop_terminates_everything(self):
+        pool, spawned = self._pool()
+        pool.grow()
+        pool.grow()
+        pool.stop()
+        assert all(p.terminated for p in spawned)
+        assert pool.count() == 0

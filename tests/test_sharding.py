@@ -38,8 +38,8 @@ from distributed_parameter_server_for_ml_training_tpu.comms.service import (
 from distributed_parameter_server_for_ml_training_tpu.ps import (
     ParameterStore, StoreConfig)
 from distributed_parameter_server_for_ml_training_tpu.ps.sharding import (
-    SHARD_SLOTS, ShardInfo, partition_keys, shard_for_key, slot_range,
-    validate_shard_map)
+    SHARD_SLOTS, ShardInfo, key_slot, partition_keys, shard_for_key,
+    slot_range, validate_shard_map)
 
 
 def _keys(n=40):
@@ -409,3 +409,136 @@ class TestCheckpointShardIdentity:
         assert step == 0
         np.testing.assert_array_equal(fresh.parameters["w"],
                                       store.parameters["w"])
+
+
+def _slot_key(lo, hi, taken=()):
+    """A parameter name whose consistent-hash slot lands in [lo, hi)."""
+    i = 0
+    while True:
+        k = f"mig{i}/kernel"
+        if lo <= key_slot(k) < hi and k not in taken:
+            return k
+        i += 1
+
+
+class TestMigrationRefreshRace:
+    """ISSUE 11 satellite: a worker pushing on a shard map that moved
+    mid-migration is re-routed (async) or dropped (sync) — its gradient
+    is applied at most once, never on both primaries."""
+
+    def _topology(self, mode, keys_by_shard):
+        servers, addrs, stores, svcs = [], [], [], []
+        for i in range(2):
+            store = ParameterStore(
+                {k: np.ones(4, np.float32) for k in keys_by_shard[i]},
+                StoreConfig(mode=mode, total_workers=1,
+                            push_codec="none", shard_index=i,
+                            shard_count=2))
+            svc = ParameterService(
+                store, sharding=ShardInfo(i, 2, ["pending"] * 2))
+            server, port = serve(store, port=0, service=svc)
+            servers.append(server)
+            addrs.append(f"localhost:{port}")
+            stores.append(store)
+            svcs.append(svc)
+        return servers, addrs, stores, svcs
+
+    def _migrate(self, svcs, lo=16, hi=32):
+        """Server-side [lo,hi) handoff shard 0 -> 1 while clients keep
+        their cached (now stale) map."""
+        emeta, payload = unpack_msg(svcs[0].reshard(
+            pack_msg({"op": "export", "slot_lo": lo, "slot_hi": hi}),
+            None))
+        svcs[1].reshard(pack_msg(
+            {"op": "import", "journal": emeta["journal"]}, payload), None)
+        version = emeta["shard_map"]["version"] + 1
+        for svc in svcs:
+            svc.reshard(pack_msg({"op": "apply_ranges",
+                                  "ranges": [[0, lo], [lo, 64]],
+                                  "map_version": version}), None)
+        svcs[0].reshard(pack_msg({"op": "commit", "slot_lo": lo,
+                                  "slot_hi": hi}), None)
+        return version
+
+    def _reference_apply(self, mode, key, value, grad):
+        """What ONE application of ``grad`` produces under this store's
+        update rule — the double-apply detector."""
+        ref = ParameterStore(
+            {key: np.full(4, value, np.float32)},
+            StoreConfig(mode=mode, total_workers=1, push_codec="none"))
+        ref.register_worker()
+        ref.push(0, {key: grad}, 0)
+        return ref.parameters[key]
+
+    def test_async_stale_push_rerouted_exactly_once(self):
+        stay0 = _slot_key(0, 16)
+        moved = _slot_key(16, 32)
+        stay1 = _slot_key(32, 64)
+        servers, addrs, stores, svcs = self._topology(
+            "async", [[stay0, moved], [stay1]])
+        sharded = ShardedRemoteStore(addrs, rpc_timeout=10.0)
+        try:
+            wid, _ = sharded.register_worker("w0")
+            v_stale = sharded.shard_map["version"]
+            version = self._migrate(svcs)
+            # The client still routes on the pre-migration map: the
+            # moved key goes to the donor, which disowns it with a
+            # fresh map; the client re-routes that slice once.
+            grads = {k: np.full(4, 0.5, np.float32)
+                     for k in (stay0, moved, stay1)}
+            assert sharded.push(wid, grads, 0)
+            assert sharded.shard_map["version"] == version > v_stale
+            # Applied EXACTLY once, on the new owner only.
+            assert moved not in stores[0].parameters
+            np.testing.assert_allclose(
+                stores[1].parameters[moved],
+                self._reference_apply("async", moved, 1.0,
+                                      grads[moved]), rtol=1e-6)
+            np.testing.assert_allclose(
+                stores[0].parameters[stay0],
+                self._reference_apply("async", stay0, 1.0,
+                                      grads[stay0]), rtol=1e-6)
+            # The NEXT push routes straight to the new owner: no
+            # disowned round-trip.
+            assert sharded.push(wid, grads, 1)
+            for s in svcs:
+                assert not s._draining
+        finally:
+            sharded.close()
+            for s in servers:
+                s.stop(grace=None)
+
+    def test_sync_stale_push_dropped_never_double_applied(self):
+        stay0 = _slot_key(0, 16)
+        moved = _slot_key(16, 32)
+        stay1 = _slot_key(32, 64)
+        servers, addrs, stores, svcs = self._topology(
+            "sync", [[stay0, moved], [stay1]])
+        sharded = ShardedRemoteStore(addrs, rpc_timeout=10.0)
+        try:
+            wid, _ = sharded.register_worker("w0")
+            self._migrate(svcs)
+            adopted = stores[1].parameters[moved].copy()
+            grads = {k: np.full(4, 0.5, np.float32)
+                     for k in (stay0, moved, stay1)}
+            # Sync mode: re-pushing the disowned slice would double-
+            # report this worker into the new owner's round, so it is
+            # dropped — the cost of one staleness reject, never a
+            # double apply.
+            assert sharded.push(wid, grads, 0)
+            np.testing.assert_array_equal(stores[1].parameters[moved],
+                                          adopted)
+            # Round accounting survived on both shards regardless.
+            assert stores[0].global_step == 1
+            assert stores[1].global_step == 1
+            # The client adopted the pushed map: the next round routes
+            # the moved key to its new owner and the gradient lands.
+            assert sharded.push(wid, grads, 1)
+            np.testing.assert_allclose(
+                stores[1].parameters[moved],
+                self._reference_apply("sync", moved, float(adopted[0]),
+                                      grads[moved]), rtol=1e-6)
+        finally:
+            sharded.close()
+            for s in servers:
+                s.stop(grace=None)

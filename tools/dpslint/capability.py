@@ -92,6 +92,27 @@ META_KEY_CATALOG: dict[str, tuple[str, ...]] = {
     # shard map: presence IS the capability (docs/SHARDING.md) — an
     # unsharded server never attaches one.
     "shard_map": (),
+    # -- live migration (admin plane + push-race surfacing) --------------
+    # Reshard request fields: only a shard primary (ShardingState
+    # present) serves the admin plane (docs/SHARDING.md "Migration
+    # protocol").
+    "op": ("sharding",),
+    "slot_lo": ("sharding",),
+    "slot_hi": ("sharding",),
+    "journal": ("sharding",),
+    "ranges": ("sharding",),
+    "map_version": ("sharding",),
+    # Reshard reply fields are read only by the coordinator (cli.py,
+    # outside comms/): export_step / exported / adopted / journal_loaded
+    # / dropped never appear as comms-side reads.
+    # A push reply's disowned list only means something to a client that
+    # holds a shard map to re-route against.
+    "disowned": ("shard_map",),
+    # -- serve tier (canary-gated inference; docs/SHARDING.md) ----------
+    "infer": ("canary",),
+    "quality": ("canary",),
+    "arm": ("canary",),
+    "serving_step": ("canary",),
 }
 
 #: Variable names treated as envelope-meta receivers in comms/.
