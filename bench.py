@@ -36,6 +36,7 @@ fail (tests prove the retry, the fallback, and the diagnostic artifact).
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import os
 import sys
@@ -311,14 +312,27 @@ def run_bench(args) -> dict:
 
         stage = "timed_trials"
         best_dt = float("inf")
-        for trial in range(args.trials):
-            t0 = time.perf_counter()
-            state, loss = window(state, images, labels, key)
-            final_loss = float(loss)  # forces completion of the whole chain
-            dt = time.perf_counter() - t0
-            print(f"trial {trial}: {dt*1e3:.1f} ms, loss {final_loss:.4f}",
-                  file=sys.stderr)
-            best_dt = min(best_dt, dt)
+        timed_wall = 0.0
+        profile_ctx = contextlib.nullcontext()
+        if getattr(args, "profile_dir", None):
+            # Perf observatory (docs/OBSERVABILITY.md): bracket ONLY the
+            # timed trials — warmup compile and the fetch probe stay out
+            # of the dump so attribution reconciles against timed wall.
+            from distributed_parameter_server_for_ml_training_tpu \
+                .telemetry.profiler import capture
+            profile_ctx = capture(args.profile_dir)
+            print(f"profiler: tracing timed trials into "
+                  f"{args.profile_dir}", file=sys.stderr)
+        with profile_ctx:
+            for trial in range(args.trials):
+                t0 = time.perf_counter()
+                state, loss = window(state, images, labels, key)
+                final_loss = float(loss)  # forces the whole chain
+                dt = time.perf_counter() - t0
+                print(f"trial {trial}: {dt*1e3:.1f} ms, "
+                      f"loss {final_loss:.4f}", file=sys.stderr)
+                best_dt = min(best_dt, dt)
+                timed_wall += dt
 
         images_per_sec = args.scan_steps * args.batch_size / best_dt
         per_chip = images_per_sec / n_chips
@@ -334,6 +348,48 @@ def run_bench(args) -> dict:
         el_bytes = {"none": 4, "bf16": 2, "fp16": 2, "int8": 1}[grad_codec]
         ring_bytes = (2 * (n_chips - 1) / n_chips * n_params * el_bytes
                       if n_chips > 1 else 0)
+        # Perf-observatory companion fields (ISSUE 12): MFU from the
+        # SINGLE step's compile-time cost analysis (never the scanned
+        # window — XLA reports whole-program flops) and the fraction of
+        # timed wall the profiler attributed to device/executable time.
+        # Only computed when a profile was captured; both are
+        # failure-hardened nulls, never a cost to the record.
+        stage = "profile_attribution"
+        mfu_value = None
+        device_time_fraction = None
+        attribution_basis = None
+        if getattr(args, "profile_dir", None):
+            from distributed_parameter_server_for_ml_training_tpu \
+                .analysis.device_profile import attribute_profile
+            from distributed_parameter_server_for_ml_training_tpu \
+                .telemetry.profiler import compiled_cost
+            from distributed_parameter_server_for_ml_training_tpu \
+                .telemetry.profiler import mfu as mfu_of
+            try:
+                step_fn = train_step if hasattr(train_step, "lower") \
+                    else jax.jit(train_step)
+                cost = compiled_cost(
+                    step_fn.lower(state, images[0], labels[0],
+                                  key).compile())
+                mfu_value = mfu_of(cost["flops"],
+                                   args.scan_steps / best_dt,
+                                   devices[0].device_kind, n_chips)
+                if mfu_value is not None:
+                    mfu_value = round(mfu_value, 4)
+            except Exception as e:  # noqa: BLE001 — null, never a crash
+                print(f"cost analysis failed (mfu recorded null): {e}",
+                      file=sys.stderr)
+            try:
+                prof = attribute_profile(args.profile_dir)["profile"]
+                if timed_wall > 0 and prof["total_attributed_s"] > 0:
+                    device_time_fraction = round(
+                        prof["total_attributed_s"]
+                        / (timed_wall * n_chips), 4)
+                    attribution_basis = prof.get("basis")
+            except Exception as e:  # noqa: BLE001 — null, never a crash
+                print(f"profile attribution failed (recording null): "
+                      f"{e}", file=sys.stderr)
+
         stage = "fetch_probe"
         fetch_qps = None
         if not getattr(args, "no_fetch_probe", False):
@@ -363,6 +419,14 @@ def run_bench(args) -> dict:
             "autoscale_actions": 0,
             "canary_promotions": 0,
             "reshard_events": 0,
+            # Perf-observatory fields (ISSUE 12): null unless this run
+            # captured a profile (--profile-dir). device_time_fraction is
+            # attributed time / (timed wall x chips); the basis says
+            # whether that attribution came from real device lanes or the
+            # CPU backend's host-execute proxy (docs/OBSERVABILITY.md).
+            "mfu": mfu_value,
+            "device_time_fraction": device_time_fraction,
+            "profile_attribution_basis": attribution_basis,
         }
         # Static-analysis attribution (ISSUE 10 satellite): whether the
         # tree this number was measured from passed dpslint, and what the
@@ -405,6 +469,11 @@ def main() -> int:
     parser.add_argument("--no-fetch-probe", action="store_true",
                         help="skip the serve-path probe (fetch_qps "
                              "recorded as null)")
+    parser.add_argument("--profile-dir", default=None,
+                        help="capture a jax.profiler trace of the timed "
+                             "trials into this directory and record "
+                             "mfu / device_time_fraction in the result "
+                             "(parse with `cli perf profile`)")
     parser.add_argument("--no-cpu-fallback", action="store_true",
                         help="fail instead of falling back to "
                              "JAX_PLATFORMS=cpu when the configured "

@@ -11,8 +11,17 @@ from .parse_logs import (
     staleness_series,
     worker_throughput_series,
 )
+from .device_profile import (
+    OP_CLASSES,
+    attribute_profile,
+    classify_op,
+    device_time_tables,
+    load_chrome_trace,
+    render_profile_table,
+)
 from .runner import run_cell, run_matrix
 from .traces import (
+    PHASES,
     assemble_traces,
     critical_path_report,
     find_trace_dumps,
@@ -22,13 +31,16 @@ from .traces import (
 )
 from .visualize import ExperimentVisualizer
 
-__all__ = ["aggregate_worker_metrics", "alert_timeline",
-           "assemble_traces",
-           "build_telemetry_timeseries", "cluster_worker_series",
-           "critical_path_report",
-           "find_trace_dumps", "load_trace_dumps",
+__all__ = ["OP_CLASSES", "PHASES",
+           "aggregate_worker_metrics", "alert_timeline",
+           "assemble_traces", "attribute_profile",
+           "build_telemetry_timeseries", "classify_op",
+           "cluster_worker_series",
+           "critical_path_report", "device_time_tables",
+           "find_trace_dumps", "load_chrome_trace", "load_trace_dumps",
            "parse_cluster_series",
            "parse_experiment", "parse_snapshot_series",
+           "render_profile_table",
            "save_chrome_trace", "staleness_series", "to_chrome_trace",
            "worker_throughput_series",
            "ExperimentVisualizer", "run_cell", "run_matrix"]

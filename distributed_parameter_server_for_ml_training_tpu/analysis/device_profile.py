@@ -1,0 +1,311 @@
+"""Parse jax.profiler dumps into device-time attribution tables.
+
+The read half of the perf observatory (write half:
+:mod:`..telemetry.profiler`). A ``--profile-dir`` capture leaves one
+Chrome-format ``*.trace.json.gz`` per host under
+``plugins/profile/<run>/``; this module turns that into the thing the
+"break the plateau" ROADMAP item needs: per-op-class device time, and a
+reconciliation against the flight-recorder ``critical_path_report`` so
+one artifact attributes each step's wall end-to-end (host phase ->
+device op class), with the unattributed residual REPORTED, not hidden.
+
+Honesty rules:
+
+- On CPU (the CI/demo platform) jax emits no ``/device:`` lanes. The
+  host lane still carries per-op thunk events (``convolution.687``,
+  ``dot.12``), which classify into real op classes (``basis:
+  "host_ops"``); a capture with no op events at all degrades to the
+  executor-wrapper time (``basis: "host_execute_proxy"``, excluding the
+  double-counting "wait for completion" variant). Either way
+  ``device_lanes_present`` stays False and the residual stays visible —
+  host attribution is never presented as measured device time.
+- Op classification is by name pattern; on device lanes anything
+  unmatched (fused kernels with opaque names) lands in ``other`` — the
+  fractions always sum to 1 over attributed time. On host lanes
+  unmatched names are python frames/bookkeeping, NOT ops, so they stay
+  unattributed rather than polluting ``other``.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import re
+
+__all__ = [
+    "OP_CLASSES",
+    "attribute_profile",
+    "classify_op",
+    "device_time_tables",
+    "load_chrome_trace",
+    "render_profile_table",
+]
+
+#: op class -> one-line meaning (docs/OBSERVABILITY.md documents exactly
+#: these rows; tools/dpslint's catalog-drift check pins the two to each
+#: other both directions).
+OP_CLASSES = {
+    "matmul": "dense MXU work: dot/matmul/gemm/einsum kernels",
+    "conv": "convolution kernels",
+    "collective": "cross-device comms: all-reduce/all-gather/"
+                  "reduce-scatter/all-to-all/permute/psum",
+    "quantize-pack": "codec arithmetic: quantize/dequantize/pack/unpack",
+    "transfer": "host<->device + on-device copies, infeed/outfeed",
+    "host_execute": "host-side executable dispatch (the CPU-backend "
+                    "proxy when no device lanes exist)",
+    "other": "unclassified device ops (opaque fusion names)",
+}
+
+#: Ordered (class, pattern) — first match wins, so collectives beat the
+#: ``dot`` inside a fused all-reduce name.
+_CLASS_PATTERNS = (
+    ("collective", re.compile(
+        r"all[-_]?reduce|all[-_]?gather|reduce[-_]?scatter|"
+        r"all[-_]?to[-_]?all|collective|psum|permute", re.I)),
+    ("quantize-pack", re.compile(r"quant|dequant|pack|unpack", re.I)),
+    ("transfer", re.compile(
+        r"copy|memcpy|infeed|outfeed|transfer|h2d|d2h", re.I)),
+    ("conv", re.compile(r"conv", re.I)),
+    ("matmul", re.compile(r"dot|matmul|gemm|einsum", re.I)),
+)
+
+#: Host events that ARE the executable running (the last-resort CPU
+#: proxy for device time). ParseArguments/donation bookkeeping etc. stay
+#: unattributed; the "(wait for completion)" variant is excluded in code
+#: because it wraps the inner Execute events and would double-count.
+_HOST_EXECUTE_RE = re.compile(
+    r"Executable::Execute|ExecuteOnLocalDevice|ThunkExecutor::Execute",
+    re.I)
+
+
+def classify_op(name: str) -> str:
+    for cls, pat in _CLASS_PATTERNS:
+        if pat.search(name):
+            return cls
+    return "other"
+
+
+def load_chrome_trace(path: str) -> dict:
+    """One dumped Chrome trace (gzipped or plain JSON)."""
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rt") as f:
+        return json.load(f)
+
+
+def _lanes(events: list) -> dict[int, str]:
+    """pid -> process name, from the "M" (metadata) events."""
+    names: dict[int, str] = {}
+    for ev in events:
+        if ev.get("ph") == "M" and ev.get("name") == "process_name":
+            pid = ev.get("pid")
+            nm = (ev.get("args") or {}).get("name")
+            if isinstance(pid, int) and isinstance(nm, str):
+                names[pid] = nm
+    return names
+
+
+def device_time_tables(trace: dict) -> dict:
+    """Per-op-class device-time table for one Chrome trace dict.
+
+    Durations are summed over complete ("X") events. Attribution basis,
+    in preference order (reported as ``"basis"``):
+
+    - ``device_lanes`` — events on ``/device:`` lanes; every event
+      counts (unmatched names -> ``other``).
+    - ``host_ops`` — no device lanes, but the host lane carries per-op
+      thunk events (CPU backend); only pattern-matched op names count.
+    - ``host_execute_proxy`` — no op events either; executor-wrapper
+      time stands in (excluding the outer "wait for completion" events,
+      which wrap the inner Execute and would double-count).
+    - ``none`` — nothing attributable.
+    """
+    events = trace.get("traceEvents") or []
+    lanes = _lanes(events)
+    device_pids = {p for p, n in lanes.items() if "/device:" in n}
+    device_ops: dict[str, dict] = {}
+    host_ops: dict[str, dict] = {}
+    host_exec: dict[str, dict] = {}
+    t_min, t_max = None, None
+
+    def add(table: dict, cls: str, dur_s: float) -> None:
+        row = table.setdefault(cls, {"time_s": 0.0, "events": 0})
+        row["time_s"] += dur_s
+        row["events"] += 1
+
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue
+        dur = ev.get("dur")
+        if not isinstance(dur, (int, float)) or dur < 0:
+            continue
+        ts = ev.get("ts")
+        if isinstance(ts, (int, float)):
+            t_min = ts if t_min is None else min(t_min, ts)
+            t_max = ts + dur if t_max is None else max(t_max, ts + dur)
+        name = str(ev.get("name", ""))
+        if device_pids:
+            if ev.get("pid") in device_pids:
+                add(device_ops, classify_op(name), dur / 1e6)  # dur: us
+        else:
+            cls = classify_op(name)
+            if cls != "other":
+                add(host_ops, cls, dur / 1e6)
+            elif _HOST_EXECUTE_RE.search(name) \
+                    and "wait for completion" not in name:
+                add(host_exec, "host_execute", dur / 1e6)
+
+    if device_pids:
+        basis, per_class = "device_lanes", device_ops
+    elif host_ops:
+        basis, per_class = "host_ops", host_ops
+    elif host_exec:
+        basis, per_class = "host_execute_proxy", host_exec
+    else:
+        basis, per_class = "none", {}
+    total = sum(r["time_s"] for r in per_class.values())
+    for row in per_class.values():
+        row["time_s"] = round(row["time_s"], 6)
+        row["fraction"] = round(row["time_s"] / total, 4) if total else 0.0
+    return {
+        "basis": basis,
+        "device_lanes_present": bool(device_pids),
+        "lanes": sorted(lanes.values()),
+        "op_classes": per_class,
+        "total_attributed_s": round(total, 6),
+        "trace_wall_s": round((t_max - t_min) / 1e6, 6)
+        if t_min is not None else 0.0,
+    }
+
+
+def _merge_tables(tables: list[dict]) -> dict:
+    """Sum per-class rows across hosts/files into one table. Mixed bases
+    (one host dumped device lanes, another only host events) keep the
+    strongest basis and sum only the files that share it — averaging a
+    proxy into measured device time would corrupt both."""
+    order = ("device_lanes", "host_ops", "host_execute_proxy", "none")
+    basis = min((t.get("basis", "none") for t in tables),
+                key=order.index, default="none")
+    counted = [t for t in tables if t.get("basis", "none") == basis]
+    merged: dict = {
+        "basis": basis,
+        "device_lanes_present": any(t["device_lanes_present"]
+                                    for t in tables),
+        "lanes": sorted({ln for t in tables for ln in t["lanes"]}),
+        "op_classes": {},
+        "total_attributed_s": 0.0,
+        "trace_wall_s": max((t["trace_wall_s"] for t in tables),
+                            default=0.0),
+    }
+    for t in counted:
+        for cls, row in t["op_classes"].items():
+            m = merged["op_classes"].setdefault(
+                cls, {"time_s": 0.0, "events": 0})
+            m["time_s"] += row["time_s"]
+            m["events"] += row["events"]
+    total = sum(r["time_s"] for r in merged["op_classes"].values())
+    merged["total_attributed_s"] = round(total, 6)
+    for row in merged["op_classes"].values():
+        row["time_s"] = round(row["time_s"], 6)
+        row["fraction"] = round(row["time_s"] / total, 4) if total else 0.0
+    return merged
+
+
+def attribute_profile(logdir: str, critical: dict | None = None,
+                      cost: dict | None = None,
+                      mfu_value: float | None = None,
+                      device_kind: str | None = None) -> dict:
+    """The merged perf-observatory artifact for one capture.
+
+    ``critical`` is an ``analysis.traces.critical_path_report`` result
+    (host-phase surface), ``cost`` a ``telemetry.profiler.compiled_cost``
+    result; both optional — whatever is absent is reported absent.
+    Reconciliation: span-level step wall vs profiler-attributed time,
+    residual = wall - attributed, clamped at 0 and REPORTED.
+    """
+    from ..telemetry.profiler import find_profile_dumps
+    paths = find_profile_dumps(logdir)
+    tables = []
+    errors = []
+    for p in paths:
+        try:
+            tables.append(device_time_tables(load_chrome_trace(p)))
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            errors.append({"file": os.path.basename(p), "error": str(e)})
+    profile = _merge_tables(tables) if tables else {
+        "basis": "none", "device_lanes_present": False, "lanes": [],
+        "op_classes": {}, "total_attributed_s": 0.0, "trace_wall_s": 0.0,
+    }
+    out: dict = {
+        "profile": profile,
+        "trace_files": [os.path.basename(p) for p in paths],
+        "parse_errors": errors,
+    }
+    if device_kind is not None:
+        out["device_kind"] = device_kind
+    if cost is not None:
+        out["cost"] = dict(cost)
+        out["cost"]["mfu"] = mfu_value
+    if critical is not None:
+        out["critical_path"] = critical
+        wall = float(sum((critical.get("phase_totals_s") or {}).values()))
+        step_wall = critical.get("step_wall_total_s")
+        if step_wall is None:  # older report shape: top-N lower bound
+            step_wall = sum(s.get("wall_s", 0.0)
+                            for s in critical.get("stragglers") or [])
+        step_wall = float(step_wall)
+        attributed = profile["total_attributed_s"]
+        out["reconciliation"] = {
+            "step_wall_s": round(step_wall, 6),
+            "phase_covered_s": round(wall, 6),
+            "attributed_s": round(attributed, 6),
+            "attribution_basis": profile.get("basis", "none"),
+            "residual_s": round(max(0.0, step_wall - attributed), 6),
+            "residual_fraction": round(
+                max(0.0, step_wall - attributed) / step_wall, 4)
+            if step_wall > 0 else None,
+        }
+    return out
+
+
+def render_profile_table(report: dict) -> str:
+    """Human-readable table for ``cli perf profile``."""
+    lines = []
+    prof = report.get("profile") or {}
+    basis_text = {
+        "device_lanes": "device lanes",
+        "host_ops": "host op events (no device lanes in this capture)",
+        "host_execute_proxy": "host-execute proxy (no device lanes or "
+                              "op events in this capture)",
+        "none": "none (nothing attributable)",
+    }
+    basis = prof.get("basis", "none")
+    lines.append(f"attribution basis: {basis_text.get(basis, basis)}")
+    rows = sorted((prof.get("op_classes") or {}).items(),
+                  key=lambda kv: -kv[1]["time_s"])
+    if rows:
+        lines.append(f"{'op class':<15} {'time_s':>12} {'share':>7} "
+                     f"{'events':>8}")
+        for cls, r in rows:
+            lines.append(f"{cls:<15} {r['time_s']:>12.6f} "
+                         f"{r['fraction']*100:>6.1f}% {r['events']:>8}")
+    else:
+        lines.append("(no attributable events in the capture)")
+    cost = report.get("cost")
+    if cost:
+        flops = cost.get("flops")
+        by = cost.get("bytes_accessed")
+        mfu_v = cost.get("mfu")
+        lines.append(f"per-step cost: flops="
+                     f"{'n/a' if flops is None else f'{flops:.3e}'} "
+                     f"bytes={'n/a' if by is None else f'{by:.3e}'}")
+        lines.append("mfu: " + ("n/a (unknown device peak)"
+                                if mfu_v is None else f"{mfu_v*100:.2f}%"))
+    rec = report.get("reconciliation")
+    if rec:
+        lines.append(
+            f"reconciliation: step wall {rec['step_wall_s']:.4f}s, "
+            f"attributed {rec['attributed_s']:.4f}s "
+            f"({rec['attribution_basis']}), residual "
+            f"{rec['residual_s']:.4f}s")
+    return "\n".join(lines)

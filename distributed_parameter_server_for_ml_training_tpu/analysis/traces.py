@@ -245,6 +245,9 @@ def critical_path_report(spans: list[dict], top: int = 10) -> dict:
     Returns::
 
         {"steps": n,
+         "step_wall_total_s": summed wall over ALL steps (not just the
+                              top-N — the perf-observatory
+                              reconciliation base),
          "phase_totals_s": {compute, fetch_wait, push_wait,
                             server_apply, codec},
          "stragglers": [top-N step entries, slowest first, each with
@@ -267,6 +270,7 @@ def critical_path_report(spans: list[dict], top: int = 10) -> dict:
         by_dom[e["dominant_phase"]] = by_dom.get(e["dominant_phase"], 0) + 1
     return {
         "steps": len(entries),
+        "step_wall_total_s": round(sum(e["wall_s"] for e in entries), 6),
         "phase_totals_s": {p: round(v, 6) for p, v in totals.items()},
         "stragglers": entries[:top],
         "by_dominant_phase": by_dom,

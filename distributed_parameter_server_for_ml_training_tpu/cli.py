@@ -372,6 +372,40 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--autoscale-dry-run", action="store_true",
                    help="decide and record scaling actions without "
                         "spawning or retiring anything")
+    s.add_argument("--profile-dir", default=None,
+                   help="capture a jax.profiler (XLA-level) trace of the "
+                        "server's apply/aggregation hot path into this "
+                        "directory (same bracket as train/worker; parse "
+                        "with `cli perf profile`)")
+    s.add_argument("--no-slo", action="store_true",
+                   help="disable the serve-tier SLO evaluator (on by "
+                        "default with the health monitor): multi-window "
+                        "error-budget burn over the server-side RPC "
+                        "latency/error metrics -> slo_burn_fast/"
+                        "slo_burn_slow alerts, GET /cluster 'slo' block "
+                        "(docs/OBSERVABILITY.md)")
+    s.add_argument("--slo-fetch-p99-ms", type=float,
+                   default=_env("DPS_SLO_FETCH_P99_MS", 100.0, float),
+                   help="fetch latency objective: 99%% of FetchParameters "
+                        "under this many milliseconds")
+    s.add_argument("--slo-availability", type=float,
+                   default=_env("DPS_SLO_AVAILABILITY", 0.99, float),
+                   help="availability objective for fetch and push "
+                        "(good fraction, e.g. 0.99)")
+    s.add_argument("--slo-fast-window", type=float,
+                   default=_env("DPS_SLO_FAST_WINDOW", 60.0, float),
+                   help="fast burn window seconds (slo_burn_fast, "
+                        "critical)")
+    s.add_argument("--slo-slow-window", type=float,
+                   default=_env("DPS_SLO_SLOW_WINDOW", 300.0, float),
+                   help="slow burn window seconds (slo_burn_slow, "
+                        "warning)")
+    s.add_argument("--slo-fast-burn", type=float,
+                   default=_env("DPS_SLO_FAST_BURN", 14.4, float),
+                   help="burn-rate threshold over the fast window")
+    s.add_argument("--slo-slow-burn", type=float,
+                   default=_env("DPS_SLO_SLOW_BURN", 6.0, float),
+                   help="burn-rate threshold over the slow window")
     add_platform(s)
     add_telemetry(s)
 
@@ -613,6 +647,53 @@ def build_parser() -> argparse.ArgumentParser:
                          "(0 = one shot)")
     st.add_argument("--json", action="store_true",
                     help="print the raw /cluster JSON instead of the table")
+
+    pf = sub.add_parser(
+        "perf",
+        help="perf observatory (docs/OBSERVABILITY.md): attribute a "
+             "--profile-dir capture into per-op-class device time "
+             "(`profile`), or run the bench-ledger regression watch "
+             "(`check`)")
+    pfsub = pf.add_subparsers(dest="perf_command", required=True)
+    pfp = pfsub.add_parser(
+        "profile",
+        help="parse a jax.profiler capture into device-time attribution "
+             "tables, optionally joined with flight-recorder dumps into "
+             "one end-to-end artifact")
+    pfp.add_argument("--profile-dir", required=True,
+                     help="the --profile-dir a train/serve/bench run "
+                          "captured into")
+    pfp.add_argument("--trace-dump-dir", default=None,
+                     help="flight-recorder dump dir (--trace-dump-dir of "
+                          "the same run): joins the host-phase "
+                          "critical-path report and reconciles step wall "
+                          "vs attributed device time")
+    pfp.add_argument("--device-kind", default=None,
+                     help="override the device kind recorded in the "
+                          "artifact (default: jax.devices()[0] if jax "
+                          "imports)")
+    pfp.add_argument("--out", default=None,
+                     help="write the merged JSON artifact here")
+    pfp.add_argument("--json", action="store_true",
+                     help="print the JSON artifact instead of the table")
+    pfc = pfsub.add_parser(
+        "check",
+        help="bench regression watch over the committed BENCH_*/"
+             "MULTICHIP_* ledger (tools/benchwatch; exit 0 pass, "
+             "1 malformed, 2 regression)")
+    pfc.add_argument("--root", default=None,
+                     help="ledger directory (default: the repo checkout "
+                          "root)")
+    pfc.add_argument("--tolerance", type=float, default=0.05,
+                     help="allowed fractional drop (default: 0.05)")
+    pfc.add_argument("--baseline-window", type=int, default=3,
+                     help="usable runs in the baseline median")
+    pfc.add_argument("--recent-window", type=int, default=1,
+                     help="usable runs in the recent median")
+    pfc.add_argument("--format", choices=("md", "json"), default="md",
+                     help="verdict format (default: md)")
+    pfc.add_argument("--validate-only", action="store_true",
+                     help="schema-validate the ledger and stop")
 
     ln = sub.add_parser(
         "lint",
@@ -917,6 +998,25 @@ def _cmd_serve(args) -> int:
             # Shard identity + replica lag ride the same /cluster payload
             # cli status renders (docs/SHARDING.md, docs/OBSERVABILITY.md).
             monitor.sharding = sharding
+        if not getattr(args, "no_slo", False):
+            # Serve-tier SLOs (docs/OBSERVABILITY.md): multi-window
+            # error-budget burn over the server-side RPC histograms,
+            # evaluated on the monitor's tick -> slo_burn_fast/
+            # slo_burn_slow alerts + the /cluster "slo" block.
+            from .telemetry import SloEvaluator, default_objectives
+            monitor.slo = SloEvaluator(
+                default_objectives(
+                    fetch_p99_ms=getattr(args, "slo_fetch_p99_ms", 100.0),
+                    availability=getattr(args, "slo_availability", 0.99)),
+                fast_window_s=getattr(args, "slo_fast_window", 60.0),
+                slow_window_s=getattr(args, "slo_slow_window", 300.0),
+                fast_burn_threshold=getattr(args, "slo_fast_burn", 14.4),
+                slow_burn_threshold=getattr(args, "slo_slow_burn", 6.0))
+            print(f"slo: evaluator on (fetch p99 "
+                  f"{monitor.slo.objectives[0].threshold_s*1e3:.0f}ms, "
+                  f"availability "
+                  f"{monitor.slo.objectives[1].target:.3g})",
+                  file=sys.stderr, flush=True)
     svc = ParameterService(store, faults=getattr(args, "faults", None),
                            monitor=monitor, sharding=sharding)
     if getattr(args, "remediate", False) \
@@ -1039,14 +1139,20 @@ def _cmd_serve(args) -> int:
         # server.py:399-403 sleep-forever loop, but exiting cleanly once all
         # registered workers report JobFinished — and, with --worker-timeout,
         # expiring silent workers each tick (failure-detection reaper).
-        while not store.wait_all_finished(timeout=1.0):
-            expired = store.expire_stale_workers()
-            if expired:
-                print(f"expired silent workers: {expired}", file=sys.stderr)
-                if monitor is not None:
-                    # Dead-worker alerts fire on the very next evaluation
-                    # instead of waiting out the report-age threshold.
-                    monitor.note_expired(expired)
+        # --profile-dir brackets the whole serving window so the XLA-level
+        # timeline covers the apply/aggregation hot path (`cli perf
+        # profile` parses the dump).
+        with _profiler_session(getattr(args, "profile_dir", None)):
+            while not store.wait_all_finished(timeout=1.0):
+                expired = store.expire_stale_workers()
+                if expired:
+                    print(f"expired silent workers: {expired}",
+                          file=sys.stderr)
+                    if monitor is not None:
+                        # Dead-worker alerts fire on the very next
+                        # evaluation instead of waiting out the
+                        # report-age threshold.
+                        monitor.note_expired(expired)
         time.sleep(0.5)
     except KeyboardInterrupt:
         pass
@@ -1292,6 +1398,39 @@ def _render_status(view: dict) -> str:
                 f"step={rep.get('step')} "
                 f"lag={rep.get('lag_steps')} step(s), "
                 f"announced {rep.get('announce_age_s', 0):.1f}s ago")
+    slo = view.get("slo")
+    if slo:
+        # Serve-tier SLOs (docs/OBSERVABILITY.md): per-objective
+        # quantiles + window burn rates. Absent block (older server, or
+        # --no-slo) renders nothing — forward/backward compatible by
+        # construction, pinned by the degradation test.
+        lines.append("")
+        lines.append("slo objectives:")
+        for obj in slo.get("objectives", []):
+            wins = obj.get("windows", {})
+            burns = []
+            for rule in sorted(wins):
+                w = wins[rule]
+                mark = " BREACH" if w.get("breaching") else ""
+                burns.append(f"{w.get('window_s', 0):g}s burn "
+                             f"{w.get('burn', 0):g}x{mark}")
+            thr = (f" p99<={obj['threshold_ms']:g}ms"
+                   if obj.get("threshold_ms") is not None else "")
+            p99 = obj.get("p99_ms")
+            p99_s = "-" if p99 is None else f"{p99:g}ms"
+            lines.append(f"  {obj.get('name')}: "
+                         f"target={obj.get('target')}{thr} "
+                         f"p99={p99_s} n={obj.get('total', 0)} "
+                         f"({'; '.join(burns) if burns else 'no windows'})")
+        breaches = slo.get("breaches", [])
+        if breaches:
+            for b in breaches:
+                lines.append(
+                    f"  [{sev_mark.get(b.get('severity'), '????')}] "
+                    f"{b.get('rule')}: {b.get('objective')} burning "
+                    f"{b.get('burn')}x budget over "
+                    f"{b.get('window_s', 0):g}s "
+                    f"({b.get('bad')}/{b.get('total')} bad)")
     return "\n".join(lines)
 
 
@@ -1302,7 +1441,11 @@ def cmd_status(args) -> int:
     the remediation engine holds active actions against them — degraded
     but healing (docs/ROBUSTNESS.md): a restart policy should hold off
     and let the self-healing run —, 1 when the endpoint is unreachable or
-    has no monitor."""
+    has no monitor. SLO breaches ride the same semantics: slo_burn_fast
+    is a critical alert (exit 2/3), slo_burn_slow a warning (exit 0) —
+    paging on fast burn only is the multi-window point. A server without
+    an "slo" block (older build, --no-slo) renders everything else
+    unchanged."""
     import json as _json
     import time as _time
     from urllib.error import HTTPError, URLError
@@ -1593,6 +1736,82 @@ def cmd_infer(args) -> int:
     return 0 if served else 1
 
 
+def cmd_perf(args) -> int:
+    if args.perf_command == "check":
+        return _cmd_perf_check(args)
+    return _cmd_perf_profile(args)
+
+
+def _cmd_perf_check(args) -> int:
+    """Delegate to tools/benchwatch — a repo-checkout tool like
+    ``cli lint`` (the ledger and the watcher live beside the package,
+    not in the wheel). Same exit codes as ``python -m tools.benchwatch``:
+    0 pass, 1 malformed ledger, 2 regression."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    if not os.path.isdir(os.path.join(root, "tools", "benchwatch")):
+        print("cli perf check: tools/benchwatch not found — run from a "
+              "repo checkout (the watcher is not shipped in the wheel)",
+              file=sys.stderr)
+        return 2
+    if root not in sys.path:
+        sys.path.insert(0, root)
+    from tools.benchwatch.__main__ import main as benchwatch_main
+    argv = ["--root", args.root or root,
+            "--tolerance", str(args.tolerance),
+            "--baseline-window", str(args.baseline_window),
+            "--recent-window", str(args.recent_window),
+            "--format", args.format]
+    if args.validate_only:
+        argv.append("--validate-only")
+    return benchwatch_main(argv)
+
+
+def _cmd_perf_profile(args) -> int:
+    """Parse a ``--profile-dir`` capture into the merged perf-observatory
+    artifact (analysis/device_profile.py): per-op-class device time,
+    optionally joined with the flight-recorder critical-path report so
+    step wall reconciles against attributed device time."""
+    import json as _json
+
+    from .analysis.device_profile import (attribute_profile,
+                                          render_profile_table)
+    critical = None
+    dump_dir = getattr(args, "trace_dump_dir", None)
+    if dump_dir:
+        from .analysis.traces import (critical_path_report,
+                                      find_trace_dumps, load_trace_dumps)
+        dumps = find_trace_dumps(dump_dir)
+        if dumps:
+            critical = critical_path_report(load_trace_dumps(dumps))
+        else:
+            print(f"perf profile: no trace-*.json dumps in {dump_dir} — "
+                  f"skipping the critical-path join", file=sys.stderr)
+    device_kind = getattr(args, "device_kind", None)
+    if device_kind is None:
+        try:
+            import jax
+            device_kind = jax.devices()[0].device_kind
+        except Exception:  # noqa: BLE001 — artifact stays usable jax-less
+            device_kind = None
+    report = attribute_profile(args.profile_dir, critical=critical,
+                               device_kind=device_kind)
+    if not report["trace_files"]:
+        print(f"perf profile: no jax.profiler dumps under "
+              f"{args.profile_dir} (expected plugins/profile/<run>/"
+              f"*.trace.json.gz)", file=sys.stderr)
+        return 1
+    if getattr(args, "out", None):
+        os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+        with open(args.out, "w") as f:
+            _json.dump(report, f, indent=2)
+        print(f"perf profile: artifact -> {args.out}", file=sys.stderr)
+    if args.json:
+        print(_json.dumps(report, indent=2))
+    else:
+        print(render_profile_table(report))
+    return 0
+
+
 def cmd_lint(args) -> int:
     """Delegate to tools/dpslint. The analyzer and its baseline live
     beside the package in the repo checkout (not in the wheel) — exactly
@@ -1621,7 +1840,8 @@ def main(argv=None) -> int:
             "experiments": cmd_experiments, "supervise": cmd_supervise,
             "status": cmd_status, "replica": cmd_replica,
             "loadgen": cmd_loadgen, "reshard": cmd_reshard,
-            "infer": cmd_infer, "lint": cmd_lint}[args.command](args)
+            "infer": cmd_infer, "lint": cmd_lint,
+            "perf": cmd_perf}[args.command](args)
 
 
 if __name__ == "__main__":

@@ -200,10 +200,15 @@ class ReplicaServer:
         self._server: grpc.Server | None = None
         self._channel = None
         self._fetch_stub = None
-        from ..telemetry import get_registry
+        from ..telemetry import LATENCY_BUCKETS, get_registry
         reg = get_registry()
         self._tm_fetches = reg.counter("dps_replica_fetches_total")
         self._tm_refreshes = reg.counter("dps_replica_refreshes_total")
+        # Refresh DURATION (wire transfer + re-pack) on the shared
+        # LATENCY_BUCKETS scheme — distinct from dps_replica_lag_seconds,
+        # which is an AGE gauge (time since last sync), not a duration.
+        self._tm_refresh_hist = reg.histogram(
+            "dps_replica_refresh_seconds", buckets=LATENCY_BUCKETS)
         self._tm_stale = reg.counter("dps_replica_stale_rejects_total")
         self._tm_redirects = reg.counter("dps_replica_redirects_total")
         self._tm_step = reg.gauge("dps_replica_step")
@@ -222,6 +227,7 @@ class ReplicaServer:
         tensor payload is never decoded here, so a replica's refresh
         cost is the wire transfer plus one envelope re-pack, regardless
         of model size."""
+        t0 = time.perf_counter()
         with self._lock:
             have = self._step
         meta: dict = {"replica": {"shard_id": self.shard_id,
@@ -234,6 +240,7 @@ class ReplicaServer:
         if rmeta.get("not_modified"):
             with self._lock:
                 self._last_sync = now
+            self._tm_refresh_hist.observe(time.perf_counter() - t0)
             return
         step = int(rmeta["global_step"])
         # Re-pack with the replica's own envelope over the primary's
@@ -255,6 +262,7 @@ class ReplicaServer:
                 self._repack_arms_locked()
         self._tm_refreshes.inc()
         self._tm_step.set(step)
+        self._tm_refresh_hist.observe(time.perf_counter() - t0)
 
     def _evict_history_locked(self) -> None:
         """Cap the step history, never evicting a step an arm is pinned

@@ -227,15 +227,24 @@ class ParameterService:
         # counters (telemetry/). Client-side spans (comms/client.py)
         # include the wire + queueing; the delta between the two
         # distributions in one snapshot stream IS the network cost.
-        from ..telemetry import get_registry
+        from ..telemetry import LATENCY_BUCKETS, get_registry
         reg = get_registry()
+        # dps_rpc_server_latency_seconds / dps_rpc_server_errors_total are
+        # the SLO-facing pair (telemetry/slo.py): the finer LATENCY_BUCKETS
+        # scheme puts an edge at every plausible p99 objective, and the
+        # error counter makes availability = errors/calls computable from
+        # snapshot deltas alone. dps_rpc_handler_seconds stays (coarser
+        # legacy edges pinned by committed snapshot history).
         self._tm_rpc = {
             name: (reg.histogram("dps_rpc_handler_seconds", rpc=name),
                    reg.counter("dps_rpc_handler_bytes_total", rpc=name,
                                direction="in"),
                    reg.counter("dps_rpc_handler_bytes_total", rpc=name,
                                direction="out"),
-                   reg.counter("dps_rpc_handler_calls_total", rpc=name))
+                   reg.counter("dps_rpc_handler_calls_total", rpc=name),
+                   reg.histogram("dps_rpc_server_latency_seconds",
+                                 buckets=LATENCY_BUCKETS, method=name),
+                   reg.counter("dps_rpc_server_errors_total", method=name))
             for name in ["RegisterWorker", "PushGradrients",
                          "FetchParameters", "JobFinished", "Reshard"]
         }
@@ -871,7 +880,7 @@ class ParameterService:
         from ..telemetry import now, trace_enabled, trace_span, \
             use_wire_context
         from .wire import peek_trace
-        hist, b_in, b_out, calls = self._tm_rpc[name]
+        hist, b_in, b_out, calls, slo_hist, errors = self._tm_rpc[name]
 
         def wrapped(request: bytes, ctx) -> bytes:
             t0 = now()
@@ -889,8 +898,17 @@ class ParameterService:
                 with use_wire_context(wire_ctx), \
                         trace_span("rpc.server", rpc=name):
                     reply = fn(request, ctx)
+            except Exception:  # noqa: BLE001 — counted, then re-raised
+                # Aborts (incl. injected unavailable/deadline faults)
+                # raise through grpc's ctx.abort — count them where the
+                # SLO availability objective reads, then let the abort
+                # propagate unchanged.
+                errors.inc()
+                raise
             finally:
-                hist.observe(now() - t0)
+                dur = now() - t0
+                hist.observe(dur)
+                slo_hist.observe(dur)
             b_out.inc(len(reply))
             return reply
 

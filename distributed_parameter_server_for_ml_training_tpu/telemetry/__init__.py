@@ -28,6 +28,7 @@ from .registry import (
     Counter,
     Gauge,
     Histogram,
+    LATENCY_BUCKETS,
     LATENCY_BUCKETS_S,
     MetricsRegistry,
     STALENESS_BUCKETS,
@@ -55,6 +56,7 @@ from .remediation import (
     RemediationPolicy,
     note_action,
 )
+from .slo import SloEvaluator, SloObjective, default_objectives
 from .snapshot import SnapshotEmitter
 from .spans import now, span
 from .prometheus import render_prometheus, start_metrics_server
@@ -88,6 +90,7 @@ __all__ = [
     "HealthRuleEngine",
     "HealthThresholds",
     "Histogram",
+    "LATENCY_BUCKETS",
     "LATENCY_BUCKETS_S",
     "MetricsRegistry",
     "RULE_CATALOG",
@@ -96,6 +99,8 @@ __all__ = [
     "ReplicaAutoscaler",
     "STALENESS_BUCKETS",
     "SPAN_CATALOG",
+    "SloEvaluator",
+    "SloObjective",
     "SnapshotEmitter",
     "TraceContext",
     "VALUE_BUCKETS",
@@ -103,6 +108,7 @@ __all__ = [
     "add_shutdown_flush",
     "current_context",
     "current_wire_trace",
+    "default_objectives",
     "disable_tracing",
     "enable_tracing",
     "get_cluster_monitor",

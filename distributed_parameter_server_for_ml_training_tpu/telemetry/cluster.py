@@ -176,6 +176,12 @@ class ClusterMonitor:
         #: cluster_view() carries its state under "autoscale" (cli serve
         #: --autoscale wires it).
         self.autoscaler = None
+        #: Optional SloEvaluator (telemetry/slo.py); when set, every
+        #: evaluation pass folds its burn-rate breaches into the
+        #: ClusterState (-> slo_burn_fast/slo_burn_slow alerts) and
+        #: cluster_view() carries its state under "slo" (cli serve
+        #: wires it unless --no-slo).
+        self.slo = None
 
         reg = registry or get_registry()
         # Alert counters pre-created for every rule so a scrape shows the
@@ -277,6 +283,12 @@ class ClusterMonitor:
         w_start, acc0, rej0 = self._push_window
         if now - w_start >= self.interval:
             self._push_window = (now, acc, rej)
+        slo_breaches: list = []
+        if self.slo is not None:
+            try:
+                slo_breaches = self.slo.evaluate(now)
+            except Exception:  # noqa: BLE001 — SLO math must not stop health
+                slo_breaches = []
         return ClusterState(
             ts=now,
             global_step=int(getattr(self.store, "global_step", 0)),
@@ -284,7 +296,8 @@ class ClusterMonitor:
             workers=workers,
             expired=expired,
             pushes_accepted_delta=max(0, acc - acc0),
-            pushes_rejected_delta=max(0, rej - rej0))
+            pushes_rejected_delta=max(0, rej - rej0),
+            slo_breaches=slo_breaches)
 
     def evaluate(self) -> list[dict]:
         """One evaluation pass; returns the new edge events. Serialized
@@ -422,6 +435,11 @@ class ClusterMonitor:
         if self.autoscaler is not None:
             try:
                 out["autoscale"] = self.autoscaler.view()
+            except Exception:  # noqa: BLE001
+                pass
+        if self.slo is not None:
+            try:
+                out["slo"] = self.slo.view()
             except Exception:  # noqa: BLE001
                 pass
         return out

@@ -78,6 +78,13 @@ RULE_CATALOG = {
     "straggler_lag": (
         "info", "a worker's reported step more than straggler_lag_steps "
                 "behind the fastest reporting worker"),
+    "slo_burn_fast": (
+        "critical", "an SLO objective's fast-window error-budget burn rate "
+                    "crossed its threshold (telemetry/slo.py; budget gone "
+                    "in hours at this rate)"),
+    "slo_burn_slow": (
+        "warning", "an SLO objective's slow-window error-budget burn rate "
+                   "crossed its threshold (sustained budget bleed)"),
 }
 
 
@@ -142,6 +149,9 @@ class ClusterState:
     #: Push outcome deltas since the last pass (async staleness gate).
     pushes_accepted_delta: int = 0
     pushes_rejected_delta: int = 0
+    #: SLO burn-rate breaches from the attached SloEvaluator this pass
+    #: (telemetry/slo.py ``evaluate()`` dicts); empty when no evaluator.
+    slo_breaches: list = field(default_factory=list)
 
 
 @dataclass
@@ -457,6 +467,23 @@ class HealthRuleEngine:
                  f"rejected by the staleness gate this window",
                  value=round(ratio, 4),
                  threshold=t.staleness_reject_ratio)
+
+        # 7) SLO burn-rate breaches (telemetry/slo.py, attached by the
+        # monitor). One aggregated alert per rule — alert identity is
+        # (rule, worker) and these are server-side conditions with no
+        # worker — naming every breaching objective, value = worst burn.
+        for rule in ("slo_burn_fast", "slo_burn_slow"):
+            hits = [b for b in state.slo_breaches
+                    if isinstance(b, dict) and b.get("rule") == rule]
+            if not hits:
+                continue
+            worst = max(hits, key=lambda b: b.get("burn") or 0.0)
+            names = ", ".join(sorted(str(b.get("objective")) for b in hits))
+            fire(rule, None,
+                 f"SLO burn over {worst.get('window_s', 0):.0f}s window: "
+                 f"{names} (worst burn {worst.get('burn', 0):.1f}x budget)",
+                 value=worst.get("burn"),
+                 threshold=worst.get("burn_threshold"))
 
         # A departed-for-good worker's history must not pin memory forever.
         for wid in [w for w in self._tracks

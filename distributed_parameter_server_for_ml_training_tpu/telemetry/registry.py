@@ -40,6 +40,19 @@ LATENCY_BUCKETS_S = (
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
 )
 
+#: THE shared scheme for recorded durations (SLO-grade serving latency):
+#: 250 us .. 30 s with extra resolution through the 1-100 ms band where
+#: RPC handler latencies and SLO thresholds live — a p99 objective at
+#: 50/75/100 ms needs an edge AT the threshold for bucket-counting
+#: "good" events to be exact, which the coarser LATENCY_BUCKETS_S
+#: (jumping 25 -> 50 -> 100 ms) cannot give. New duration histograms use
+#: this scheme; LATENCY_BUCKETS_S remains for the pre-existing series
+#: whose committed snapshot history pins their edges.
+LATENCY_BUCKETS = (
+    0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.0075, 0.01, 0.025, 0.05,
+    0.075, 0.1, 0.25, 0.5, 0.75, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
 #: Payload-size buckets (bytes): 1 KiB .. 1 GiB in x4 steps. The ResNet-18
 #: fp32 payload (~45 MB, the reference's dominant wire term, server.py:222)
 #: lands mid-scheme; its fp16/int8 codec forms land one/two buckets lower.

@@ -1,14 +1,17 @@
-"""Latency-summary math shared by the load generator and `cli infer`.
+"""Latency-summary math shared by the load generator, `cli infer`, and
+the server-side SLO evaluator.
 
 Kept separate from the load generator so the math has fast unit tests:
 the slow-marker audit (scripts/lint.sh) slow-marks any test file that
 touches the generator itself, and percentile arithmetic should not need
-a gRPC fleet to verify.
+a gRPC fleet to verify. :func:`histogram_quantile` is the bucketed
+counterpart used server-side (telemetry/slo.py) where only histogram
+snapshots exist, not raw samples — one implementation, both surfaces.
 """
 
 from __future__ import annotations
 
-__all__ = ["latency_summary", "percentile"]
+__all__ = ["histogram_quantile", "latency_summary", "percentile"]
 
 
 def percentile(sorted_vals: list[float], p: float) -> float:
@@ -17,6 +20,32 @@ def percentile(sorted_vals: list[float], p: float) -> float:
         return 0.0
     k = round(p / 100.0 * (len(sorted_vals) - 1))
     return sorted_vals[min(len(sorted_vals) - 1, max(0, k))]
+
+
+def histogram_quantile(edges: list[float], counts: list[int],
+                       p: float) -> float | None:
+    """Quantile estimate from a fixed-bucket histogram snapshot.
+
+    ``edges`` are the inclusive upper bounds; ``counts`` are the
+    NON-cumulative per-bucket counts, optionally with one extra trailing
+    overflow slot (the registry's ``snapshot()`` shape). Returns the
+    upper edge of the bucket containing the p-th observation — a
+    conservative (never-understated) estimate, which is the right bias
+    for SLO checks. None when the histogram is empty or the quantile
+    lands in the overflow bucket (no finite upper bound to report).
+    """
+    total = sum(counts)
+    if total <= 0:
+        return None
+    rank = p / 100.0 * total
+    seen = 0
+    for i, c in enumerate(counts):
+        seen += c
+        if seen >= rank and c > 0:
+            if i >= len(edges):
+                return None  # overflow bucket: unbounded above
+            return float(edges[i])
+    return None
 
 
 def latency_summary(lat_s: list[float]) -> dict:

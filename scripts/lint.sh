@@ -13,6 +13,9 @@
 #      generator or drives the chaos soaks spawns subprocess servers or
 #      timed load loops; those belong behind the `slow` marker, outside
 #      the tier-1 budget.
+#   4. bench-ledger schema — the committed BENCH_*/MULTICHIP_* records
+#      must parse against the shape bench.py emits (tools/benchwatch
+#      --validate-only; the full regression check runs in tier1.sh).
 set -u
 cd "$(dirname "$0")/.."
 rc=0
@@ -98,5 +101,11 @@ for f in tests/*.py; do
     fi
   fi
 done
+# --- 4. bench-ledger schema ------------------------------------------------
+if ! python -m tools.benchwatch --validate-only; then
+  echo "lint.sh: bench ledger schema validation failed" >&2
+  rc=1
+fi
+
 [ "$rc" -eq 0 ] && echo "lint.sh OK"
 exit "$rc"

@@ -12,5 +12,15 @@ if ! bash scripts/lint.sh; then
   exit 1
 fi
 
+# --- benchwatch: continuous bench regression watch -------------------------
+# The committed BENCH_*/MULTICHIP_* ledger is the repo's longitudinal perf
+# record; the watch flags a >5% drop of the recent median below the
+# baseline median (tools/benchwatch; docs/OBSERVABILITY.md). Exit 2 =
+# regression, exit 1 = malformed ledger — both stop the run.
+if ! python -m tools.benchwatch; then
+  echo "benchwatch failed — bench ledger regressed or malformed" >&2
+  exit 1
+fi
+
 # --- ROADMAP.md "Tier-1 verify", verbatim ---------------------------------
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

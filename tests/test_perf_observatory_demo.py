@@ -1,0 +1,117 @@
+"""Perf-observatory demo: recorded artifacts (tier-1) + full rerun (slow).
+
+The recorded run under ``experiments/results/perf_observatory/`` is the
+ISSUE 12 acceptance evidence; tier-1 validates what was recorded (same
+discipline as the trace demo's Perfetto artifact check). The slow
+wrapper re-runs the whole drill into a temp dir.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "experiments", "results", "perf_observatory")
+
+
+def _summary() -> dict:
+    path = os.path.join(OUT, "perf_observatory.json")
+    assert os.path.exists(path), \
+        "run experiments/run_perf_observatory_demo.py to record the demo"
+    with open(path) as f:
+        return json.load(f)
+
+
+class TestRecordedArtifacts:
+    def test_all_checks_recorded_pass(self):
+        summary = _summary()
+        assert summary["all_pass"], summary["checks"]
+        # The headline properties, named explicitly.
+        checks = summary["checks"]
+        assert checks["A_reconciles_with_span_step_wall"]
+        assert checks["A_mfu_honest_on_cpu"]
+        assert checks["B_fast_burn_fired_as_critical_alert"]
+        assert checks["B_breach_resolves_when_fault_clears"]
+        assert checks["C_synthetic_20pct_drop_flagged"]
+        assert checks["C_real_history_green"]
+
+    def test_profile_artifact_reconciles(self):
+        """The merged artifact itself: real attribution basis, residual
+        arithmetic consistent, nothing hidden."""
+        with open(os.path.join(OUT, "a_perf_profile.json")) as f:
+            rep = json.load(f)
+        assert rep["trace_files"] and rep["parse_errors"] == []
+        prof = rep["profile"]
+        assert prof["basis"] in ("device_lanes", "host_ops",
+                                 "host_execute_proxy")
+        rec = rep["reconciliation"]
+        assert rec["attribution_basis"] == prof["basis"]
+        assert rec["residual_s"] == pytest.approx(
+            max(0.0, rec["step_wall_s"] - rec["attributed_s"]), abs=1e-5)
+        fracs = [r["fraction"] for r in prof["op_classes"].values()]
+        assert sum(fracs) == pytest.approx(1.0, abs=0.01)
+
+    def test_costed_artifact_reports_null_mfu_on_cpu(self):
+        with open(os.path.join(OUT,
+                               "a_perf_profile_with_cost.json")) as f:
+            rep = json.load(f)
+        cost = rep["cost"]
+        assert cost["flops"] is not None and cost["flops"] > 0
+        if rep.get("device_kind") not in ("TPU v4", "TPU v5 lite",
+                                          "TPU v5e", "TPU v5p"):
+            assert cost["mfu"] is None  # no invented peak
+
+    def test_breach_capture_has_slo_block_and_critical_alert(self):
+        with open(os.path.join(OUT, "b_cluster_breach.json")) as f:
+            view = json.load(f)
+        assert any(a["rule"] == "slo_burn_fast"
+                   and a["severity"] == "critical"
+                   for a in view["alerts"])
+        slo = view["slo"]
+        assert any(b["rule"] == "slo_burn_fast"
+                   and b["objective"] == "fetch_latency"
+                   for b in slo["breaches"])
+
+    def test_clear_capture_resolved(self):
+        with open(os.path.join(OUT, "b_cluster_clear.json")) as f:
+            view = json.load(f)
+        assert not [a for a in view["alerts"]
+                    if str(a["rule"]).startswith("slo_burn")]
+        assert view["slo"]["breaches"] == []
+
+    def test_status_transcripts_pin_exit_codes(self):
+        with open(os.path.join(OUT, "b_status_breach.txt")) as f:
+            breach = f.read()
+        assert breach.startswith("exit code: 2")
+        assert "slo_burn_fast" in breach and "BREACH" in breach
+        with open(os.path.join(OUT, "b_status_clear.txt")) as f:
+            clear = f.read()
+        assert clear.startswith("exit code: 0")
+
+    def test_benchwatch_verdict_artifacts(self):
+        with open(os.path.join(OUT, "c_check_synthetic.json")) as f:
+            synth = json.load(f)
+        assert synth["status"] == "regression"
+        assert any(s["file"] == "BENCH_r03.json"
+                   for s in synth["skipped"])
+        with open(os.path.join(OUT, "c_check_real.json")) as f:
+            real = json.load(f)
+        assert real["status"] == "pass"
+
+
+@pytest.mark.slow
+def test_perf_observatory_demo_reruns_clean(tmp_path):
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "experiments",
+                      "run_perf_observatory_demo.py"),
+         "--out-dir", str(tmp_path)],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        cwd=REPO, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stdout[-3000:] + proc.stderr[-3000:]
+    with open(tmp_path / "perf_observatory.json") as f:
+        summary = json.load(f)
+    assert summary["all_pass"], summary["checks"]

@@ -1,0 +1,154 @@
+"""Edge cases for analysis/traces.py (ISSUE 12 satellite).
+
+The critical-path attribution is the reconciliation base for the perf
+observatory (``step_wall_total_s`` anchors device-time attribution), so
+its behavior on degenerate input is load-bearing: zero spans, zero-wall
+steps, overlapping/duplicated spans, and wrapped-ring dumps where a step
+root was evicted must yield PARTIAL coverage honestly reported — never a
+crash, never silent misattribution to a surviving step.
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from distributed_parameter_server_for_ml_training_tpu.analysis import (
+    PHASES,
+    assemble_traces,
+    critical_path_report,
+    load_trace_dumps,
+)
+
+
+def _span(name, sid, parent, ts, dur, trace="T1", **attrs):
+    return {"name": name, "trace_id": trace, "span_id": sid,
+            "parent_id": parent, "ts": ts, "dur": dur, "role": "w",
+            "pid": 1, "tid": 1, "attrs": attrs}
+
+
+def _step(trace="T1", prefix="s", t0=1000.0, wall=1.0, step=0):
+    """A well-formed step: 0.6 compute + 0.3 fetch_wait (0.1 codec)."""
+    p = prefix
+    return [
+        _span("worker.step", f"{p}0", None, t0, wall, trace=trace,
+              worker=0, step=step),
+        _span("worker.compute", f"{p}1", f"{p}0", t0, 0.6 * wall,
+              trace=trace),
+        _span("worker.fetch_wait", f"{p}2", f"{p}0", t0 + 0.6 * wall,
+              0.3 * wall, trace=trace),
+        _span("worker.codec", f"{p}3", f"{p}2", t0 + 0.65 * wall,
+              0.1 * wall, trace=trace, stage="decode"),
+    ]
+
+
+class TestZeroAndEmpty:
+    def test_empty_span_list_yields_empty_report(self):
+        rep = critical_path_report([])
+        assert rep["steps"] == 0
+        assert rep["step_wall_total_s"] == 0.0
+        assert rep["stragglers"] == []
+        assert rep["by_dominant_phase"] == {}
+        assert rep["phase_totals_s"] == {p: 0.0 for p in PHASES}
+
+    def test_zero_wall_step_reports_zero_coverage_not_div_error(self):
+        spans = [
+            _span("worker.step", "z0", None, 1000.0, 0.0, worker=0,
+                  step=0),
+            _span("worker.compute", "z1", "z0", 1000.0, 0.0),
+        ]
+        e = critical_path_report(spans)["stragglers"][0]
+        assert e["wall_s"] == 0.0
+        assert e["coverage"] == 0.0
+        assert e["dominant_phase"] == "other"  # nothing attributed
+
+    def test_step_with_no_phase_children_is_all_residual(self):
+        """A step whose children were all evicted still appears, with
+        zero phases and coverage — the residual is visible, not faked."""
+        e = critical_path_report(
+            [_span("worker.step", "n0", None, 1000.0, 2.0, worker=1,
+                   step=3)])["stragglers"][0]
+        assert e["wall_s"] == pytest.approx(2.0)
+        assert all(v == 0.0 for v in e["phases_s"].values())
+        assert e["coverage"] == 0.0
+        assert e["dominant_phase"] == "other"
+
+
+class TestOverlapAndClamp:
+    def test_nested_codec_exceeding_wait_clamps_not_negative(self):
+        """Clock skew can make a nested codec span outlast its wait; the
+        exclusive wait phase clamps at zero instead of going negative."""
+        spans = [
+            _span("worker.step", "c0", None, 1000.0, 1.0, worker=0,
+                  step=0),
+            _span("worker.fetch_wait", "c1", "c0", 1000.0, 0.1),
+            _span("worker.codec", "c2", "c1", 1000.01, 0.5,
+                  stage="decode"),
+        ]
+        ph = critical_path_report(spans)["stragglers"][0]["phases_s"]
+        assert ph["fetch_wait"] == 0.0
+        assert ph["codec"] == pytest.approx(0.5)
+
+    def test_overlapping_phase_spans_surface_coverage_above_one(self):
+        """Malformed input where compute and fetch_wait overlap books
+        more phase time than wall; coverage > 1 makes the overlap
+        VISIBLE rather than silently normalizing it away."""
+        spans = [
+            _span("worker.step", "o0", None, 1000.0, 1.0, worker=0,
+                  step=0),
+            _span("worker.compute", "o1", "o0", 1000.0, 0.9),
+            _span("worker.fetch_wait", "o2", "o0", 1000.0, 0.9),
+        ]
+        e = critical_path_report(spans)["stragglers"][0]
+        assert e["coverage"] == pytest.approx(1.8)
+
+
+class TestWrappedRecorderDumps:
+    def test_evicted_step_root_yields_orphans_not_misattribution(self):
+        """Ring wrap evicted step T2's root; its surviving children must
+        neither crash the report nor leak into step T1's phases."""
+        t1 = _step(trace="T1", prefix="a", step=1)
+        t2 = _step(trace="T2", prefix="b", t0=2000.0, step=2)
+        spans = t1 + t2[1:]  # T2's worker.step root evicted
+        asm = assemble_traces(spans)
+        assert asm["orphan_spans"] >= 1  # re-rooted, not lost
+        rep = critical_path_report(spans)
+        assert rep["steps"] == 1
+        e = rep["stragglers"][0]
+        assert e["step"] == 1
+        # T1's own attribution, unchanged by T2's orphaned children.
+        assert e["phases_s"]["compute"] == pytest.approx(0.6)
+        assert e["phases_s"]["fetch_wait"] == pytest.approx(0.2)
+        assert e["phases_s"]["codec"] == pytest.approx(0.1)
+
+    def test_overlapping_dump_files_dedup_by_span_id(self, tmp_path):
+        """A SIGTERM dump followed by an atexit dump of the same process
+        overlaps almost entirely; loading both must not double-count
+        durations (payload-dict and bare-list file shapes both read)."""
+        spans = _step()
+        p1 = tmp_path / "trace-w-1-sigterm.json"
+        p1.write_text(json.dumps({"kind": "flight_recorder",
+                                  "spans": spans}))
+        p2 = tmp_path / "trace-w-1-atexit.json"
+        p2.write_text(json.dumps(spans[1:]))  # bare list, overlapping
+        merged = load_trace_dumps([str(p1), str(p2)])
+        assert len(merged) == len(spans)
+        rep = critical_path_report(merged)
+        assert rep["steps"] == 1
+        assert rep["stragglers"][0]["phases_s"]["compute"] == \
+            pytest.approx(0.6)
+
+    def test_step_wall_total_covers_all_steps_not_just_top(self):
+        """``step_wall_total_s`` is the perf-observatory reconciliation
+        base: it must sum EVERY step even when top-N truncates the
+        straggler list."""
+        spans = []
+        for i in range(4):
+            spans += _step(trace=f"T{i}", prefix=f"p{i}",
+                           t0=1000.0 + i, wall=0.5 + 0.1 * i, step=i)
+        rep = critical_path_report(spans, top=2)
+        assert rep["steps"] == 4
+        assert len(rep["stragglers"]) == 2
+        assert rep["step_wall_total_s"] == \
+            pytest.approx(0.5 + 0.6 + 0.7 + 0.8)

@@ -1,0 +1,220 @@
+"""benchwatch: schema-validated bench ledger + regression watch.
+
+The committed ``BENCH_r*.json`` / ``MULTICHIP_r*.json`` records are the
+repo's only longitudinal performance record, and until now nothing read
+them: a PR could halve throughput and tier-1 would stay green. This
+tool ingests the ledger, validates every record against the schema the
+bench harness actually emits, and runs a noise-tolerant regression
+check:
+
+- **Usable** records: ``rc == 0``, non-null ``parsed``, and no
+  ``platform_fallback`` marker (a CPU-fallback number is not comparable
+  to TPU history). Unusable records are SKIPPED AND REPORTED with a
+  reason — an rc!=0 TPU-init flake (BENCH_r05) is not a regression, but
+  it is not silently dropped either.
+- **Regression** per metric: the median of the newest
+  ``recent_window`` usable values vs the median of the
+  ``baseline_window`` values before them; flagged when recent <
+  baseline x (1 - tolerance). Medians tolerate single-run noise;
+  the windows are configurable per invocation.
+
+Surfaces: ``python -m tools.benchwatch`` (scripts/lint.sh gate 4 runs
+``--validate-only``; scripts/tier1.sh runs the full check) and
+``cli perf check`` (same code, same verdict). Exit codes: 0 pass,
+1 malformed ledger, 2 regression. Deliberately jax-free so the lint
+gate stays cheap.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import statistics
+
+__all__ = [
+    "check_regressions",
+    "load_ledger",
+    "render_markdown",
+    "validate_record",
+]
+
+#: field -> required type(s) for the two record kinds (the shape
+#: bench.py emits and the committed history carries; ``parsed`` extras
+#: beyond the core four keys are allowed — newer bench.py versions
+#: append fields like fetch_qps/mfu and old records must stay valid).
+_BENCH_FIELDS = {"n": int, "cmd": str, "rc": int, "tail": str}
+_PARSED_FIELDS = {"metric": str, "value": (int, float), "unit": str}
+_MULTICHIP_FIELDS = {"n_devices": int, "rc": int, "ok": bool,
+                     "skipped": bool, "tail": str}
+
+
+def _type_errors(obj: dict, fields: dict, ctx: str) -> list:
+    errs = []
+    for key, typ in fields.items():
+        if key not in obj:
+            errs.append(f"{ctx}: missing required field {key!r}")
+        elif not isinstance(obj[key], typ) or isinstance(obj[key], bool) \
+                and typ is int:
+            errs.append(f"{ctx}: field {key!r} has type "
+                        f"{type(obj[key]).__name__}, wanted "
+                        f"{getattr(typ, '__name__', typ)}")
+    return errs
+
+
+def validate_record(kind: str, obj) -> list:
+    """Schema errors for one record ('' list = valid). ``kind`` is
+    'bench' or 'multichip'."""
+    if not isinstance(obj, dict):
+        return [f"{kind} record is {type(obj).__name__}, wanted object"]
+    if kind == "multichip":
+        return _type_errors(obj, _MULTICHIP_FIELDS, "multichip")
+    errs = _type_errors(obj, _BENCH_FIELDS, "bench")
+    if "parsed" not in obj:
+        errs.append("bench: missing required field 'parsed'")
+    elif obj["parsed"] is not None:
+        if not isinstance(obj["parsed"], dict):
+            errs.append("bench: 'parsed' must be null or object")
+        else:
+            errs += _type_errors(obj["parsed"], _PARSED_FIELDS,
+                                 "bench.parsed")
+            if "vs_baseline" not in obj["parsed"]:
+                errs.append("bench.parsed: missing required field "
+                            "'vs_baseline'")
+    return errs
+
+
+def load_ledger(root: str) -> dict:
+    """All committed records under ``root``, in run order, each entry
+    ``{"file", "kind", "record"|None, "errors": [...]}``."""
+    entries = []
+    for kind, pat in (("bench", "BENCH_*.json"),
+                      ("multichip", "MULTICHIP_*.json")):
+        for path in sorted(glob.glob(os.path.join(root, pat))):
+            entry = {"file": os.path.basename(path), "kind": kind,
+                     "record": None, "errors": []}
+            try:
+                with open(path) as f:
+                    obj = json.load(f)
+            except (OSError, json.JSONDecodeError) as e:
+                entry["errors"] = [f"unreadable: {e}"]
+                entries.append(entry)
+                continue
+            entry["record"] = obj
+            entry["errors"] = validate_record(kind, obj)
+            entries.append(entry)
+    return {"root": root, "entries": entries,
+            "malformed": [e for e in entries if e["errors"]]}
+
+
+def _usable_bench(entry: dict) -> tuple[bool, str]:
+    """(usable, reason-if-not) for one valid bench entry."""
+    rec = entry["record"]
+    if rec.get("rc") != 0:
+        return False, f"rc={rec.get('rc')} (run failed; not comparable)"
+    parsed = rec.get("parsed")
+    if not isinstance(parsed, dict):
+        return False, "parsed=null (no metric extracted)"
+    if parsed.get("platform_fallback"):
+        return False, (f"platform_fallback="
+                       f"{parsed.get('platform_fallback')!r} "
+                       f"(not comparable to accelerator history)")
+    return True, ""
+
+
+def check_regressions(ledger: dict, tolerance: float = 0.05,
+                      baseline_window: int = 3,
+                      recent_window: int = 1) -> dict:
+    """The verdict over one loaded ledger (see module docstring)."""
+    if tolerance < 0 or baseline_window < 1 or recent_window < 1:
+        raise ValueError("tolerance must be >= 0 and windows >= 1")
+    skipped = []
+    by_metric: dict[str, list] = {}
+    for entry in ledger["entries"]:
+        if entry["kind"] != "bench" or entry["errors"]:
+            continue
+        ok, reason = _usable_bench(entry)
+        if not ok:
+            skipped.append({"file": entry["file"], "reason": reason})
+            continue
+        parsed = entry["record"]["parsed"]
+        by_metric.setdefault(parsed["metric"], []).append(
+            {"file": entry["file"], "value": float(parsed["value"]),
+             "unit": parsed.get("unit", "")})
+    metrics = {}
+    regressions = []
+    for metric, points in by_metric.items():
+        values = [p["value"] for p in points]
+        row: dict = {"unit": points[0]["unit"], "runs": len(points),
+                     "values": values,
+                     "files": [p["file"] for p in points]}
+        if len(values) < baseline_window + recent_window:
+            row["status"] = "insufficient_history"
+            row["needed"] = baseline_window + recent_window
+        else:
+            recent = statistics.median(values[-recent_window:])
+            base = statistics.median(
+                values[-(recent_window + baseline_window):-recent_window])
+            floor = base * (1.0 - tolerance)
+            row.update({
+                "recent_median": round(recent, 3),
+                "baseline_median": round(base, 3),
+                "floor": round(floor, 3),
+                "change_fraction": round((recent - base) / base, 4)
+                if base else None,
+                "status": "regression" if recent < floor else "ok",
+            })
+            if row["status"] == "regression":
+                regressions.append(metric)
+        metrics[metric] = row
+    malformed = [{"file": e["file"], "errors": e["errors"]}
+                 for e in ledger["malformed"]]
+    status = "malformed" if malformed else (
+        "regression" if regressions else "pass")
+    return {
+        "status": status,
+        "tolerance": tolerance,
+        "baseline_window": baseline_window,
+        "recent_window": recent_window,
+        "metrics": metrics,
+        "regressions": sorted(regressions),
+        "skipped": skipped,
+        "malformed": malformed,
+    }
+
+
+def render_markdown(verdict: dict) -> str:
+    """Markdown verdict for humans / PR comments."""
+    icon = {"pass": "PASS", "regression": "REGRESSION",
+            "malformed": "MALFORMED LEDGER"}
+    label = icon.get(verdict["status"], verdict["status"])
+    lines = [f"## benchwatch: {label}", ""]
+    if verdict["metrics"]:
+        lines += ["| metric | runs | baseline | recent | change | "
+                  "status |", "|---|---|---|---|---|---|"]
+        for name in sorted(verdict["metrics"]):
+            m = verdict["metrics"][name]
+            if m["status"] == "insufficient_history":
+                lines.append(f"| `{name}` | {m['runs']} | - | - | - | "
+                             f"insufficient history "
+                             f"(need {m['needed']}) |")
+                continue
+            chg = m["change_fraction"]
+            chg_s = "-" if chg is None else f"{chg*100:+.1f}%"
+            lines.append(
+                f"| `{name}` | {m['runs']} | {m['baseline_median']} | "
+                f"{m['recent_median']} | {chg_s} | {m['status']} |")
+    else:
+        lines.append("_no usable bench records_")
+    if verdict["skipped"]:
+        lines += ["", "Skipped records (reported, never compared):"]
+        lines += [f"- `{s['file']}`: {s['reason']}"
+                  for s in verdict["skipped"]]
+    if verdict["malformed"]:
+        lines += ["", "Malformed records (fail the gate):"]
+        lines += [f"- `{m['file']}`: {'; '.join(m['errors'])}"
+                  for m in verdict["malformed"]]
+    lines += ["", f"tolerance {verdict['tolerance']*100:.0f}% · baseline "
+                  f"window {verdict['baseline_window']} · recent window "
+                  f"{verdict['recent_window']}"]
+    return "\n".join(lines)

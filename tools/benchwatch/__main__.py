@@ -1,0 +1,63 @@
+"""CLI for the bench regression watch (see package docstring).
+
+Exit codes: 0 pass, 1 malformed ledger, 2 regression — distinct so
+scripts/lint.sh (schema gate) and scripts/tier1.sh (full check) can
+both consume the same entry point.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from . import check_regressions, load_ledger, render_markdown
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.benchwatch",
+        description="Validate the committed bench ledger and check for "
+                    "throughput regressions.")
+    ap.add_argument("--root", default=".",
+                    help="directory holding BENCH_*.json / "
+                         "MULTICHIP_*.json (default: .)")
+    ap.add_argument("--tolerance", type=float, default=0.05,
+                    help="allowed fractional drop before a regression "
+                         "flags (default: 0.05)")
+    ap.add_argument("--baseline-window", type=int, default=3,
+                    help="usable runs in the baseline median (default: 3)")
+    ap.add_argument("--recent-window", type=int, default=1,
+                    help="usable runs in the recent median (default: 1)")
+    ap.add_argument("--format", choices=("md", "json"), default="md",
+                    help="verdict output format (default: md)")
+    ap.add_argument("--validate-only", action="store_true",
+                    help="schema-validate the ledger and stop (the "
+                         "scripts/lint.sh gate)")
+    args = ap.parse_args(argv)
+
+    ledger = load_ledger(args.root)
+    if args.validate_only:
+        if ledger["malformed"]:
+            for e in ledger["malformed"]:
+                for err in e["errors"]:
+                    print(f"benchwatch: {e['file']}: {err}",
+                          file=sys.stderr)
+            return 1
+        n = len(ledger["entries"])
+        print(f"benchwatch: ledger OK ({n} records)")
+        return 0
+
+    verdict = check_regressions(
+        ledger, tolerance=args.tolerance,
+        baseline_window=args.baseline_window,
+        recent_window=args.recent_window)
+    if args.format == "json":
+        print(json.dumps(verdict, indent=2))
+    else:
+        print(render_markdown(verdict))
+    return {"pass": 0, "malformed": 1, "regression": 2}[verdict["status"]]
+
+
+if __name__ == "__main__":
+    sys.exit(main())

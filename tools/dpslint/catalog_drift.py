@@ -304,6 +304,13 @@ def check_lint_rules(ctx: DriftContext) -> list[Finding]:
     return out
 
 
+def check_op_classes(ctx: DriftContext) -> list[Finding]:
+    return _table_check(ctx, "op-class",
+                        f"{_PKG}/analysis/device_profile.py",
+                        "OP_CLASSES", "docs/OBSERVABILITY.md",
+                        "#### Op classes", "profiler op class")
+
+
 def check_meta_keys(ctx: DriftContext) -> list[Finding]:
     """META_KEY_CATALOG pinned to docs/WIRE_PROTOCOL.md's envelope-meta
     table — a wire field cannot be cataloged without being documented,
@@ -332,6 +339,7 @@ CHECKS = {
     "shard-map-fields": check_shard_map_fields,
     "sharding-metric-families": check_sharding_metric_families,
     "lint-rules": check_lint_rules,
+    "op-classes": check_op_classes,
     "meta-keys": check_meta_keys,
 }
 
