@@ -419,6 +419,13 @@ def run_bench(args) -> dict:
             "autoscale_actions": 0,
             "canary_promotions": 0,
             "reshard_events": 0,
+            # Robustness attribution (ISSUE 13): zero by construction for
+            # the same reason — no coordinator crash/resume and no fault
+            # injection run during a bench measurement; the chaos numbers
+            # live in experiments/results/reshard_chaos/. Non-zero values
+            # mean the measurement overlapped a recovery.
+            "reshard_resumes": 0,
+            "corrupt_frames_refused": 0,
             # Perf-observatory fields (ISSUE 12): null unless this run
             # captured a profile (--profile-dir). device_time_fraction is
             # attributed time / (timed wall x chips); the basis says

@@ -6,6 +6,7 @@ import json
 import os
 import threading
 import time
+import zlib
 
 import jax
 import numpy as np
@@ -79,13 +80,16 @@ class CheckpointManager:
 
 #: Store-snapshot record format. v1 was (npz, {global_step,...} json); v2
 #: adds the aggregation-config block and the push-token journal that make a
-#: server restart transparent to retrying clients (docs/ROBUSTNESS.md).
-#: Restore accepts both.
-STORE_SNAPSHOT_VERSION = 2
+#: server restart transparent to retrying clients (docs/ROBUSTNESS.md); v3
+#: adds the npz CRC-32 integrity stamp (torn/corrupt snapshots detected at
+#: restore, falling back to the previous valid record) and the in-flight
+#: migration ledger block (docs/ROBUSTNESS.md "Migration failure matrix").
+#: Restore accepts all three.
+STORE_SNAPSHOT_VERSION = 3
 
 
 def save_store(store: ParameterStore, directory: str,
-               journal_fn=None) -> str:
+               journal_fn=None, migration_fn=None) -> str:
     """Atomic, versioned snapshot of a parameter store: params npz +
     metadata JSON (format v2: global step, aggregation-mode config, and —
     via ``journal_fn``, typically ``ParameterService.journal_snapshot`` —
@@ -140,6 +144,14 @@ def save_store(store: ParameterStore, directory: str,
         },
         "saved_at": time.time(),
     }
+    # In-flight migration ledger (docs/ROBUSTNESS.md "Migration failure
+    # matrix"): a primary that crashes mid-reshard restores its ledger
+    # record with the params, so `cli reshard --resume` can read the
+    # crash point and the donor's lease keeps its original deadline.
+    if migration_fn is not None:
+        mig = migration_fn()
+        if mig is not None:
+            meta["migration"] = mig
     # Unique temp names per call: concurrent snapshots (periodic thread +
     # final snapshot) must never interleave writes into one file. Publish
     # order is json THEN npz: restore discovers records by .npz, so a
@@ -149,6 +161,20 @@ def save_store(store: ParameterStore, directory: str,
     tmp_npz = os.path.join(directory, f".tmp-{suffix}.npz")
     tmp_json = os.path.join(directory, f".tmp-{suffix}.json")
     np.savez(tmp_npz, **arrays)
+    # CRC the STAGED npz bytes (v3): restore re-hashes the published
+    # file against this stamp, so a torn write, a crash mid-rename, or
+    # later on-disk damage is detected and restore falls back to the
+    # previous valid snapshot instead of silently loading garbage.
+    crc, size = 0, 0
+    with open(tmp_npz, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+            size += len(chunk)
+    meta["npz_crc32"] = crc
+    meta["npz_size"] = size
     with open(tmp_json, "w") as f:
         json.dump(meta, f)
     final = os.path.join(directory, f"store_{step:08d}.npz")
@@ -157,11 +183,47 @@ def save_store(store: ParameterStore, directory: str,
     return final
 
 
+def _read_record(directory: str, name: str
+                 ) -> tuple[dict[str, np.ndarray], dict]:
+    """Read and fully validate ONE snapshot record (npz + json). Raises
+    on any damage: unreadable metadata, an ``npz_crc32`` mismatch (v3
+    stamp), or an npz numpy cannot decode (the only integrity signal a
+    pre-v3 record offers). Arrays are materialized here — np.load is
+    lazy, and a torn zip often only fails when a member is read."""
+    npz_path = os.path.join(directory, name)
+    with open(os.path.join(directory,
+                           name.replace(".npz", ".json"))) as f:
+        meta = json.load(f)
+    want = meta.get("npz_crc32")
+    if want is not None:
+        crc = 0
+        with open(npz_path, "rb") as f:
+            while True:
+                chunk = f.read(1 << 20)
+                if not chunk:
+                    break
+                crc = zlib.crc32(chunk, crc)
+        if crc != int(want):
+            raise ValueError(
+                f"npz checksum mismatch (torn or corrupt write): "
+                f"crc {crc:#010x} != recorded {int(want):#010x}")
+    data = np.load(npz_path)
+    params = {k: np.array(data[k], np.float32) for k in data.files}
+    return params, meta
+
+
 def load_store_record(directory: str, step: int | None = None
                       ) -> tuple[dict[str, np.ndarray], dict]:
     """Read the newest (or given-step) snapshot -> (params, meta dict).
     v1 records (no ``format_version``) load with an empty journal and no
-    aggregation block."""
+    aggregation block.
+
+    Newest-pick mode walks newest -> oldest past torn or corrupt
+    records (CRC-verified for v3, decode-verified for older), logging
+    one ``CHECKPOINT_FALLBACK`` line per skip — a crash mid-snapshot
+    must cost one checkpoint interval of progress, not the restore. An
+    EXPLICIT ``step`` is load-bearing: damage there is an error, never
+    a silent substitution of some other step."""
     snaps = sorted(f for f in os.listdir(directory)
                    if f.startswith("store_") and f.endswith(".npz"))
     if not snaps:
@@ -170,14 +232,17 @@ def load_store_record(directory: str, step: int | None = None
         name = f"store_{step:08d}.npz"
         if name not in snaps:
             raise FileNotFoundError(name)
-    else:
-        name = snaps[-1]
-    data = np.load(os.path.join(directory, name))
-    with open(os.path.join(directory,
-                           name.replace(".npz", ".json"))) as f:
-        meta = json.load(f)
-    params = {k: np.array(data[k], np.float32) for k in data.files}
-    return params, meta
+        return _read_record(directory, name)
+    errors = []
+    for name in reversed(snaps):
+        try:
+            return _read_record(directory, name)
+        except Exception as e:  # noqa: BLE001 — any damage means fall back
+            errors.append(f"{name}: {e}")
+            print(f"CHECKPOINT_FALLBACK {name} unreadable ({e}); "
+                  f"trying previous snapshot", flush=True)
+    raise FileNotFoundError(
+        f"no valid store snapshot in {directory}: " + "; ".join(errors))
 
 
 def restore_store(store: ParameterStore, directory: str,
@@ -238,6 +303,13 @@ def restore_server_state(store: ParameterStore, service, directory: str,
     loaded = 0
     if service is not None:
         loaded = service.load_journal(meta.get("push_journal", []))
+        # Re-install any in-flight migration ledger record (v3): a
+        # donor that died mid-export comes back FROZEN under its
+        # original lease deadline, so the coordinator's --resume (or
+        # lease expiry) decides the outcome, not the crash.
+        mig_load = getattr(service, "load_migration", None)
+        if mig_load is not None:
+            mig_load(meta.get("migration"))
     return store.global_step, loaded
 
 
@@ -252,7 +324,8 @@ class PeriodicStoreCheckpointer(threading.Thread):
     """
 
     def __init__(self, store: ParameterStore, directory: str,
-                 interval: float = 30.0, journal_fn=None):
+                 interval: float = 30.0, journal_fn=None,
+                 migration_fn=None):
         super().__init__(daemon=True)
         self.store = store
         self.directory = directory
@@ -261,6 +334,10 @@ class PeriodicStoreCheckpointer(threading.Thread):
         #: ``ParameterService.journal_snapshot``), persisted into every
         #: snapshot so a restart keeps deduping pre-crash push retries.
         self.journal_fn = journal_fn
+        #: Optional migration-ledger source (typically
+        #: ``ParameterService.migration_snapshot``) — persisted so a
+        #: primary that crashes mid-reshard restores its crash point.
+        self.migration_fn = migration_fn
         self.last_error: Exception | None = None
         # NB: must not be named _stop — that would shadow
         # threading.Thread._stop(), which join() calls internally.
@@ -270,7 +347,8 @@ class PeriodicStoreCheckpointer(threading.Thread):
         while not self._stop_event.wait(self.interval):
             try:
                 save_store(self.store, self.directory,
-                           journal_fn=self.journal_fn)
+                           journal_fn=self.journal_fn,
+                           migration_fn=self.migration_fn)
                 self.last_error = None
             except Exception as e:  # noqa: BLE001 — keep snapshotting
                 self.last_error = e
@@ -285,7 +363,8 @@ class PeriodicStoreCheckpointer(threading.Thread):
         which swallows them (a failed final snapshot must not mask the
         shutdown itself); the periodic ``last_error`` is left for the
         next tick's bookkeeping."""
-        save_store(self.store, self.directory, journal_fn=self.journal_fn)
+        save_store(self.store, self.directory, journal_fn=self.journal_fn,
+                   migration_fn=self.migration_fn)
 
     def stop(self, final_snapshot: bool = True) -> Exception | None:
         """Stop the thread; returns the last unrecovered periodic failure
@@ -298,6 +377,7 @@ class PeriodicStoreCheckpointer(threading.Thread):
             # tick there is no later retry, and the caller must know the
             # run's end state was not persisted.
             save_store(self.store, self.directory,
-                       journal_fn=self.journal_fn)
+                       journal_fn=self.journal_fn,
+                       migration_fn=self.migration_fn)
             self.last_error = None
         return self.last_error

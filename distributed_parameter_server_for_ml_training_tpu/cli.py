@@ -566,6 +566,12 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--canary-tolerance", type=float, default=0.0,
                    help="promote while canary mean quality >= stable "
                         "mean - tolerance; below that, roll back")
+    r.add_argument("--faults", default=None,
+                   help="seeded fault spec for the replica tier (env "
+                        "DPS_FAULTS_REPLICA; comms/faults.py grammar): "
+                        "`refresh.*` rules hit the subscription poll, "
+                        "`subscribe.*` rules this replica's own serving "
+                        "handler")
     add_telemetry(r)
 
     lg = sub.add_parser(
@@ -611,6 +617,32 @@ def build_parser() -> argparse.ArgumentParser:
                          "donor's boundary facing the recipient")
     rs.add_argument("--json", action="store_true",
                     help="print only the RESHARD_JSON line")
+    rs.add_argument("--migration-id", default=None,
+                    help="explicit migration id (defaults to a random "
+                         "one); the durable ledger key --resume/--abort "
+                         "match against (docs/ROBUSTNESS.md)")
+    rs.add_argument("--lease-ttl", type=float, default=30.0,
+                    help="donor freeze lease in seconds: if the "
+                         "coordinator dies before publishing the map, "
+                         "the donor auto-unfreezes and aborts after "
+                         "this long (default 30)")
+    rs.add_argument("--resume", action="store_true",
+                    help="inspect the primaries' migration ledger and "
+                         "deterministically roll the crashed migration "
+                         "forward (map already publishing) or back "
+                         "(pre-publish / lease expired)")
+    rs.add_argument("--abort", action="store_true",
+                    help="roll back an in-flight migration: recipient "
+                         "drops its adopted copy, donor unfreezes, map "
+                         "untouched (refused once the map started "
+                         "publishing — use --resume)")
+    rs.add_argument("--crash-after",
+                    choices=["export", "import", "apply_first",
+                             "apply_all"],
+                    default=None,
+                    help="chaos drill hook: hard-exit the coordinator "
+                         "immediately after this phase boundary "
+                         "(experiments/run_reshard_chaos_demo.py)")
 
     inf = sub.add_parser(
         "infer",
@@ -1092,7 +1124,8 @@ def _cmd_serve(args) -> int:
         ckpt = PeriodicStoreCheckpointer(
             store, ckpt_dir,
             interval=getattr(args, "checkpoint_interval", 30.0),
-            journal_fn=svc.journal_snapshot)
+            journal_fn=svc.journal_snapshot,
+            migration_fn=svc.migration_snapshot)
         ckpt.start()
         # SIGTERM drains the store's end state through the same shutdown
         # path that dumps the flight recorder — a terminated server
@@ -1398,6 +1431,19 @@ def _render_status(view: dict) -> str:
                 f"step={rep.get('step')} "
                 f"lag={rep.get('lag_steps')} step(s), "
                 f"announced {rep.get('announce_age_s', 0):.1f}s ago")
+        mig = sh.get("migration")
+        if mig:
+            # In-flight migration ledger (docs/ROBUSTNESS.md "Migration
+            # failure matrix"). Absent block (idle, or a server predating
+            # the ledger) renders nothing — degradation-pinned like the
+            # slo block.
+            lease = mig.get("lease_remaining_s")
+            lease_s = "" if lease is None else f" lease={lease:g}s"
+            lines.append(
+                f"  migration {mig.get('id')}: {mig.get('role')} "
+                f"phase={mig.get('phase')} "
+                f"slots=[{mig.get('slot_lo')},{mig.get('slot_hi')}) "
+                f"frozen={mig.get('frozen_slots', 0)}{lease_s}")
     slo = view.get("slo")
     if slo:
         # Serve-tier SLOs (docs/OBSERVABILITY.md): per-objective
@@ -1557,7 +1603,8 @@ def _cmd_replica(args) -> int:
                         canary_min_samples=getattr(
                             args, "canary_min_samples", 20),
                         canary_tolerance=getattr(args, "canary_tolerance",
-                                                 0.0))
+                                                 0.0),
+                        faults=getattr(args, "faults", None))
     port = rep.start()
     print(f"replica up on :{port} (primary={args.primary}, "
           f"shard={args.shard_id}, "
@@ -1598,13 +1645,252 @@ def cmd_loadgen(args) -> int:
     return 0 if result["fetches_ok"] > 0 else 1
 
 
+def _reshard_crash_if(args, point: str) -> None:
+    """Deterministic coordinator kill at a phase boundary (the chaos
+    demo's four crash points). Hard exit — no cleanup, exactly what a
+    crashed coordinator leaves behind."""
+    if getattr(args, "crash_after", None) == point:
+        print(f"RESHARD_CRASH_POINT {point}", flush=True)
+        os._exit(21)
+
+
+def _reshard_plan(smeta: dict, donor: int, recipient: int,
+                  lo: int, hi: int, n: int, mig_id: str,
+                  lease_ttl: float) -> dict:
+    """Compute the FULL migration plan — post-move partition and target
+    map version — from the donor's live map (a ``status`` reply), before
+    anything is frozen. The plan rides every subsequent op as the
+    ``migration`` meta field, so each primary's ledger record carries
+    everything a resumed coordinator needs."""
+    live = smeta.get("shard_map") or {}
+    ranges = [tuple(sh["slot_range"]) for sh in live.get("shards", [])]
+    if len(ranges) != n:
+        raise SystemExit(f"donor's shard map lists {len(ranges)} "
+                         f"shards, expected {n}")
+    dlo, dhi = ranges[donor]
+    rlo, rhi = ranges[recipient]
+    if not dlo <= lo < hi <= dhi:
+        raise SystemExit(f"slots [{lo},{hi}) not owned by donor "
+                         f"{donor} (owns [{dlo},{dhi}))")
+    # The moved range must sit at the donor boundary FACING the
+    # recipient, so both stay contiguous after the handoff.
+    if recipient == donor + 1:
+        if hi != dhi:
+            raise SystemExit(f"moving to shard {recipient} needs "
+                             f"HI == donor's upper bound {dhi}")
+        ranges[donor] = (dlo, lo)
+        ranges[recipient] = (lo, rhi)
+    else:
+        if lo != dlo:
+            raise SystemExit(f"moving to shard {recipient} needs "
+                             f"LO == donor's lower bound {dlo}")
+        ranges[donor] = (hi, dhi)
+        ranges[recipient] = (rlo, hi)
+    return {"id": mig_id, "slot_lo": lo, "slot_hi": hi,
+            "ranges": [list(r) for r in ranges],
+            "map_version": int(live.get("version", 0)) + 1,
+            "lease_ttl": float(lease_ttl)}
+
+
+def _reshard_apply_order(stores, donor: int, recipient: int) -> list:
+    """Publish order: donor FIRST (its apply is the commit point — the
+    lease stops applying and the migration becomes roll-forward-only),
+    recipient second, bystanders after."""
+    order = [donor, recipient] + [i for i in range(len(stores))
+                                  if i not in (donor, recipient)]
+    return [(i, stores[i]) for i in order]
+
+
+def _reshard_publish(stores, donor: int, recipient: int, plan: dict,
+                     args) -> dict:
+    """Phases 3+4: apply_ranges everywhere (idempotent server-side, so
+    a resumed coordinator re-applies safely) then commit on the donor.
+    Returns the commit reply meta."""
+    first = True
+    for _i, s in _reshard_apply_order(stores, donor, recipient):
+        s.reshard_op("apply_ranges", ranges=plan["ranges"],
+                     map_version=plan["map_version"], migration=plan)
+        if first:
+            first = False
+            _reshard_crash_if(args, "apply_first")
+    _reshard_crash_if(args, "apply_all")
+    cmeta, _ = stores[donor].reshard_op(
+        "commit", slot_lo=plan["slot_lo"], slot_hi=plan["slot_hi"],
+        migration=plan)
+    return cmeta
+
+
+def _reshard_run(stores, donor: int, recipient: int, plan: dict,
+                 args) -> int:
+    """The full protocol under a ledger plan: export -> import ->
+    lease re-check -> publish (apply donor-first) -> commit."""
+    import json as _json
+
+    lo, hi = plan["slot_lo"], plan["slot_hi"]
+    # 1. Export: the donor freezes [lo,hi) (pushes touching those slots
+    #    are disowned from this instant), journals the migration record
+    #    with its lease deadline, and hands back a consistent params
+    #    subset + its push journal.
+    emeta, payload = stores[donor].reshard_op(
+        "export", slot_lo=lo, slot_hi=hi, migration=plan)
+    _reshard_crash_if(args, "export")
+    # 2. Import: recipient adopts the params AND the donor's journal
+    #    entries, so a worker replaying a pre-handoff push token against
+    #    the new owner still answers `duplicate`.
+    imeta, _ = stores[recipient].reshard_op(
+        "import", payload=payload, journal=emeta.get("journal"),
+        migration=plan)
+    _reshard_crash_if(args, "import")
+    # Lease re-check at the point of no return: if the donor's freeze
+    # expired while export/import ran (slow transfer, paused
+    # coordinator), the donor already unfroze and took pushes for
+    # [lo,hi) — publishing the map now would hand those writes to the
+    # recipient's STALE copy. Abort the recipient instead; the cluster
+    # is exactly where it started.
+    smeta, _ = stores[donor].reshard_op("status")
+    mig = smeta.get("migration")
+    if not (isinstance(mig, dict) and mig.get("id") == plan["id"]):
+        stores[recipient].reshard_op("abort", migration=plan)
+        print(f"RESHARD_LEASE_LOST migration={plan['id']} donor lease "
+              f"expired before publish; recipient rolled back, map "
+              f"untouched", file=sys.stderr, flush=True)
+        return 3
+    cmeta = _reshard_publish(stores, donor, recipient, plan, args)
+    result = {"migration": plan["id"], "donor": donor,
+              "recipient": recipient, "slots": [lo, hi],
+              "map_version": plan["map_version"],
+              "export_step": emeta.get("export_step"),
+              "exported": emeta.get("exported"),
+              "adopted": imeta.get("adopted"),
+              "journal_loaded": imeta.get("journal_loaded"),
+              "dropped": cmeta.get("dropped"),
+              "ranges": [list(r) for r in plan["ranges"]]}
+    print("RESHARD_JSON " + _json.dumps(result), flush=True)
+    if not args.json:
+        print(f"moved slots [{lo},{hi}) shard {donor} -> {recipient} "
+              f"at step {result['export_step']} "
+              f"({result['adopted']} tensors, "
+              f"{result['journal_loaded']} journal entries; "
+              f"map v{plan['map_version']})", file=sys.stderr)
+    return 0
+
+
+def _reshard_resume(stores, donor: int, recipient: int, lo: int,
+                    hi: int, args) -> int:
+    """Crash-point oracle (docs/ROBUSTNESS.md "Migration failure
+    matrix"): read both primaries' ledger records and deterministically
+    finish or undo the migration.
+
+    - donor record in ``export`` phase (map never published, lease
+      live): ROLL FORWARD from the top — re-export is idempotent (the
+      frozen range took no applies) and refreshes the lease.
+    - donor record in ``apply_ranges`` phase (map publishing): ROLL
+      FORWARD the tail only — re-running export/import here would graft
+      the donor's stale copy over writes the recipient already owns.
+    - donor record GONE but recipient record present: the lease expired
+      (donor auto-unfroze and kept serving) — ROLL BACK the recipient.
+    - no records anywhere: nothing in flight (committed or fully
+      aborted); report and exit clean."""
+    import json as _json
+
+    dmeta, _ = stores[donor].reshard_op("status")
+    rmeta, _ = stores[recipient].reshard_op("status")
+    drec = dmeta.get("migration")
+    rrec = rmeta.get("migration")
+    drec = drec if isinstance(drec, dict) else None
+    rrec = rrec if isinstance(rrec, dict) else None
+    rec = drec or rrec
+    if rec is None:
+        result = {"outcome": "none", "donor": donor,
+                  "recipient": recipient}
+        print("RESHARD_RESUME_JSON " + _json.dumps(result), flush=True)
+        if not args.json:
+            print("no migration in flight on either primary (already "
+                  "committed, or rolled back by lease expiry)",
+                  file=sys.stderr)
+        return 0
+    # Rebuild the coordinator's plan from the ledger record — the
+    # primaries journaled everything at export/import time.
+    plan = {"id": str(rec["id"]), "slot_lo": int(rec["slot_lo"]),
+            "slot_hi": int(rec["slot_hi"]),
+            "ranges": [list(r) for r in (rec.get("ranges") or [])],
+            "map_version": int(rec.get("map_version") or 0),
+            "lease_ttl": float(args.lease_ttl)}
+    if drec is None:
+        # Lease expired: the donor unfroze, kept ownership, and may have
+        # applied pushes to [lo,hi) since — the recipient's copy is
+        # stale by construction. Roll back.
+        ameta, _ = stores[recipient].reshard_op("abort", migration=plan)
+        result = {"outcome": "rolled_back", "migration": plan["id"],
+                  "dropped": ameta.get("dropped")}
+        print("RESHARD_RESUME_JSON " + _json.dumps(result), flush=True)
+        if not args.json:
+            print(f"migration {plan['id']}: donor lease expired — "
+                  f"recipient rolled back ({ameta.get('dropped')} "
+                  f"params dropped), map untouched", file=sys.stderr)
+        return 0
+    if drec.get("phase") == "export":
+        rc = _reshard_run(stores, donor, recipient, plan, args)
+        outcome = "rolled_forward" if rc == 0 else "rolled_back"
+        print("RESHARD_RESUME_JSON " + _json.dumps(
+            {"outcome": outcome, "migration": plan["id"],
+             "from_phase": "export"}), flush=True)
+        return rc
+    # Map already publishing: finish apply everywhere + commit.
+    cmeta = _reshard_publish(stores, donor, recipient, plan, args)
+    result = {"outcome": "rolled_forward", "migration": plan["id"],
+              "from_phase": "apply_ranges",
+              "map_version": plan["map_version"],
+              "dropped": cmeta.get("dropped")}
+    print("RESHARD_RESUME_JSON " + _json.dumps(result), flush=True)
+    if not args.json:
+        print(f"migration {plan['id']}: map v{plan['map_version']} "
+              f"re-published everywhere, donor committed "
+              f"({cmeta.get('dropped')} params dropped)",
+              file=sys.stderr)
+    return 0
+
+
+def _reshard_abort_cmd(stores, donor: int, recipient: int, args) -> int:
+    """Operator-driven roll-back. Refused once the donor's map publish
+    began (phase ``apply_ranges``): from there the recipient owns
+    writes, and undoing the publish would lose them — --resume rolls
+    forward instead."""
+    import json as _json
+
+    dmeta, _ = stores[donor].reshard_op("status")
+    drec = dmeta.get("migration")
+    if isinstance(drec, dict) and drec.get("phase") == "apply_ranges":
+        print(f"migration {drec.get('id')} already publishing its map — "
+              f"abort refused, run --resume to roll forward",
+              file=sys.stderr)
+        return 4
+    # Recipient first (drop the copy while the donor still owns and
+    # serves the range), donor second (unfreeze).
+    ameta, _ = stores[recipient].reshard_op("abort")
+    bmeta, _ = stores[donor].reshard_op("abort")
+    result = {"outcome": "aborted",
+              "recipient_dropped": ameta.get("dropped"),
+              "donor_aborted": bmeta.get("aborted")}
+    print("RESHARD_ABORT_JSON " + _json.dumps(result), flush=True)
+    if not args.json:
+        print(f"migration aborted: recipient dropped "
+              f"{ameta.get('dropped')} params, donor unfroze, map "
+              f"untouched", file=sys.stderr)
+    return 0
+
+
 def cmd_reshard(args) -> int:
     """Live migration coordinator (docs/SHARDING.md \"Migration
-    protocol\"): export -> import -> apply_ranges everywhere -> commit.
-    Stateless — all state lives in the primaries; rerunning a failed
-    attempt is safe (export freezes again, import re-adopts, the map
-    version only moves forward)."""
-    import json as _json
+    protocol\", docs/ROBUSTNESS.md \"Migration failure matrix\"):
+    status -> plan -> export -> import -> lease re-check ->
+    apply_ranges (donor first) -> commit. Every op carries the full
+    plan under a migration id, each primary journals its phase through
+    the checkpoint machinery, and the donor's freeze holds a TTL lease
+    — so a coordinator killed at ANY boundary is recoverable with
+    ``--resume`` (deterministic roll-forward/roll-back) and a
+    never-resumed crash self-heals by lease expiry."""
+    import uuid
 
     from .comms.client import RemoteStore
 
@@ -1622,72 +1908,23 @@ def cmd_reshard(args) -> int:
         raise SystemExit("recipient must be adjacent to donor "
                          "(donor±1): per-shard slot ranges stay "
                          "contiguous (docs/SHARDING.md)")
+    if args.resume and args.abort:
+        raise SystemExit("--resume and --abort are mutually exclusive")
     stores = [RemoteStore(a) for a in primaries]
     try:
-        # 1. Export: the donor freezes [lo,hi) (pushes touching those
-        #    slots are disowned from this instant), hands back a
-        #    consistent params subset + its push journal.
-        emeta, payload = stores[donor].reshard_op("export", slot_lo=lo,
-                                                  slot_hi=hi)
-        live = emeta.get("shard_map") or {}
-        ranges = [tuple(sh["slot_range"])
-                  for sh in live.get("shards", [])]
-        if len(ranges) != n:
-            raise SystemExit(f"donor's shard map lists "
-                             f"{len(ranges)} shards, expected {n}")
-        dlo, dhi = ranges[donor]
-        rlo, rhi = ranges[recipient]
-        if not dlo <= lo < hi <= dhi:
-            raise SystemExit(f"slots [{lo},{hi}) not owned by donor "
-                             f"{donor} (owns [{dlo},{dhi}))")
-        # The moved range must sit at the donor boundary FACING the
-        # recipient, so both stay contiguous after the handoff.
-        if recipient == donor + 1:
-            if hi != dhi:
-                raise SystemExit(f"moving to shard {recipient} needs "
-                                 f"HI == donor's upper bound {dhi}")
-            ranges[donor] = (dlo, lo)
-            ranges[recipient] = (lo, rhi)
-        else:
-            if lo != dlo:
-                raise SystemExit(f"moving to shard {recipient} needs "
-                                 f"LO == donor's lower bound {dlo}")
-            ranges[donor] = (hi, dhi)
-            ranges[recipient] = (rlo, hi)
-        version = int(live.get("version", 0)) + 1
-        # 2. Import: recipient adopts the params AND the donor's journal
-        #    entries, so a worker replaying a pre-handoff push token
-        #    against the new owner still answers `duplicate`.
-        imeta, _ = stores[recipient].reshard_op(
-            "import", payload=payload, journal=emeta.get("journal"))
-        # 3. Publish the bumped map to EVERY primary (each refreshes its
-        #    clients through the have_shard_map delta handshake).
-        for s in stores:
-            s.reshard_op("apply_ranges",
-                         ranges=[list(r) for r in ranges],
-                         map_version=version)
-        # 4. Commit: the donor drops the handed-off params.
-        cmeta, _ = stores[donor].reshard_op("commit", slot_lo=lo,
-                                            slot_hi=hi)
+        if args.abort:
+            return _reshard_abort_cmd(stores, donor, recipient, args)
+        if args.resume:
+            return _reshard_resume(stores, donor, recipient, lo, hi,
+                                   args)
+        mig_id = args.migration_id or f"mig-{uuid.uuid4().hex[:10]}"
+        smeta, _ = stores[donor].reshard_op("status")
+        plan = _reshard_plan(smeta, donor, recipient, lo, hi, n,
+                             mig_id, args.lease_ttl)
+        return _reshard_run(stores, donor, recipient, plan, args)
     finally:
         for s in stores:
             s.close()
-    result = {"donor": donor, "recipient": recipient,
-              "slots": [lo, hi], "map_version": version,
-              "export_step": emeta.get("export_step"),
-              "exported": emeta.get("exported"),
-              "adopted": imeta.get("adopted"),
-              "journal_loaded": imeta.get("journal_loaded"),
-              "dropped": cmeta.get("dropped"),
-              "ranges": [list(r) for r in ranges]}
-    print("RESHARD_JSON " + _json.dumps(result), flush=True)
-    if not args.json:
-        print(f"moved slots [{lo},{hi}) shard {donor} -> {recipient} "
-              f"at step {result['export_step']} "
-              f"({result['adopted']} tensors, "
-              f"{result['journal_loaded']} journal entries; "
-              f"map v{version})", file=sys.stderr)
-    return 0
 
 
 def cmd_infer(args) -> int:

@@ -144,6 +144,13 @@ class RemoteStore:
         #: advertises the capability in its register request; either side
         #: missing it degrades to a directive-less wire.
         self.supports_directives = False
+        #: True once the server advertises CRC verification on push
+        #: frames (docs/WIRE_PROTOCOL.md "Checksum trailer"): pushes are
+        #: then encoded with the 4-byte CRC-32 trailer and a corrupt
+        #: frame is REFUSED server-side instead of silently applying.
+        #: Gated because a legacy server would mistake the trailer for
+        #: buffer slack — same degradation discipline as delta_fetch.
+        self.supports_checksum = False
         #: Directives received but not yet taken by the worker loop, plus
         #: the highest seq seen (the dedupe/ack watermark — the server
         #: re-attaches outstanding directives every reply until acked).
@@ -499,6 +506,8 @@ class RemoteStore:
                     reply.get("compressed_domain", False))
                 self.supports_directives = bool(
                     reply.get("directives", False))
+                self.supports_checksum = bool(
+                    reply.get("checksum", False))
                 # A fresh registration (incl. session resume against a
                 # restarted server) starts a fresh directive stream: the
                 # new server's seqs restart from 1, so a stale watermark
@@ -650,7 +659,8 @@ class RemoteStore:
             meta["trace"] = wt
         self._attach_health(meta)
         self._attach_directive_ack(meta)
-        payload = encode_tensor_dict(gradients, trace=wt)
+        payload = encode_tensor_dict(gradients, trace=wt,
+                                     checksum=self.supports_checksum)
         # Recorded BEFORE the send: a push that dies mid-RPC is exactly
         # the one the reconnect path must be able to re-send verbatim.
         self._last_push = (token, payload, int(fetched_step))

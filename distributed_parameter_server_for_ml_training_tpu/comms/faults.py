@@ -31,8 +31,9 @@ Spec grammar (CLI ``--faults`` / env ``DPS_FAULTS_CLIENT`` /
     spec  := [ 'seed=' int ';' ] rule ( ';' rule )*
     rule  := op '.' kind [ '=' float ] '@' when
     op    := 'push' | 'fetch' | 'register' | 'finish' | 'any' | 'compute'
+           | 'reshard' | 'refresh' | 'subscribe'
     kind  := 'unavailable' | 'deadline' | 'delay' | 'drop_reply' | 'kill'
-           | 'delay_compute'
+           | 'delay_compute' | 'partition' | 'corrupt'
     when  := 'p=' float          # per-call probability (seeded RNG)
            | 'n=' int(,int)*     # specific 1-based call indices for op
            | 'every=' int        # every k-th call
@@ -47,15 +48,36 @@ Examples::
                                          # deterministic straggler; the
                                          # worker loop polls this op once
                                          # per step — 'any' never matches)
+    reshard.kill@n=2                     # 2nd migration op kills the
+                                         # primary mid-handoff
+    refresh.partition=2@n=5              # the replica's 5th refresh
+                                         # opens a 2 s partition window
+    push.corrupt@every=4                 # every 4th push payload gets a
+                                         # deterministic byte flip
 
 The first matching rule per call wins. ``delay`` composes with nothing —
 it IS the action (the call proceeds after the sleep).
+
+Serve-tier ops (ISSUE 13): ``reshard`` targets the admin-plane Reshard
+RPC; ``refresh`` the replica's subscription poll against its primary
+(client side of `comms/replica.py`); ``subscribe`` the replica's OWN
+fetch-serving handler. ``any`` still means exactly the four worker RPCs
+(``ANY_EXCLUDED``) so pre-existing seeded chaos schedules replay
+byte-identically.
+
+New kinds: ``partition`` drops every matching call — both directions,
+nothing sent, nothing executed — for a ``value``-second window opened
+when the rule triggers (default 1 s); ``corrupt`` flips one
+deterministically-chosen byte of the request's tensor-payload region and
+lets the call proceed, which is exactly what the wire CRC trailer
+(comms/wire.py FLAG_CRC) must catch.
 """
 
 from __future__ import annotations
 
 import os
 import random
+import struct
 import threading
 import time
 from dataclasses import dataclass
@@ -63,12 +85,16 @@ from dataclasses import dataclass
 import grpc
 
 __all__ = [
+    "ANY_EXCLUDED",
     "COMPUTE_OP",
     "FAULT_KINDS",
     "FAULT_OPS",
+    "REFRESH_OP",
+    "SUBSCRIBE_OP",
     "FaultInjector",
     "FaultRule",
     "InjectedRpcError",
+    "corrupt_request",
     "install_client_faults",
     "parse_fault_spec",
 ]
@@ -79,7 +105,16 @@ __all__ = [
 #: and ``any`` rules (which span the four RPCs) never match it.
 COMPUTE_OP = "__compute__"
 
-#: op name (spec vocabulary) -> RPC method name (None = matches all four).
+#: Pseudo-RPC names for the replica tier's two wire directions
+#: (comms/replica.py): the subscription poll replica->primary (client
+#: side) and the replica's own fetch-serving handler (server side). Both
+#: are FetchParameters on the real wire, but a chaos schedule must be
+#: able to partition the SUBSCRIPTION without touching serve traffic
+#: (and vice versa), so each direction decides under its own op name.
+REFRESH_OP = "__replica_refresh__"
+SUBSCRIBE_OP = "__replica_subscribe__"
+
+#: op name (spec vocabulary) -> RPC method name (None = 'any').
 FAULT_OPS = {
     "push": "PushGradrients",  # quirk 1 typo is the wire contract
     "fetch": "FetchParameters",
@@ -87,15 +122,26 @@ FAULT_OPS = {
     "finish": "JobFinished",
     "any": None,
     "compute": COMPUTE_OP,  # worker-loop per-step hook, not an RPC
+    "reshard": "Reshard",  # admin-plane migration protocol
+    "refresh": REFRESH_OP,  # replica subscription poll (client side)
+    "subscribe": SUBSCRIBE_OP,  # replica's serving handler (server side)
 }
 
+#: RPC/pseudo-op names an 'any' rule never matches. 'any' has always
+#: meant "the four worker RPCs"; keeping the admin plane and the replica
+#: tier out preserves every pre-existing seeded schedule byte-for-byte
+#: (an 'any.kill@n=40' chaos soak must not start counting reshard ops).
+ANY_EXCLUDED = frozenset({COMPUTE_OP, "Reshard", REFRESH_OP,
+                          SUBSCRIBE_OP})
+
 FAULT_KINDS = ("unavailable", "deadline", "delay", "drop_reply", "kill",
-               "delay_compute")
+               "delay_compute", "partition", "corrupt")
 
 _STATUS = {
     "unavailable": grpc.StatusCode.UNAVAILABLE,
     "deadline": grpc.StatusCode.DEADLINE_EXCEEDED,
     "drop_reply": grpc.StatusCode.UNAVAILABLE,  # a lost reply looks transient
+    "partition": grpc.StatusCode.UNAVAILABLE,  # a dropped packet looks down
 }
 
 
@@ -130,10 +176,35 @@ class FaultRule:
     def matches_rpc(self, rpc_name: str) -> bool:
         target = FAULT_OPS[self.op]
         if target is None:
-            # 'any' spans the four RPCs; the compute pseudo-op is only
-            # ever hit by an explicit 'compute.' rule.
-            return rpc_name != COMPUTE_OP
+            # 'any' spans the four worker RPCs; compute, the admin
+            # plane, and the replica tier are only ever hit by their own
+            # explicit op rules (ANY_EXCLUDED — schedule stability).
+            return rpc_name not in ANY_EXCLUDED
         return target == rpc_name
+
+
+def corrupt_request(data: bytes, salt: int) -> bytes:
+    """Flip ONE byte of an envelope's tensor-payload region,
+    deterministically chosen from ``salt`` (the rule's per-hit counter) —
+    same spec, same call sequence, same flipped byte, so a corrupt drill
+    is as replayable as every other kind. Falls back to the meta JSON for
+    header-only envelopes (still a corrupt request — the server's
+    envelope parse fails loud instead of the CRC check)."""
+    buf = bytearray(data)
+    if len(buf) <= 4:
+        return bytes(buf)  # no envelope to speak of; nothing to flip
+    start = 4
+    try:
+        (hlen,) = struct.unpack_from("<I", data, 0)
+    except struct.error:
+        hlen = 0
+    if 0 < hlen <= len(buf) - 4 - 1:
+        # Flip inside the tensor payload (after the meta JSON) when one
+        # exists — the case the wire CRC trailer must catch.
+        start = 4 + hlen
+    off = start + (salt * 2654435761) % (len(buf) - start)
+    buf[off] ^= 0xFF
+    return bytes(buf)
 
 
 def parse_fault_spec(spec: str) -> tuple[int, list[FaultRule]]:
@@ -212,6 +283,13 @@ class FaultInjector:
         self._op_calls: dict[str, int] = {}
         self._rngs = [random.Random((self.seed << 8) ^ (i * 2654435761))
                       for i in range(len(self.rules))]
+        # Partition windows: rule index -> wall-clock deadline. While a
+        # rule's window is open EVERY matching call drops (both
+        # directions dead), not just the triggering one — that is what
+        # makes it a partition rather than a point failure.
+        self._partition_until: dict[int, float] = {}  # guarded by: self._lock
+        # Per-rule hit counters salting the corrupt byte-flip offset.
+        self._rule_hits: dict[int, int] = {}  # guarded by: self._lock
         # _telemetry=False (schedule_preview's probe) keeps phantom
         # counters out of the process registry: a preview replays the
         # schedule without claiming injections happened on the wire.
@@ -240,6 +318,12 @@ class FaultInjector:
             for i, rule in enumerate(self.rules):
                 if not rule.matches_rpc(rpc_name):
                     continue
+                if rule.kind == "partition" and \
+                        time.time() < self._partition_until.get(i, 0.0):
+                    # Open window: the call drops without consuming the
+                    # rule's trigger state — the window IS the fault.
+                    self._tm[(rule.op, rule.kind)].inc()
+                    return rule
                 if rule.at is not None:
                     hit = n in rule.at
                 elif rule.every is not None:
@@ -250,9 +334,23 @@ class FaultInjector:
                     # draws land.
                     hit = self._rngs[i].random() < (rule.prob or 0.0)
                 if hit:
+                    if rule.kind == "partition":
+                        self._partition_until[i] = \
+                            time.time() + (rule.value or 1.0)
+                    self._rule_hits[i] = self._rule_hits.get(i, 0) + 1
                     self._tm[(rule.op, rule.kind)].inc()
                     return rule
         return None
+
+    def corrupt_salt(self, rule: FaultRule) -> int:
+        """The number of times ``rule`` has triggered so far (1-based at
+        the moment of a hit) — the deterministic salt
+        :func:`corrupt_request` flips with."""
+        for i, r in enumerate(self.rules):
+            if r is rule:
+                with self._lock:
+                    return self._rule_hits.get(i, 0)
+        return 0
 
     def maybe_delay_compute(self) -> float:
         """Worker-loop hook (``ps/worker.py``): one decision per local
@@ -295,6 +393,12 @@ class FaultInjector:
             if rule.kind == "delay":
                 time.sleep(rule.value)
                 return fn(request, ctx)
+            if rule.kind == "corrupt":
+                # Ingress corruption: the handler sees a byte-flipped
+                # request, exactly as if the wire damaged it — the CRC
+                # refusal path (comms/service.py) is what's under test.
+                return fn(corrupt_request(request,
+                                          self.corrupt_salt(rule)), ctx)
             if rule.kind == "kill":
                 print(f"fault injection: killing server mid-{rpc_name}",
                       flush=True)
@@ -302,6 +406,9 @@ class FaultInjector:
             if rule.kind == "drop_reply":
                 fn(request, ctx)  # the apply HAPPENS; the reply does not
                 self._abort(ctx, "drop_reply", rpc_name)
+            # unavailable / deadline / partition: nothing executes. For
+            # partition the abort doubles as "request never arrived" —
+            # and the open window keeps dropping follow-ups both ways.
             self._abort(ctx, rule.kind, rpc_name)
 
         return wrapped
@@ -328,6 +435,12 @@ class _FaultyCall:
         if rule.kind == "delay":
             time.sleep(rule.value)
             return self._inner(request, timeout=timeout)
+        if rule.kind == "corrupt":
+            # Egress corruption: the wire damages this client's request
+            # in flight; the server's CRC check must refuse it.
+            return self._inner(
+                corrupt_request(request, self._injector.corrupt_salt(rule)),
+                timeout=timeout)
         if rule.kind == "kill":
             print(f"fault injection: killing client mid-{self._rpc_name}",
                   flush=True)

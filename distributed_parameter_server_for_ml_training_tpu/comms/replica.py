@@ -44,6 +44,7 @@ always serve the newest synced step (docs/SHARDING.md "Serve tier").
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from collections import deque
@@ -168,7 +169,8 @@ class ReplicaServer:
                  canary_fraction: float = 0.05,
                  canary_min_samples: int = 20,
                  canary_tolerance: float = 0.0,
-                 history: int = 8):
+                 history: int = 8,
+                 faults=None):
         self.primary = primary
         self.port = int(port)
         self.shard_id = int(shard_id)
@@ -195,6 +197,22 @@ class ReplicaServer:
         # step -> primary payload; guarded by: self._lock
         self._payloads: dict[int, bytes] = {}
         self._arm_replies: dict[str, bytes] = {}  # guarded by: self._lock
+        # Deterministic replica-tier fault injection (comms/faults.py):
+        # ``refresh.*`` rules wrap the subscription poll (this replica as
+        # a client of its primary), ``subscribe.*`` rules its own serving
+        # handler. Env DPS_FAULTS_REPLICA applies when the caller passes
+        # nothing — autoscaler-spawned replicas inherit the environment,
+        # so one seeded schedule covers the whole elastic tier.
+        if faults is None:
+            faults = os.environ.get("DPS_FAULTS_REPLICA") or None
+        if faults is not None and isinstance(faults, str):
+            from .faults import FaultInjector
+            faults = FaultInjector(faults, side="replica")
+        self.faults = faults
+        #: Refresh backoff ceiling: a dead primary is polled at most this
+        #: often instead of hammered at poll_interval (the PR 5 heartbeat
+        #: discipline applied to the replica tier).
+        self._backoff_cap = max(1.0, 20.0 * self.poll_interval)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._server: grpc.Server | None = None
@@ -209,6 +227,8 @@ class ReplicaServer:
         # which is an AGE gauge (time since last sync), not a duration.
         self._tm_refresh_hist = reg.histogram(
             "dps_replica_refresh_seconds", buckets=LATENCY_BUCKETS)
+        self._tm_refresh_errors = reg.counter(
+            "dps_replica_refresh_errors_total")
         self._tm_stale = reg.counter("dps_replica_stale_rejects_total")
         self._tm_redirects = reg.counter("dps_replica_redirects_total")
         self._tm_step = reg.gauge("dps_replica_step")
@@ -293,11 +313,33 @@ class ReplicaServer:
             self._tm_stable_step.set(self.canary.stable_step)
 
     def _poll_loop(self) -> None:
+        """Refresh forever, backing off a dead primary. Consecutive
+        failures double the wait up to ``_backoff_cap`` (capped
+        exponential — an unreachable primary sees a few polls per
+        second-ish, not a poll_interval-rate hammer), one log line per
+        FAILING/RECOVERED transition, every failure counted. The
+        staleness stamp keeps aging throughout, so the serve gate still
+        fails loud."""
+        failing = False
+        delay = self.poll_interval
         while not self._stop.is_set():
             try:
                 self._poll_once()
-            except Exception:  # noqa: BLE001 — a dead primary stalls the
-                pass           # stamp; the staleness gate fails us loud.
+            except Exception as e:  # noqa: BLE001 — any refresh failure backs off
+                self._tm_refresh_errors.inc()
+                if not failing:
+                    failing = True
+                    print(f"REPLICA_REFRESH_FAILING shard={self.shard_id} "
+                          f"primary={self.primary} "
+                          f"error={type(e).__name__}", flush=True)
+                self._stop.wait(delay)
+                delay = min(delay * 2.0, self._backoff_cap)
+                continue
+            if failing:
+                failing = False
+                print(f"REPLICA_REFRESH_RECOVERED shard={self.shard_id} "
+                      f"primary={self.primary}", flush=True)
+            delay = self.poll_interval
             self._stop.wait(self.poll_interval)
 
     # -- serving (client -> replica) -----------------------------------------
@@ -368,10 +410,18 @@ class ReplicaServer:
     def start(self) -> int:
         """Bind, start serving and polling. Returns the bound port."""
         ident = lambda b: b  # noqa: E731
+        fetch_handler = self._fetch_parameters
+        if self.faults is not None:
+            # The serving direction decides under its own pseudo-op so a
+            # schedule can fail serve traffic without touching the
+            # subscription (and vice versa).
+            from .faults import SUBSCRIBE_OP
+            fetch_handler = self.faults.wrap_handler(SUBSCRIBE_OP,
+                                                     fetch_handler)
         handlers = grpc.method_handlers_generic_handler(SERVICE_NAME, {
             name: grpc.unary_unary_rpc_method_handler(
                 fn, request_deserializer=ident, response_serializer=ident)
-            for name, fn in [("FetchParameters", self._fetch_parameters),
+            for name, fn in [("FetchParameters", fetch_handler),
                              ("RegisterWorker", self._redirect),
                              ("PushGradrients", self._redirect),
                              ("JobFinished", self._redirect)]
@@ -390,6 +440,10 @@ class ReplicaServer:
         self._fetch_stub = self._channel.unary_unary(
             f"/{SERVICE_NAME}/FetchParameters",
             request_serializer=ident, response_deserializer=ident)
+        if self.faults is not None:
+            from .faults import REFRESH_OP, _FaultyCall
+            self._fetch_stub = _FaultyCall(self._fetch_stub, self.faults,
+                                           REFRESH_OP)
         self._thread = threading.Thread(target=self._poll_loop,
                                         name="replica-poll", daemon=True)
         self._thread.start()

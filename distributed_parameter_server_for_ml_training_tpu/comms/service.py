@@ -32,15 +32,30 @@ import grpc
 
 from ..ps.sharding import key_slot
 from ..ps.store import ParameterStore
-from .wire import decode_tensor_dict, encode_tensor_dict
+from .wire import decode_tensor_dict, encode_tensor_dict, \
+    frame_checksum_ok
 
 SERVICE_NAME = "ps.ParameterServer"
 
 #: Admin reshard sub-operations (docs/SHARDING.md "Migration protocol").
 #: The 5th RPC is admin-plane: only shard PRIMARIES register it, so a
 #: replica answers it UNIMPLEMENTED and can never be talked into a
-#: handoff.
-RESHARD_OPS = ("export", "import", "commit", "apply_ranges")
+#: handoff. ``status`` and ``abort`` are the crash-safety pair (ISSUE
+#: 13): status exposes the primary's durable migration record so a
+#: resumed coordinator can decide roll-forward vs roll-back; abort
+#: unwinds a half-done handoff (donor unfreezes, recipient drops the
+#: adopted range) with the live map untouched.
+RESHARD_OPS = ("export", "import", "commit", "apply_ranges", "status",
+               "abort")
+
+#: Default TTL on the donor's export freeze (docs/ROBUSTNESS.md
+#: "Migration failure matrix"): a coordinator that dies between export
+#: and map publish would otherwise leave ``[lo, hi)`` frozen forever.
+#: Once the lease expires the donor auto-unfreezes and clears its
+#: migration record — the map never moved, so nothing else needs
+#: unwinding. After the new map publishes the lease no longer applies:
+#: that migration is roll-forward-only.
+DEFAULT_MIGRATION_LEASE_S = 30.0
 
 #: Completed push-token outcomes kept for dedupe (and persisted in store
 #: snapshots, checkpoint/manager.py). One entry per client nonce; 4x the
@@ -256,10 +271,28 @@ class ParameterService:
         # land on the donor's copy after export.
         self._reshard_lock = threading.Lock()
         self._draining: set[int] = set()  # guarded by: self._reshard_lock
+        # Durable migration ledger (docs/ROBUSTNESS.md "Migration failure
+        # matrix"): this primary's record of the in-flight handoff it is
+        # donor or recipient of — persisted into store snapshots
+        # (checkpoint/manager.py migration_fn) and restored with them, so
+        # a primary that crashes mid-migration comes back knowing exactly
+        # which phase it had reached. None = no migration in flight.
+        self._migration: dict | None = None  # guarded by: self._reshard_lock
         self._tm_reshard = {
             op: reg.counter("dps_reshard_events_total", op=op)
             for op in RESHARD_OPS}
+        self._tm_lease_expired = reg.counter(
+            "dps_reshard_lease_expired_total")
         self._tm_disowned = reg.counter("dps_push_disowned_keys_total")
+        # Pushes refused because their frame failed the CRC trailer check
+        # (docs/WIRE_PROTOCOL.md "Checksum trailer"); feeds the
+        # wire_corrupt health rule via the monitor.
+        self._tm_wire_corrupt = reg.counter("dps_wire_corrupt_total")
+        # Surface the in-flight migration in the shard map's /cluster
+        # view (degradation-pinned: servers without the provider simply
+        # publish no "migration" block).
+        if sharding is not None:
+            sharding.migration_provider = self.migration_view
         # Pushes refused while their worker was quarantined (remediation
         # action; docs/ROBUSTNESS.md).
         self._tm_quarantined = reg.counter(
@@ -457,6 +490,11 @@ class ParameterService:
             return []
         lo, hi = self.sharding.my_range()
         with self._reshard_lock:
+            if self._draining:
+                # Lazy lease check on the hot path's cold branch: a
+                # frozen range must not keep disowning pushes after its
+                # donor lease lapsed.
+                self._lease_expired_locked()
             draining = set(self._draining)
         out = []
         for k in names:
@@ -471,6 +509,123 @@ class ParameterService:
         admin never has to know key names."""
         return [k for k in self.store.param_names()
                 if lo <= key_slot(k) < hi]
+
+    # -- durable migration ledger + lease (docs/ROBUSTNESS.md) ---------------
+
+    @staticmethod
+    def _migration_plan(plan) -> dict | None:
+        """Normalized coordinator plan from the request's ``migration``
+        field; None for legacy coordinators (ledger-less reshard, the
+        pre-lease behavior) or a garbled plan."""
+        if not isinstance(plan, dict):
+            return None
+        try:
+            return {
+                "id": str(plan["id"]),
+                "slot_lo": int(plan["slot_lo"]),
+                "slot_hi": int(plan["slot_hi"]),
+                "ranges": [[int(a), int(b)]
+                           for a, b in (plan.get("ranges") or [])],
+                "map_version": int(plan.get("map_version") or 0),
+                "lease_ttl": float(plan.get("lease_ttl")
+                                   or DEFAULT_MIGRATION_LEASE_S),
+            }
+        except (KeyError, TypeError, ValueError):
+            return None
+
+    def _lease_expired_locked(self) -> bool:
+        """Lazy lease enforcement (requires ``_reshard_lock``): a donor
+        whose pre-publish freeze outlived its TTL auto-unfreezes and
+        clears its record — the map never moved, so the abort is local
+        and complete. Returns True when it fired. Checked wherever the
+        frozen range could wedge traffic: reshard ops, the push
+        ownership filter, the status/cluster views, and snapshot
+        restore. After ``apply_ranges`` publishes the new map the phase
+        is no longer ``export`` and the lease stops applying — from
+        there the migration is roll-forward-only."""
+        rec = self._migration
+        if rec is None or rec.get("role") != "donor" \
+                or rec.get("phase") != "export":
+            return False
+        if time.time() <= float(rec.get("lease_deadline", 0.0)):
+            return False
+        self._draining.clear()
+        self._migration = None
+        self._tm_lease_expired.inc()
+        print(f"RESHARD_LEASE_EXPIRED migration={rec.get('id')} "
+              f"slots=[{rec.get('slot_lo')},{rec.get('slot_hi')}) "
+              f"frozen range auto-unfrozen, map untouched", flush=True)
+        return True
+
+    def migration_view(self) -> dict | None:
+        """Compact in-flight-migration block for ``GET /cluster`` /
+        ``cli status`` (riding the sharding view via the provider hook);
+        None when no migration is in flight."""
+        with self._reshard_lock:
+            self._lease_expired_locked()
+            rec = self._migration
+            if rec is None:
+                return None
+            out = {"id": rec["id"], "role": rec["role"],
+                   "phase": rec["phase"],
+                   "slot_lo": rec["slot_lo"], "slot_hi": rec["slot_hi"],
+                   "map_version": rec["map_version"],
+                   # The full target partition: a resumed coordinator
+                   # (cli.py _reshard_resume) rebuilds its plan from
+                   # this block, and apply_ranges needs every shard's
+                   # post-move range, not just the migrated window.
+                   "ranges": [list(r)
+                              for r in (rec.get("ranges") or [])],
+                   "frozen_slots": len(self._draining)}
+            if rec["role"] == "donor" and rec["phase"] == "export":
+                out["lease_remaining_s"] = round(
+                    float(rec.get("lease_deadline", 0.0)) - time.time(), 3)
+            return out
+
+    def migration_snapshot(self) -> dict | None:
+        """The full migration record for checkpoint persistence
+        (checkpoint/manager.py ``migration_fn``), or None."""
+        with self._reshard_lock:
+            self._lease_expired_locked()
+            return None if self._migration is None \
+                else dict(self._migration)
+
+    def load_migration(self, rec) -> bool:
+        """Restore a persisted migration record (server restart mid-
+        migration). A donor still in its ``export`` phase re-freezes its
+        range — unless the lease lapsed while the server was down, in
+        which case the restore IS the auto-abort (map untouched).
+        Malformed records are ignored: a garbled ledger must degrade to
+        a resumable-by-status=absent migration, not a refused restore.
+        Returns True when a record was installed."""
+        if not isinstance(rec, dict):
+            return False
+        try:
+            rec = {
+                "id": str(rec["id"]), "role": str(rec["role"]),
+                "phase": str(rec["phase"]),
+                "slot_lo": int(rec["slot_lo"]),
+                "slot_hi": int(rec["slot_hi"]),
+                "ranges": [[int(a), int(b)]
+                           for a, b in (rec.get("ranges") or [])],
+                "map_version": int(rec.get("map_version") or 0),
+                "lease_ttl": float(rec.get("lease_ttl")
+                                   or DEFAULT_MIGRATION_LEASE_S),
+                "lease_deadline": float(rec.get("lease_deadline", 0.0)),
+                "started_at": float(rec.get("started_at", 0.0)),
+            }
+        except (KeyError, TypeError, ValueError):
+            return False
+        with self._reshard_lock:
+            self._migration = rec
+            if rec["role"] == "donor" and rec["phase"] == "export":
+                self._draining.update(range(rec["slot_lo"],
+                                            rec["slot_hi"]))
+                if self._lease_expired_locked():
+                    return False
+        print(f"RESHARD_RESTORED migration={rec['id']} "
+              f"role={rec['role']} phase={rec['phase']}", flush=True)
+        return True
 
     def reshard(self, request: bytes, ctx) -> bytes:
         """Admin-plane slot-range handoff (docs/SHARDING.md "Migration
@@ -503,13 +658,40 @@ class ParameterService:
                           f"reshard: unknown op {op!r}")
             raise ValueError(f"unknown reshard op {op!r}")
         self._tm_reshard[op].inc()
+        plan = self._migration_plan(meta.get("migration"))
         # Every reply carries the CURRENT map (full, never delta-gated):
         # the coordinator derives the new partition from the donor's live
         # ranges instead of trusting its own stale picture.
+        if op == "status":
+            # Read-only: the resumed coordinator's crash-point oracle.
+            return pack_msg({"migration": self.migration_view(),
+                             "global_step": self.store.global_step,
+                             **self._shard_fields()})
+        if op == "abort":
+            return self._reshard_abort(plan)
         if op == "export":
             lo, hi = int(meta["slot_lo"]), int(meta["slot_hi"])
             with self._reshard_lock:
+                self._lease_expired_locked()
+                rec = self._migration
+                if rec is not None and (plan is None
+                                        or rec["id"] != plan["id"]):
+                    if ctx is not None:
+                        ctx.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                                  f"reshard: migration {rec['id']} "
+                                  f"already in flight")
+                    raise ValueError("migration already in flight")
                 self._draining.update(range(lo, hi))
+                if plan is not None:
+                    # Same id re-export is idempotent (resume replays the
+                    # phase): the range got no applies while frozen, so a
+                    # second export snapshot is byte-equivalent.
+                    now = time.time()
+                    self._migration = {**plan, "role": "donor",
+                                       "phase": "export",
+                                       "lease_deadline":
+                                           now + plan["lease_ttl"],
+                                       "started_at": now}
             keys = self._keys_in_slots(lo, hi)
             params, step = self.store.export_params(keys)
             return pack_msg({"export_step": step,
@@ -518,20 +700,47 @@ class ParameterService:
                              **self._shard_fields()},
                             encode_tensor_dict(params))
         if op == "import":
+            with self._reshard_lock:
+                rec = self._migration
+                if rec is not None and (plan is None
+                                        or rec["id"] != plan["id"]):
+                    if ctx is not None:
+                        ctx.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                                  f"reshard: migration {rec['id']} "
+                                  f"already in flight")
+                    raise ValueError("migration already in flight")
             params = decode_tensor_dict(payload)
             adopted = self.store.adopt_params(params)
             loaded = self.load_journal(meta.get("journal"))
+            if plan is not None:
+                now = time.time()
+                with self._reshard_lock:
+                    self._migration = {**plan, "role": "recipient",
+                                       "phase": "import",
+                                       "lease_deadline":
+                                           now + plan["lease_ttl"],
+                                       "started_at": now}
             return pack_msg({"adopted": adopted, "journal_loaded": loaded,
                              **self._shard_fields()})
         if op == "apply_ranges":
-            version = self.sharding.adopt_ranges(
-                meta["ranges"], meta.get("map_version"))
+            version = self._apply_ranges(meta)
             # The adopted map is now the sole ownership authority: drain
             # markers for slots handed away are redundant (the range
             # check disowns), and markers for slots the map says we KEEP
             # would contradict it (an aborted handoff must un-freeze).
             with self._reshard_lock:
                 self._draining.clear()
+                rec = self._migration
+                if rec is not None and (plan is None
+                                        or rec["id"] == plan["id"]):
+                    if rec["role"] == "donor":
+                        # Map published: the lease stops applying and
+                        # the only exit is forward (commit).
+                        rec["phase"] = "apply_ranges"
+                    else:
+                        # The recipient now OWNS the adopted range — its
+                        # half of the migration is complete.
+                        self._migration = None
             return pack_msg({"map_version": version,
                              **self._shard_fields()})
         # commit: the recipient holds the range; release the donor copy.
@@ -539,7 +748,54 @@ class ParameterService:
         dropped = self.store.drop_params(self._keys_in_slots(lo, hi))
         with self._reshard_lock:
             self._draining -= set(range(lo, hi))
+            rec = self._migration
+            if rec is not None and (plan is None
+                                    or rec["id"] == plan["id"]):
+                self._migration = None
         return pack_msg({"dropped": dropped, **self._shard_fields()})
+
+    def _apply_ranges(self, meta: dict) -> int:
+        """Adopt the coordinator's partition — idempotently. A resumed
+        coordinator re-applies the SAME plan to every primary; bumping
+        the version again on a primary that already holds it would churn
+        every client's cached map for nothing, so an exact match
+        (ranges AND version already at-or-past the plan's) is a no-op."""
+        ranges = meta["ranges"]
+        want = meta.get("map_version")
+        try:
+            want_i = None if want is None else int(want)
+            norm = [(int(a), int(b)) for a, b in ranges]
+        except (TypeError, ValueError):
+            want_i, norm = None, None
+        if want_i is not None and norm is not None \
+                and self.sharding.version >= want_i \
+                and self.sharding.ranges() == norm:
+            return self.sharding.version
+        return self.sharding.adopt_ranges(ranges, want)
+
+    def _reshard_abort(self, plan: dict | None) -> bytes:
+        """Roll back this primary's half of a migration: donor
+        unfreezes; a recipient that never came to own the range drops
+        its adopted copies (ownership stays exclusive — the donor still
+        owns and serves them). The live map is untouched either way."""
+        dropped = 0
+        with self._reshard_lock:
+            rec = self._migration
+            if rec is not None and (plan is None
+                                    or rec["id"] == plan["id"]):
+                if rec["role"] == "recipient":
+                    lo, hi = rec["slot_lo"], rec["slot_hi"]
+                    my_lo, my_hi = self.sharding.my_range()
+                    if not (my_lo <= lo and hi <= my_hi):
+                        dropped = self.store.drop_params(
+                            self._keys_in_slots(lo, hi))
+                self._draining.clear()
+                self._migration = None
+                print(f"RESHARD_ABORT migration={rec['id']} "
+                      f"role={rec['role']} phase={rec['phase']} "
+                      f"dropped={dropped}", flush=True)
+        return pack_msg({"aborted": True, "dropped": dropped,
+                         **self._shard_fields()})
 
     def register_worker(self, request: bytes, ctx) -> bytes:
         meta, _ = unpack_msg(request)
@@ -614,6 +870,14 @@ class ParameterService:
             # acks and act on them; every other pairing degrades to a
             # directive-less wire.
             "directives": True,
+            # Checksum capability (docs/WIRE_PROTOCOL.md "Checksum
+            # trailer"): this server verifies the CRC-32 trailer on push
+            # frames and REFUSES corrupt ones. Capable clients attach
+            # the trailer to their push payloads; legacy pairings
+            # degrade to unchecksummed frames exactly like delta_fetch /
+            # trace_context (a server that never advertised would choke
+            # on the 4 trailer bytes, so the client must gate on this).
+            "checksum": True,
             **self._qscale_fields(),
             **self._membership_fields(),
             # Shard-map capability (docs/SHARDING.md): present only when
@@ -638,9 +902,33 @@ class ParameterService:
         except Exception:  # noqa: BLE001
             pass
 
+    def _refuse_corrupt(self, wid, meta: dict) -> bytes:
+        """Refuse a push whose payload failed integrity verification
+        (CRC trailer mismatch, or a frame the decoder rejects): counted
+        (``dps_wire_corrupt_total``), surfaced to the health engine
+        (``wire_corrupt`` rule), never applied — and never journaled, so
+        the client's clean retry of the same token can still apply."""
+        self._tm_wire_corrupt.inc()
+        if self.monitor is not None:
+            try:
+                self.monitor.note_corrupt_frame()
+            except Exception:  # noqa: BLE001 — observability only
+                pass
+        print(f"WIRE_CORRUPT push refused worker={wid}", flush=True)
+        return pack_msg({"received": False, "accepted": False,
+                         "corrupt": True,
+                         "global_step": self.store.global_step,
+                         **self._directive_fields(wid, meta)})
+
     def push_gradrients(self, request: bytes, ctx) -> bytes:
         meta, payload = unpack_msg(request)
         wid = int(meta["worker_id"])
+        # Integrity gate FIRST — before the dedupe lifecycle records
+        # anything for this token. frame_checksum_ok is None (no
+        # trailer: legacy peer, nothing to verify) or a verdict; only an
+        # explicit False refuses.
+        if len(payload) and frame_checksum_ok(payload) is False:
+            return self._refuse_corrupt(wid, meta)
         self._ingest_health(wid, meta)
         self._expire_tick()
         health = meta.get("health")
@@ -706,11 +994,13 @@ class ParameterService:
                     remaining = ctx.time_remaining()
                 if remaining is not None:
                     budget = max(0.0, min(budget, remaining - 1.0))
-                finished = dup[2].wait(timeout=budget)
-                if not finished and dup[1] is None:
-                    # Original STILL running after the wait: don't invent
-                    # an outcome in either direction — fail retryably so
-                    # the client's next attempt re-checks.
+                dup[2].wait(timeout=budget)
+                if dup[1] is None:
+                    # Original STILL running after the wait — or it was
+                    # corrupt-refused and its entry undone (event set,
+                    # outcome never recorded): don't invent an outcome
+                    # in either direction — fail retryably so the
+                    # client's next attempt re-checks.
                     if ctx is not None:
                         ctx.abort(grpc.StatusCode.UNAVAILABLE,
                                   "push still in flight; retry")
@@ -729,7 +1019,21 @@ class ParameterService:
                              "quarantined": True,
                              "global_step": self.store.global_step,
                              **self._directive_fields(wid, meta)})
-        grads = decode_tensor_dict(payload)
+        try:
+            grads = decode_tensor_dict(payload)
+        except ValueError:
+            # A garbled frame that carried no trailer (or a truncation
+            # the cheap pre-check let through): refuse it like a CRC
+            # failure, and UNDO the in-flight dedupe entry so a clean
+            # retry of the same token applies instead of replaying a
+            # refusal. Waiters on the entry wake (outcome None) and
+            # fail retryably.
+            if entry is not None:
+                with self._push_seen_lock:
+                    if self._push_seen.get(nonce) is entry:
+                        del self._push_seen[nonce]
+                entry[2].set()
+            return self._refuse_corrupt(wid, meta)
         # Ownership filter (docs/SHARDING.md "Migration protocol"): keys
         # whose slot this primary no longer owns — the map moved while
         # the client pushed on a cached one, or the slot is mid-handoff

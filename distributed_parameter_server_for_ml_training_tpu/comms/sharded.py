@@ -106,7 +106,7 @@ class ShardedRemoteStore:
         if name in {"push_codec", "fetch_codec", "supports_delta_fetch",
                     "supports_trace_context", "supports_health_report",
                     "supports_compressed_domain", "supports_directives",
-                    "config"}:
+                    "supports_checksum", "config"}:
             return getattr(self._stores[0], name)
         raise AttributeError(name)
 

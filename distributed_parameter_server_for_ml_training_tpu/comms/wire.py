@@ -12,7 +12,14 @@ Frame v2 (current)::
 
 header: {"tensors": [{"name": str, "dtype": str, "shape": [int...]}...]}
 Buffers are C-contiguous little-endian, concatenated in header order.
-flags bit 0 marks a CHUNK frame (see *Chunked framing* below).
+flags bit 0 marks a CHUNK frame (see *Chunked framing* below); flags
+bit 1 marks a CHECKSUMMED frame carrying a 4-byte CRC-32 trailer over
+everything before it (preamble + header + buffers) — corruption anywhere
+in the frame, header included, fails decode with ``ValueError`` instead
+of applying garbled tensors (docs/WIRE_PROTOCOL.md "Checksum trailer").
+The trailer is capability-gated by the caller: legacy decoders that
+predate it would mistake the 4 extra bytes for buffer slack, so encoders
+only set it for peers that advertised ``checksum`` at registration.
 
 Frame v1 (legacy, still decoded)::
 
@@ -49,6 +56,7 @@ from __future__ import annotations
 import json
 import math
 import struct
+import zlib
 from typing import Callable, Mapping
 
 import ml_dtypes  # ships with jax; provides the numpy bfloat16 dtype
@@ -62,8 +70,13 @@ from ..ops.packed import PackedInt4, as_packed_int4, packed_int4_nbytes
 WIRE_MAGIC = 0xD5
 WIRE_VERSION = 2
 FLAG_CHUNK = 0x01
+#: Frame carries a 4-byte CRC-32 trailer (zlib.crc32 — the stdlib
+#: checksum; the container ships no crc32c wheel, and the repo already
+#: keys its slot space on the same polynomial, ps/sharding.py:key_slot).
+FLAG_CRC = 0x02
 
 _PREAMBLE = 4  # magic + version + flags + reserved
+_CRC_TRAILER = 4  # u32 LE crc32 appended after the last buffer
 
 #: Upper bound on the JSON tensor table. A real table is ~100 bytes per
 #: tensor; 16 MiB is orders of magnitude past any real model and small
@@ -144,18 +157,30 @@ def _prepare(tensors: Mapping[str, np.ndarray]) -> tuple[list, list]:
 
 
 # dpslint: hot-path — the ONE sanctioned copy is the final join
-def _frame(header_obj: dict, bodies: list, flags: int = 0) -> bytes:
+def _frame(header_obj: dict, bodies: list, flags: int = 0,
+           checksum: bool = False) -> bytes:
     """Assemble one v2 frame. ``bodies`` are buffer-protocol objects; each
-    is copied exactly once by the final join."""
+    is copied exactly once by the final join. ``checksum`` sets FLAG_CRC
+    and appends the CRC-32 trailer; the CRC is accumulated incrementally
+    over the pieces BEFORE the join, so the one-copy-per-tensor
+    discipline holds for checksummed frames too."""
+    if checksum:
+        flags |= FLAG_CRC
     header = json.dumps(header_obj).encode("utf-8")
     preamble = struct.pack("<BBBBI", WIRE_MAGIC, WIRE_VERSION, flags, 0,
                            len(header))
-    return b"".join([preamble, header, *bodies])
+    if not checksum:
+        return b"".join([preamble, header, *bodies])
+    crc = zlib.crc32(header, zlib.crc32(preamble))
+    for b in bodies:
+        crc = zlib.crc32(b, crc)
+    return b"".join([preamble, header, *bodies, struct.pack("<I", crc)])
 
 
 # dpslint: hot-path — one buffer copy per tensor, enforced statically
 def encode_tensor_dict(tensors: Mapping[str, np.ndarray],
-                       trace: dict | None = None) -> bytes:
+                       trace: dict | None = None,
+                       checksum: bool = False) -> bytes:
     """Encode to a single v2 frame (one buffer copy per tensor).
 
     ``trace`` (optional, capability-gated by the caller —
@@ -164,7 +189,11 @@ def encode_tensor_dict(tensors: Mapping[str, np.ndarray],
     context of the worker operation that produced this payload. Decoders
     that don't know the field ignore it (the tensor table is keyed), and
     legacy v1 frames simply never carry one — mixed versions degrade to
-    untraced, never break."""
+    untraced, never break.
+
+    ``checksum`` (capability-gated by the caller exactly like ``trace``)
+    appends the CRC-32 integrity trailer — only send it to peers that
+    advertised ``checksum`` at registration."""
     metas, arrays = _prepare(tensors)
     for m, a in zip(metas, arrays):
         if a.nbytes:
@@ -172,17 +201,23 @@ def encode_tensor_dict(tensors: Mapping[str, np.ndarray],
     header: dict = {"tensors": metas}
     if trace is not None:
         header["trace"] = trace
-    return _frame(header, [_buffer_view(a) for a in arrays])
+    return _frame(header, [_buffer_view(a) for a in arrays],
+                  checksum=checksum)
 
 
 def encode_tensor_dict_chunks(tensors: Mapping[str, np.ndarray],
-                              max_chunk_bytes: int) -> list[bytes]:
+                              max_chunk_bytes: int,
+                              checksum: bool = False) -> list[bytes]:
     """Encode as N chunk frames, each body at most ``max_chunk_bytes``.
 
     Chunk 0's header carries the tensor table + total payload length; every
     chunk's header carries ``{"chunk": {"index", "total", "offset"}}``.
     Splits land on tensor boundaries when possible (zero-copy reassembly);
     a tensor larger than the budget is hard-split mid-buffer.
+
+    ``checksum`` appends the CRC-32 trailer to EVERY chunk frame — each
+    chunk is verified independently at parse, so reassembly only ever
+    sees clean segments.
     """
     if max_chunk_bytes < 1:
         raise ValueError(f"max_chunk_bytes must be >= 1, got "
@@ -222,7 +257,8 @@ def encode_tensor_dict_chunks(tensors: Mapping[str, np.ndarray],
         if i == 0:
             header["tensors"] = metas
             header["payload_len"] = total_payload
-        frames.append(_frame(header, bodies, flags=FLAG_CHUNK))
+        frames.append(_frame(header, bodies, flags=FLAG_CHUNK,
+                             checksum=checksum))
         offset += size
     return frames
 
@@ -252,6 +288,16 @@ def _parse_frame(payload) -> tuple[dict, memoryview, int]:
         raise ValueError(f"unsupported wire version {mv[1]}")
     else:
         flags, header_off = 0, 0  # let the v1 length checks reject it
+    if flags & FLAG_CRC:
+        # Verify BEFORE trusting anything length-prefixed: the CRC covers
+        # the whole frame (header included), so a flipped header byte
+        # fails here rather than steering the tensor-table parse.
+        if len(mv) < header_off + 4 + _CRC_TRAILER:
+            raise ValueError("truncated payload")
+        (want,) = struct.unpack_from("<I", mv, len(mv) - _CRC_TRAILER)
+        if zlib.crc32(mv[:len(mv) - _CRC_TRAILER]) != want:
+            raise ValueError("wire checksum mismatch (corrupt frame)")
+        mv = mv[:len(mv) - _CRC_TRAILER]
     if len(mv) < header_off + 4:
         raise ValueError("truncated payload")
     (hlen,) = struct.unpack_from("<I", payload, header_off)
@@ -349,6 +395,26 @@ def peek_trace(payload) -> dict | None:
         return None
     trace = header.get("trace")
     return trace if isinstance(trace, dict) else None
+
+
+def frame_checksum_ok(payload) -> bool | None:
+    """Cheap integrity verdict for one frame: ``True`` (CRC trailer
+    present and valid), ``False`` (present but wrong — corrupt or
+    truncated), ``None`` (frame carries no trailer: legacy v1, or a v2
+    peer that never negotiated the capability — nothing to verify).
+
+    The push handler calls this BEFORE the dedupe lifecycle
+    (comms/service.py): a corrupt push must be refused without recording
+    a token entry, so the client's clean retry of the same token can
+    still apply."""
+    mv = memoryview(payload)
+    if (len(mv) < _PREAMBLE or mv[0] != WIRE_MAGIC
+            or mv[1] != WIRE_VERSION or not mv[2] & FLAG_CRC):
+        return None
+    if len(mv) < _PREAMBLE + 4 + _CRC_TRAILER:
+        return False
+    (want,) = struct.unpack_from("<I", mv, len(mv) - _CRC_TRAILER)
+    return zlib.crc32(mv[:len(mv) - _CRC_TRAILER]) == want
 
 
 def is_chunk_frame(payload) -> bool:

@@ -254,6 +254,11 @@ class ShardInfo:
         self._tm_map_version.set(self._version)
         self._reg = reg
         self._tm_lag: dict[str, tuple] = {}
+        #: Optional zero-arg callable returning the in-flight migration
+        #: block for ``view()`` (or None when idle). The owning service
+        #: installs its ``migration_view`` here so ``GET /cluster``
+        #: surfaces live reshard state without sharding importing comms.
+        self.migration_provider = None
 
     @property
     def version(self) -> int:
@@ -365,9 +370,17 @@ class ShardInfo:
                  "announce_age_s": round(max(0.0, now - r["ts"]), 3)}
                 for a, r in sorted(self._replicas.items())
             ]
-            return {"shard_id": self.shard_id,
-                    "shard_count": self.shard_count,
-                    "map_version": self._version,
-                    "slot_range": list(self._ranges[self.shard_id]),
-                    "primaries": list(self.primaries),
-                    "replicas": replicas}
+            out = {"shard_id": self.shard_id,
+                   "shard_count": self.shard_count,
+                   "map_version": self._version,
+                   "slot_range": list(self._ranges[self.shard_id]),
+                   "primaries": list(self.primaries),
+                   "replicas": replicas}
+        if self.migration_provider is not None:
+            try:
+                mig = self.migration_provider()
+            except Exception:  # noqa: BLE001 — view is observability only
+                mig = None
+            if mig is not None:
+                out["migration"] = mig
+        return out

@@ -155,6 +155,12 @@ class ClusterMonitor:
         # never accumulate staleness_min_pushes.
         self._push_window: tuple[float, int, int] = \
             (clock(), *self._push_totals())
+        # Corrupt-frame refusals (wire CRC, comms/service.py): a running
+        # total fed by note_corrupt_frame, windowed exactly like the push
+        # deltas so the wire_corrupt alert holds for a full monitor
+        # interval rather than the single scrape that drained it.
+        self._corrupt_total = 0  # guarded by: self._lock
+        self._corrupt_window: tuple[float, int] = (clock(), 0)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         # Alert edge-event listeners (the remediation engine attaches
@@ -232,6 +238,13 @@ class ClusterMonitor:
                 self._tm_grad.observe(gn)
         return True
 
+    def note_corrupt_frame(self, n: int = 1) -> None:
+        """Count one refused corrupt push frame (the service calls this
+        beside ``dps_wire_corrupt_total``); feeds the ``wire_corrupt``
+        health rule on the next evaluation pass."""
+        with self._lock:
+            self._corrupt_total += int(n)
+
     def note_expired(self, worker_ids) -> None:
         """Feed membership-expiry results (the serve loop already calls
         ``store.expire_stale_workers()`` every tick; it hands the reaped ids
@@ -259,6 +272,7 @@ class ClusterMonitor:
             reports = dict(self._reports)
             expired = self._expired_pending
             self._expired_pending = []
+            corrupt_total = self._corrupt_total
             # A worker that left membership WITHOUT being expired finished
             # cleanly — drop its report so it neither alerts nor lingers
             # in the view. Expired workers keep theirs (the dead-worker
@@ -283,6 +297,9 @@ class ClusterMonitor:
         w_start, acc0, rej0 = self._push_window
         if now - w_start >= self.interval:
             self._push_window = (now, acc, rej)
+        c_start, c0 = self._corrupt_window
+        if now - c_start >= self.interval:
+            self._corrupt_window = (now, corrupt_total)
         slo_breaches: list = []
         if self.slo is not None:
             try:
@@ -297,6 +314,7 @@ class ClusterMonitor:
             expired=expired,
             pushes_accepted_delta=max(0, acc - acc0),
             pushes_rejected_delta=max(0, rej - rej0),
+            corrupt_frames_delta=max(0, corrupt_total - c0),
             slo_breaches=slo_breaches)
 
     def evaluate(self) -> list[dict]:

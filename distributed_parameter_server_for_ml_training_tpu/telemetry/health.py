@@ -72,6 +72,9 @@ RULE_CATALOG = {
     "staleness_spike": (
         "warning", "rejected-push fraction over the evaluation window above "
                    "staleness_reject_ratio (async staleness gate thrashing)"),
+    "wire_corrupt": (
+        "warning", "a push payload failed the wire CRC check this window "
+                   "and was refused (dps_wire_corrupt_total)"),
     "loss_plateau": (
         "info", "best loss improved less than plateau_min_improvement over "
                 "plateau_window_s of reports"),
@@ -149,6 +152,9 @@ class ClusterState:
     #: Push outcome deltas since the last pass (async staleness gate).
     pushes_accepted_delta: int = 0
     pushes_rejected_delta: int = 0
+    #: Corrupt push frames REFUSED over the evaluation window (wire CRC
+    #: trailer, comms/service.py) — any nonzero value alerts.
+    corrupt_frames_delta: int = 0
     #: SLO burn-rate breaches from the attached SloEvaluator this pass
     #: (telemetry/slo.py ``evaluate()`` dicts); empty when no evaluator.
     slo_breaches: list = field(default_factory=list)
@@ -467,6 +473,18 @@ class HealthRuleEngine:
                  f"rejected by the staleness gate this window",
                  value=round(ratio, 4),
                  threshold=t.staleness_reject_ratio)
+
+        # 6b) corrupt wire frames (push CRC trailer, comms/service.py).
+        # Unlike the staleness spike there is no healthy baseline rate:
+        # ONE refused frame means either real wire/memory damage or an
+        # injected chaos schedule doing its job, so any nonzero window
+        # fires. The window is time-anchored by the monitor (one
+        # interval), so the alert outlives the single scrape that saw it.
+        if state.corrupt_frames_delta > 0:
+            fire("wire_corrupt", None,
+                 f"{state.corrupt_frames_delta} corrupt push frame(s) "
+                 f"refused this window (wire CRC mismatch)",
+                 value=float(state.corrupt_frames_delta), threshold=0.0)
 
         # 7) SLO burn-rate breaches (telemetry/slo.py, attached by the
         # monitor). One aggregated alert per rule — alert identity is

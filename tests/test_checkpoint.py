@@ -219,3 +219,83 @@ def _reinit_async(trainer, cfg, backend="python"):
                     learning_rate=cfg.learning_rate,
                     push_codec=codec,
                     staleness_bound=cfg.staleness_bound))
+
+
+# -- torn-write / corrupt-snapshot recovery (docs/ROBUSTNESS.md) -------------
+
+
+def _snap_dir_with_two_records(tmp_path):
+    """Two snapshots of one async store: steps 1 and 2."""
+    store = ParameterStore(
+        {"w": np.ones(64, np.float32)},
+        StoreConfig(mode="async", total_workers=1, push_codec="none",
+                    staleness_bound=100))
+    store.push(0, {"w": np.full(64, 0.5, np.float32)}, 0)
+    save_store(store, str(tmp_path))
+    store.push(0, {"w": np.full(64, 0.25, np.float32)}, 1)
+    save_store(store, str(tmp_path))
+    assert sorted(f.name for f in tmp_path.glob("*.npz")) \
+        == ["store_00000001.npz", "store_00000002.npz"]
+    return store
+
+
+def test_truncated_npz_falls_back_to_previous(tmp_path, capsys):
+    """A torn write (crash mid-npz) costs ONE checkpoint interval, not
+    the restore: the loader walks back to the previous valid snapshot
+    with a visible log line."""
+    _snap_dir_with_two_records(tmp_path)
+    newest = tmp_path / "store_00000002.npz"
+    newest.write_bytes(newest.read_bytes()[: newest.stat().st_size // 2])
+
+    fresh = ParameterStore(
+        {"w": np.zeros(64, np.float32)},
+        StoreConfig(mode="async", total_workers=1, push_codec="none",
+                    staleness_bound=100))
+    assert restore_store(fresh, str(tmp_path)) == 1
+    out = capsys.readouterr().out
+    assert "CHECKPOINT_FALLBACK store_00000002.npz" in out
+
+
+def test_bitflip_caught_by_crc_stamp(tmp_path, capsys):
+    """Same-size on-disk damage — invisible to a length check, caught by
+    the v3 npz CRC stamp."""
+    _snap_dir_with_two_records(tmp_path)
+    newest = tmp_path / "store_00000002.npz"
+    raw = bytearray(newest.read_bytes())
+    raw[len(raw) // 2] ^= 0xFF
+    newest.write_bytes(bytes(raw))
+
+    fresh = ParameterStore(
+        {"w": np.zeros(64, np.float32)},
+        StoreConfig(mode="async", total_workers=1, push_codec="none",
+                    staleness_bound=100))
+    assert restore_store(fresh, str(tmp_path)) == 1
+    assert "checksum mismatch" in capsys.readouterr().out
+
+
+def test_explicit_step_stays_strict(tmp_path):
+    """An explicit ``step=`` is load-bearing: damage there raises, it is
+    never silently substituted with a different step."""
+    from distributed_parameter_server_for_ml_training_tpu.checkpoint import (
+        load_store_record)
+
+    _snap_dir_with_two_records(tmp_path)
+    newest = tmp_path / "store_00000002.npz"
+    newest.write_bytes(newest.read_bytes()[: newest.stat().st_size // 2])
+    with pytest.raises(Exception):  # noqa: B017 — torn zip OR crc error
+        load_store_record(str(tmp_path), step=2)
+    # ...while step=1 still loads exactly.
+    params, meta = load_store_record(str(tmp_path), step=1)
+    assert meta["global_step"] == 1 and "w" in params
+
+
+def test_all_records_damaged_raises_with_evidence(tmp_path):
+    _snap_dir_with_two_records(tmp_path)
+    for f in tmp_path.glob("*.npz"):
+        f.write_bytes(b"not a zip")
+    fresh = ParameterStore(
+        {"w": np.zeros(64, np.float32)},
+        StoreConfig(mode="async", total_workers=1, push_codec="none",
+                    staleness_bound=100))
+    with pytest.raises(FileNotFoundError, match="no valid store snapshot"):
+        restore_store(fresh, str(tmp_path))

@@ -1,0 +1,208 @@
+"""End-to-end payload integrity (docs/WIRE_PROTOCOL.md "Checksum
+trailer", docs/ROBUSTNESS.md fault grammar, tier-1).
+
+Layers covered, cheapest first:
+
+- wire codec: the CRC-32 trailer round-trips, any flipped byte fails
+  decode LOUD (header included — the trailer is verified before the
+  header JSON is parsed), legacy frames stay verdict-less, and every
+  chunk frame carries its own trailer;
+- ``corrupt_request``: the injector's byte flip is deterministic in its
+  salt and lands past the envelope meta (the envelope still parses; the
+  tensor payload is what's damaged);
+- fault spec grammar: the ``reshard``/``refresh``/``subscribe`` ops and
+  ``partition``/``corrupt`` kinds parse, ``any`` still spans exactly the
+  four worker RPCs, a partition window drops every call inside it
+  without consuming trigger state, and the injection-counter grid stays
+  dense over the full op x kind vocabulary;
+- service refusal: a corrupt push is refused un-journaled (the clean
+  retry of the SAME token still applies), counted in
+  ``dps_wire_corrupt_total``, and surfaced as the ``wire_corrupt``
+  health rule; registration advertises the ``checksum`` capability.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from distributed_parameter_server_for_ml_training_tpu.comms.faults import (
+    ANY_EXCLUDED, FAULT_KINDS, FAULT_OPS, REFRESH_OP, SUBSCRIBE_OP,
+    FaultInjector, corrupt_request, parse_fault_spec)
+from distributed_parameter_server_for_ml_training_tpu.comms.service import (
+    ParameterService, pack_msg, unpack_msg)
+from distributed_parameter_server_for_ml_training_tpu.comms.wire import (
+    FLAG_CRC, decode_tensor_dict, decode_tensor_dict_chunks,
+    encode_tensor_dict, encode_tensor_dict_chunks, frame_checksum_ok)
+from distributed_parameter_server_for_ml_training_tpu.ps.store import (
+    ParameterStore, StoreConfig)
+from distributed_parameter_server_for_ml_training_tpu.telemetry.health import (
+    ClusterState, HealthRuleEngine)
+
+
+def _tensors():
+    return {"layer0/kernel": np.arange(12, dtype=np.float32).reshape(3, 4),
+            "layer0/bias": np.ones(4, np.float32)}
+
+
+class TestWireChecksum:
+    def test_trailer_roundtrip_and_flag(self):
+        frame = encode_tensor_dict(_tensors(), checksum=True)
+        assert frame[2] & FLAG_CRC
+        assert frame_checksum_ok(frame) is True
+        out = decode_tensor_dict(frame)
+        np.testing.assert_array_equal(out["layer0/kernel"],
+                                      _tensors()["layer0/kernel"])
+
+    def test_trailer_costs_exactly_four_bytes(self):
+        plain = encode_tensor_dict(_tensors())
+        checked = encode_tensor_dict(_tensors(), checksum=True)
+        assert len(checked) == len(plain) + 4
+
+    def test_any_flipped_byte_fails_decode(self):
+        frame = bytearray(encode_tensor_dict(_tensors(), checksum=True))
+        # Probe the whole structure: preamble, header, buffers, trailer.
+        for off in (2, 9, len(frame) // 2, len(frame) - 6, len(frame) - 1):
+            damaged = bytearray(frame)
+            damaged[off] ^= 0x40
+            assert frame_checksum_ok(bytes(damaged)) is False
+            with pytest.raises(ValueError, match="checksum mismatch"):
+                decode_tensor_dict(bytes(damaged))
+
+    def test_legacy_frame_has_no_verdict(self):
+        frame = encode_tensor_dict(_tensors())
+        assert frame_checksum_ok(frame) is None
+        # ...and a flipped buffer byte decodes SILENTLY wrong — the
+        # failure mode the trailer exists to close.
+        damaged = bytearray(frame)
+        damaged[-1] ^= 0x01
+        decode_tensor_dict(bytes(damaged))
+
+    def test_chunk_frames_carry_individual_trailers(self):
+        frames = encode_tensor_dict_chunks(_tensors(), max_chunk_bytes=16,
+                                           checksum=True)
+        assert len(frames) > 1
+        assert all(frame_checksum_ok(f) is True for f in frames)
+        out = decode_tensor_dict_chunks(frames)
+        np.testing.assert_array_equal(out["layer0/bias"], np.ones(4))
+        damaged = list(frames)
+        damaged[1] = damaged[1][:-1] + bytes([damaged[1][-1] ^ 0xFF])
+        with pytest.raises(ValueError, match="checksum mismatch"):
+            decode_tensor_dict_chunks(damaged)
+
+
+class TestCorruptRequest:
+    def test_flip_is_deterministic_in_salt(self):
+        req = pack_msg({"worker_id": 0},
+                       encode_tensor_dict(_tensors(), checksum=True))
+        assert corrupt_request(req, 1) == corrupt_request(req, 1)
+        assert corrupt_request(req, 1) != req
+
+    def test_flip_lands_past_envelope_meta(self):
+        payload = encode_tensor_dict(_tensors(), checksum=True)
+        req = pack_msg({"worker_id": 3, "push_token": "ab:1"}, payload)
+        for salt in range(1, 6):
+            meta, damaged = unpack_msg(corrupt_request(req, salt))
+            # The envelope meta survives; the tensor payload is damaged
+            # — either the trailer verdict flips to False or (flip in
+            # the frame preamble) the decode itself fails loud. Both
+            # land in the service's refusal path; neither applies.
+            assert meta["worker_id"] == 3
+            assert bytes(damaged) != payload
+            if frame_checksum_ok(bytes(damaged)) is not False:
+                with pytest.raises(ValueError):
+                    decode_tensor_dict(bytes(damaged))
+
+
+class TestFaultSpecVocabulary:
+    def test_new_ops_and_kinds_parse(self):
+        _, rules = parse_fault_spec(
+            "reshard.kill@n=2;refresh.partition=2@n=5;"
+            "subscribe.unavailable@every=3;push.corrupt@every=4")
+        assert [(r.op, r.kind) for r in rules] == [
+            ("reshard", "kill"), ("refresh", "partition"),
+            ("subscribe", "unavailable"), ("push", "corrupt")]
+        assert FAULT_OPS["refresh"] == REFRESH_OP
+        assert FAULT_OPS["subscribe"] == SUBSCRIBE_OP
+
+    def test_any_still_means_the_four_worker_rpcs(self):
+        _, (rule,) = parse_fault_spec("any.unavailable@every=1")
+        for rpc in ("PushGradrients", "FetchParameters",
+                    "RegisterWorker", "JobFinished"):
+            assert rule.matches_rpc(rpc)
+        for rpc in sorted(ANY_EXCLUDED):
+            assert not rule.matches_rpc(rpc)
+
+    def test_partition_window_drops_without_consuming_triggers(self):
+        fi = FaultInjector("refresh.partition=0.3@n=1", _telemetry=False)
+        first = fi.decide(REFRESH_OP)
+        assert first is not None and first.kind == "partition"
+        # Calls 2..4 land inside the open window: all drop, even though
+        # the n=1 trigger was already consumed.
+        for _ in range(3):
+            rule = fi.decide(REFRESH_OP)
+            assert rule is not None and rule.kind == "partition"
+        time.sleep(0.35)
+        assert fi.decide(REFRESH_OP) is None  # window closed, n=1 spent
+
+    def test_corrupt_salt_counts_hits(self):
+        fi = FaultInjector("push.corrupt@every=2", _telemetry=False)
+        assert fi.decide("PushGradrients") is None
+        rule = fi.decide("PushGradrients")
+        assert rule is not None and rule.kind == "corrupt"
+        assert fi.corrupt_salt(rule) == 1
+        fi.decide("PushGradrients")
+        rule = fi.decide("PushGradrients")
+        assert fi.corrupt_salt(rule) == 2
+
+    def test_injection_counter_grid_stays_dense(self):
+        fi = FaultInjector("push.corrupt@every=2", _telemetry=False)
+        assert set(fi._tm) == {(op, kind) for op in FAULT_OPS
+                               for kind in FAULT_KINDS}
+
+
+def _svc(monitor=None):
+    store = ParameterStore(
+        {"w": np.ones(8, np.float32)},
+        StoreConfig(mode="async", total_workers=1, push_codec="none",
+                    staleness_bound=100))
+    return store, ParameterService(store, monitor=monitor)
+
+
+class TestCorruptPushRefusal:
+    def test_register_advertises_checksum(self):
+        _, svc = _svc()
+        reply, _ = unpack_msg(
+            svc.register_worker(pack_msg({"worker_name": "w"}), None))
+        assert reply.get("checksum") is True
+
+    def test_corrupt_push_refused_clean_retry_applies(self):
+        store, svc = _svc()
+        payload = encode_tensor_dict({"w": np.full(8, 0.5, np.float32)},
+                                     checksum=True)
+        meta = {"worker_id": 0, "fetched_step": 0, "push_token": "n0:1"}
+        req = pack_msg(meta, payload)
+        rmeta, _ = unpack_msg(
+            svc.push_gradrients(corrupt_request(req, 1), None))
+        assert rmeta["accepted"] is False and rmeta["corrupt"] is True
+        assert store.stats.gradients_processed == 0
+        # The refusal must NOT have journaled the token: the client's
+        # clean retry of the SAME token applies normally.
+        rmeta, _ = unpack_msg(svc.push_gradrients(req, None))
+        assert rmeta["accepted"] is True
+        assert rmeta.get("duplicate") is None
+        assert store.stats.gradients_processed == 1
+
+    def test_wire_corrupt_rule_fires_on_window_delta(self):
+        e = HealthRuleEngine()
+        evs = e.evaluate(ClusterState(ts=1000.0, global_step=0, workers={},
+                                      corrupt_frames_delta=2))
+        fired = [ev for ev in evs if ev["rule"] == "wire_corrupt"]
+        assert fired and fired[0]["severity"] == "warning"
+        assert fired[0]["state"] == "fired"
+        # A clean window resolves it — the alert is a window delta, not
+        # a latched total.
+        evs = e.evaluate(ClusterState(ts=1001.0, global_step=0,
+                                      workers={}))
+        assert [ev["state"] for ev in evs
+                if ev["rule"] == "wire_corrupt"] == ["resolved"]

@@ -21,7 +21,8 @@ import numpy as np
 import pytest
 
 from distributed_parameter_server_for_ml_training_tpu.checkpoint import (
-    load_store_record, restore_server_state, save_store)
+    STORE_SNAPSHOT_VERSION, load_store_record, restore_server_state,
+    save_store)
 from distributed_parameter_server_for_ml_training_tpu.comms import (
     FaultInjector, RemoteStore, SessionLostError, encode_tensor_dict, serve)
 from distributed_parameter_server_for_ml_training_tpu.comms.service import (
@@ -138,8 +139,10 @@ class TestDurableServerState:
         return store, ParameterService(store)
 
     def test_snapshot_roundtrip_with_journal(self, tmp_path):
-        """Format-v2 record: params + step + aggregation config + the
-        push-token journal all survive the round trip."""
+        """Current-format record: params + step + aggregation config +
+        the push-token journal all survive the round trip (v3 adds the
+        CRC stamp + migration block; tests/test_checkpoint.py pins
+        those)."""
         store, svc = self._svc(mode="async", staleness_bound=7)
         svc.push_gradrients(_push_request(0, "j:1", 0.5), None)
         svc.push_gradrients(_push_request(0, "j:2", 0.25, fetched_step=1),
@@ -147,7 +150,7 @@ class TestDurableServerState:
         save_store(store, str(tmp_path), journal_fn=svc.journal_snapshot)
 
         params, meta = load_store_record(str(tmp_path))
-        assert meta["format_version"] == 2
+        assert meta["format_version"] == STORE_SNAPSHOT_VERSION
         assert meta["global_step"] == 2
         assert meta["aggregation"]["mode"] == "async"
         assert meta["aggregation"]["staleness_bound"] == 7

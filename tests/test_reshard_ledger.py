@@ -1,0 +1,250 @@
+"""Durable migration ledger + lease tests (docs/ROBUSTNESS.md
+"Migration failure matrix", tier-1).
+
+Covers the per-primary half of crash-safe resharding over DIRECT service
+calls (no gRPC — the wire path is pinned by test_serve_tier.py and the
+recorded chaos artifact):
+
+- ``export`` with a coordinator plan journals the donor record and
+  starts the lease; same-id re-export is idempotent, a different id is
+  refused while one is in flight;
+- ``import`` journals the recipient record; ``abort`` rolls the graft
+  back (recipient drops exactly the migrated range) and unfreezes the
+  donor, map untouched;
+- a lapsed lease auto-unfreezes the donor and drops its record — lazily,
+  at the next reshard op / view — without touching the map;
+- ``apply_ranges`` flips the donor to the roll-forward-only phase
+  (lease stops), clears the recipient record, and re-applies as a
+  no-op; ``commit`` clears the donor record;
+- the record round-trips through the snapshot meta
+  (``save_store(migration_fn=...)`` -> ``load_migration``), re-freezing
+  a donor-export restore and auto-aborting one whose lease lapsed while
+  the server was down;
+- the replica refresh loop backs off on poll failures, counts them, and
+  logs the failing/recovered transition exactly once per transition.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_parameter_server_for_ml_training_tpu.checkpoint import (
+    save_store)
+from distributed_parameter_server_for_ml_training_tpu.comms.replica import (
+    ReplicaServer)
+from distributed_parameter_server_for_ml_training_tpu.comms.service import (
+    ParameterService, pack_msg, unpack_msg)
+from distributed_parameter_server_for_ml_training_tpu.ps.sharding import (
+    ShardInfo, key_slot)
+from distributed_parameter_server_for_ml_training_tpu.ps.store import (
+    ParameterStore, StoreConfig)
+
+
+def _pick_keys(n_lo=3, n_hi=2):
+    lo, hi, i = [], [], 0
+    while len(lo) < n_lo or len(hi) < n_hi:
+        k = f"layer{i}/kernel"
+        (lo if key_slot(k) < 32 else hi).append(k)
+        i += 1
+    return lo[:n_lo], hi[:n_hi]
+
+
+def _pair():
+    """Two in-process shard primaries (direct calls, no gRPC)."""
+    lo_keys, hi_keys = _pick_keys()
+    stores, svcs = [], []
+    for i, keys in enumerate((lo_keys, hi_keys)):
+        store = ParameterStore(
+            {k: np.full(4, 1.0, np.float32) for k in keys},
+            StoreConfig(mode="async", total_workers=1, push_codec="none",
+                        staleness_bound=100, shard_index=i, shard_count=2))
+        svcs.append(ParameterService(
+            store, sharding=ShardInfo(i, 2, ["pending"] * 2)))
+        stores.append(store)
+    return stores, svcs, lo_keys, hi_keys
+
+
+def _op(svc, op, payload=b"", **fields):
+    return unpack_msg(svc.reshard(pack_msg({"op": op, **fields},
+                                           payload), None))
+
+
+def _plan(mig_id="mig-test", lo=16, hi=32, ttl=30.0, version=1):
+    return {"id": mig_id, "slot_lo": lo, "slot_hi": hi,
+            "ranges": [[0, lo], [lo, 64]], "map_version": version,
+            "lease_ttl": ttl}
+
+
+class TestMigrationLedger:
+    def test_export_records_donor_and_starts_lease(self):
+        _, svcs, lo_keys, _ = _pair()
+        plan = _plan()
+        emeta, _ = _op(svcs[0], "export", slot_lo=16, slot_hi=32,
+                       migration=plan)
+        moved = [k for k in lo_keys if 16 <= key_slot(k) < 32]
+        assert emeta["exported"] == len(moved)
+        smeta, _ = _op(svcs[0], "status")
+        mig = smeta["migration"]
+        assert mig["id"] == "mig-test" and mig["role"] == "donor"
+        assert mig["phase"] == "export" and mig["frozen_slots"] == 16
+        assert 0.0 < mig["lease_remaining_s"] <= 30.0
+
+    def test_same_id_idempotent_different_id_refused(self):
+        _, svcs, _, _ = _pair()
+        a, _ = _op(svcs[0], "export", slot_lo=16, slot_hi=32,
+                   migration=_plan())
+        b, _ = _op(svcs[0], "export", slot_lo=16, slot_hi=32,
+                   migration=_plan())
+        assert b["exported"] == a["exported"]
+        with pytest.raises(ValueError, match="in flight"):
+            _op(svcs[0], "export", slot_lo=16, slot_hi=32,
+                migration=_plan(mig_id="mig-other"))
+
+    def test_abort_rolls_back_both_sides(self):
+        stores, svcs, lo_keys, _ = _pair()
+        plan = _plan()
+        emeta, payload = _op(svcs[0], "export", slot_lo=16, slot_hi=32,
+                             migration=plan)
+        _op(svcs[1], "import", payload=payload,
+            journal=emeta.get("journal"), migration=plan)
+        rmeta, _ = _op(svcs[1], "status")
+        assert rmeta["migration"]["role"] == "recipient"
+        assert rmeta["migration"]["phase"] == "import"
+        moved = [k for k in lo_keys if 16 <= key_slot(k) < 32]
+        assert all(k in stores[1].parameters for k in moved)
+
+        ameta, _ = _op(svcs[1], "abort", migration=plan)
+        assert ameta["aborted"] is True
+        # The grafted range is gone from the recipient, still owned by
+        # the donor; both ledgers are clear and the donor is unfrozen.
+        assert all(k not in stores[1].parameters for k in moved)
+        assert all(k in stores[0].parameters for k in moved)
+        _op(svcs[0], "abort", migration=plan)
+        for svc in svcs:
+            assert _op(svc, "status")[0]["migration"] is None
+            assert not svc._draining
+
+    def test_lease_expiry_auto_unfreezes_map_untouched(self, capsys):
+        _, svcs, _, _ = _pair()
+        _op(svcs[0], "export", slot_lo=16, slot_hi=32,
+            migration=_plan(ttl=0.05))
+        time.sleep(0.1)
+        smeta, _ = _op(svcs[0], "status")
+        assert smeta["migration"] is None
+        assert not svcs[0]._draining
+        # The map never moved.
+        assert [tuple(s["slot_range"])
+                for s in smeta["shard_map"]["shards"]] \
+            == [(0, 32), (32, 64)]
+        assert "RESHARD_LEASE_EXPIRED" in capsys.readouterr().out
+        # A NEW migration (different id) starts fine now.
+        emeta, _ = _op(svcs[0], "export", slot_lo=16, slot_hi=32,
+                       migration=_plan(mig_id="mig-second"))
+        assert "exported" in emeta
+
+    def test_apply_is_commit_point_and_idempotent(self):
+        _, svcs, _, _ = _pair()
+        plan = _plan(version=2)
+        emeta, payload = _op(svcs[0], "export", slot_lo=16, slot_hi=32,
+                             migration=plan)
+        _op(svcs[1], "import", payload=payload,
+            journal=emeta.get("journal"), migration=plan)
+        ameta, _ = _op(svcs[0], "apply_ranges", ranges=plan["ranges"],
+                       map_version=2, migration=plan)
+        assert ameta["map_version"] == 2
+        mig = _op(svcs[0], "status")[0]["migration"]
+        # Phase flipped: lease no longer applies (roll-forward-only).
+        assert mig["phase"] == "apply_ranges"
+        assert "lease_remaining_s" not in mig
+        assert not svcs[0]._draining
+        # Recipient's apply clears ITS record.
+        _op(svcs[1], "apply_ranges", ranges=plan["ranges"],
+            map_version=2, migration=plan)
+        assert _op(svcs[1], "status")[0]["migration"] is None
+        # Re-apply (a resumed coordinator's re-publish) is a no-op.
+        again, _ = _op(svcs[0], "apply_ranges", ranges=plan["ranges"],
+                       map_version=2, migration=plan)
+        assert again["map_version"] == 2
+        # Commit drops the donor copy and clears the donor record.
+        cmeta, _ = _op(svcs[0], "commit", slot_lo=16, slot_hi=32,
+                       migration=plan)
+        assert cmeta["dropped"] == emeta["exported"]
+        assert _op(svcs[0], "status")[0]["migration"] is None
+
+    def test_record_roundtrips_through_snapshot(self, tmp_path, capsys):
+        stores, svcs, _, _ = _pair()
+        _op(svcs[0], "export", slot_lo=16, slot_hi=32,
+            migration=_plan(ttl=60.0))
+        save_store(stores[0], str(tmp_path),
+                   migration_fn=svcs[0].migration_snapshot)
+        metas = sorted(tmp_path.glob("*.json"))
+        rec = json.loads(metas[-1].read_text())["migration"]
+        assert rec["id"] == "mig-test" and rec["phase"] == "export"
+
+        # Restore into a fresh service: the donor re-freezes its range.
+        store2 = ParameterStore(
+            {"w": np.ones(4, np.float32)},
+            StoreConfig(mode="async", total_workers=1, push_codec="none",
+                        shard_index=0, shard_count=2))
+        svc2 = ParameterService(store2,
+                                sharding=ShardInfo(0, 2, ["p"] * 2))
+        assert svc2.load_migration(rec) is True
+        assert "RESHARD_RESTORED" in capsys.readouterr().out
+        view = svc2.migration_view()
+        assert view["id"] == "mig-test" and view["frozen_slots"] == 16
+
+        # A record whose lease lapsed while the server was down is the
+        # auto-abort: nothing installed, nothing frozen.
+        rec_lapsed = dict(rec, lease_deadline=time.time() - 1.0)
+        svc3 = ParameterService(store2,
+                                sharding=ShardInfo(0, 2, ["p"] * 2))
+        assert svc3.load_migration(rec_lapsed) is False
+        assert svc3.migration_view() is None and not svc3._draining
+        # Garbage degrades to "no record", never a refused restore.
+        assert svc3.load_migration({"id": "x"}) is False
+        assert svc3.load_migration("not-a-dict") is False
+
+
+class TestReplicaRefreshBackoff:
+    def test_backoff_counts_and_logs_transitions_once(self, capsys):
+        rep = ReplicaServer("localhost:1", poll_interval=0.01)
+        calls = {"fail": 0, "ok": 0}
+        failing = threading.Event()
+        failing.set()
+
+        def poll():
+            if failing.is_set():
+                calls["fail"] += 1
+                raise ConnectionError("primary gone (simulated)")
+            calls["ok"] += 1
+
+        rep._poll_once = poll
+        base = rep._tm_refresh_errors.value
+        t = threading.Thread(target=rep._poll_loop, daemon=True)
+        t.start()
+        deadline = time.time() + 5.0
+        while calls["fail"] < 3 and time.time() < deadline:
+            time.sleep(0.01)
+        assert calls["fail"] >= 3
+        assert rep._tm_refresh_errors.value - base >= 3
+        failing.clear()
+        while calls["ok"] < 1 and time.time() < deadline:
+            time.sleep(0.01)
+        rep._stop.set()
+        t.join(timeout=5.0)
+        out = capsys.readouterr().out
+        assert out.count("REPLICA_REFRESH_FAILING") == 1
+        assert out.count("REPLICA_REFRESH_RECOVERED") == 1
+
+    def test_backoff_delay_is_capped_exponential(self):
+        rep = ReplicaServer("localhost:1", poll_interval=0.01)
+        # The cap keeps a long outage from turning into a dead replica:
+        # bounded at 20 poll intervals (>= 1 s floor).
+        assert rep._backoff_cap == 1.0
+        delay = rep.poll_interval
+        for _ in range(12):
+            delay = min(delay * 2.0, rep._backoff_cap)
+        assert delay == rep._backoff_cap

@@ -92,6 +92,11 @@ META_KEY_CATALOG: dict[str, tuple[str, ...]] = {
     # shard map: presence IS the capability (docs/SHARDING.md) — an
     # unsharded server never attaches one.
     "shard_map": (),
+    # CRC trailer capability (docs/WIRE_PROTOCOL.md "Checksum trailer"):
+    # the server advertises that it verifies push-frame checksums; only
+    # then does the client attach the FLAG_CRC trailer (a legacy server
+    # would mistake it for buffer slack).
+    "checksum": (),
     # -- live migration (admin plane + push-race surfacing) --------------
     # Reshard request fields: only a shard primary (ShardingState
     # present) serves the admin plane (docs/SHARDING.md "Migration
@@ -102,6 +107,10 @@ META_KEY_CATALOG: dict[str, tuple[str, ...]] = {
     "journal": ("sharding",),
     "ranges": ("sharding",),
     "map_version": ("sharding",),
+    # The coordinator's full migration plan (id, range, target
+    # partition, lease TTL) — one nested object, journaled per phase on
+    # each primary (docs/ROBUSTNESS.md "Migration failure matrix").
+    "migration": ("sharding",),
     # Reshard reply fields are read only by the coordinator (cli.py,
     # outside comms/): export_step / exported / adopted / journal_loaded
     # / dropped never appear as comms-side reads.
