@@ -133,8 +133,8 @@ def acquire_backend_with_fallback(retries: int = INIT_RETRIES,
     the diagnostic must describe the real failure, not the fallback's.
     """
     try:
-        return acquire_backend(retries=retries, backoff=backoff,
-                               sleep=sleep), None
+        devices = acquire_backend(retries=retries, backoff=backoff,
+                                  sleep=sleep)
     except Exception as primary:
         if not cpu_fallback:
             raise
@@ -147,6 +147,23 @@ def acquire_backend_with_fallback(retries: int = INIT_RETRIES,
                                    sleep=sleep), "cpu"
         except Exception:
             raise primary
+    # xla_bridge can fail accelerator init WITHOUT raising: jax.devices()
+    # then answers with the CPU backend after a warning, which would let
+    # an unmarked CPU number masquerade as a chip number (and run the
+    # chip-sized workload for hours). CPU devices when nothing pinned
+    # JAX_PLATFORMS=cpu ARE a fallback — mark or refuse accordingly.
+    if devices and devices[0].platform == "cpu" \
+            and os.environ.get("JAX_PLATFORMS", "") != "cpu":
+        if not cpu_fallback:
+            err = RuntimeError(
+                "accelerator init silently fell back to cpu "
+                "(jax.devices() answered CpuDevice without raising)")
+            err.bench_attempts = retries + 1
+            raise err
+        print("accelerator init silently fell back to cpu; marking the "
+              "record platform_fallback", file=sys.stderr)
+        return devices, "cpu"
+    return devices, None
 
 
 def emit_diagnostic(stage: str, err: Exception) -> None:
@@ -218,6 +235,50 @@ def lint_probe() -> dict:
     except Exception as e:  # noqa: BLE001 — probe is best-effort
         print(f"lint probe failed (recording null): {e}", file=sys.stderr)
         return {"lint_clean": None, "lint_runtime_s": None}
+
+
+def codec_probe(devices, reps: int = 3) -> dict:
+    """Device-codec companion fields (ISSUE 14): throughput of the
+    device-resident int8 quantize+pack over a synthetic multi-layer
+    gradient tree — ``codec_mb_per_s`` (input fp32 MB over the best
+    encode+finalize wall, the number benchwatch tracks once it has
+    history), ``codec_seconds`` (that best wall), and ``codec_device``
+    (the platform the encode actually ran on, so a CPU-fallback codec
+    number is never read as a chip number). Failure-hardened nulls like
+    the fetch/lint probes — never a cost to the throughput record."""
+    import numpy as np
+
+    try:
+        import jax.numpy as jnp
+
+        from distributed_parameter_server_for_ml_training_tpu.ops \
+            .device_codec import DeviceCodec
+
+        rng = np.random.default_rng(3)
+        # ~4 MB across mixed layer sizes: big enough to measure, small
+        # enough that the 1-core CPU fallback finishes in seconds.
+        flat = {f"layer{i}/kernel":
+                jnp.asarray(rng.normal(size=n).astype(np.float32))
+                for i, n in enumerate([262144, 262144, 262144,
+                                       131072, 65536, 16384, 384])}
+        pre_mb = sum(v.size for v in flat.values()) * 4 / 1e6
+        codec = DeviceCodec(error_feedback=False)
+        plan = {k: "int8" for k in flat}
+        codec.finalize(codec.encode(flat, plan=plan))  # compile warmup
+        best = float("inf")
+        for _ in range(reps):
+            codec.reset()
+            t0 = time.perf_counter()
+            codec.finalize(codec.encode(flat, plan=plan))
+            best = min(best, time.perf_counter() - t0)
+        return {"codec_device": devices[0].platform,
+                "codec_seconds": round(best, 6),
+                "codec_mb_per_s": round(pre_mb / best, 1)}
+    except Exception as e:  # noqa: BLE001 — probe is best-effort
+        print(f"codec probe failed (recording null): {e}",
+              file=sys.stderr)
+        return {"codec_device": None, "codec_seconds": None,
+                "codec_mb_per_s": None}
 
 
 def run_bench(args) -> dict:
@@ -396,6 +457,15 @@ def run_bench(args) -> dict:
             fetch_qps = fetch_qps_probe(
                 duration_s=getattr(args, "fetch_probe_secs", 1.0))
 
+        # Push-codec attribution (ISSUE 14): what the device-resident
+        # quantize+pack sustains on this backend, so BENCH_r* rounds can
+        # attribute wire-side wins separately from the train step.
+        stage = "codec_probe"
+        codec_fields = {"codec_device": None, "codec_seconds": None,
+                        "codec_mb_per_s": None}
+        if not getattr(args, "no_codec_probe", False):
+            codec_fields = codec_probe(devices)
+
         result = {
             "metric": "cifar100_resnet18_train_images_per_sec_per_chip",
             "value": round(per_chip, 1),
@@ -434,6 +504,8 @@ def run_bench(args) -> dict:
             "mfu": mfu_value,
             "device_time_fraction": device_time_fraction,
             "profile_attribution_basis": attribution_basis,
+            # Device-codec attribution (ISSUE 14): see codec_probe.
+            **codec_fields,
         }
         # Static-analysis attribution (ISSUE 10 satellite): whether the
         # tree this number was measured from passed dpslint, and what the
@@ -476,6 +548,9 @@ def main() -> int:
     parser.add_argument("--no-fetch-probe", action="store_true",
                         help="skip the serve-path probe (fetch_qps "
                              "recorded as null)")
+    parser.add_argument("--no-codec-probe", action="store_true",
+                        help="skip the device-codec probe (codec_* "
+                             "fields recorded as null)")
     parser.add_argument("--profile-dir", default=None,
                         help="capture a jax.profiler trace of the timed "
                              "trials into this directory and record "

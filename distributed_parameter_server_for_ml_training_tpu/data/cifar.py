@@ -288,10 +288,15 @@ def augment_batch(key: jax.Array, x: jax.Array) -> jax.Array:
 
     Matches the reference's torchvision transforms (worker.py:145-150) but
     runs vectorized inside the compiled step: zero-pad to 40x40, per-image
-    dynamic-slice crop, per-image flip. ``x`` must be RAW-scale float
-    [B,32,32,3] in [0,1] — torchvision applies RandomCrop BEFORE Normalize,
-    so the zero padding means black pixels, not mean-color pixels; call
-    :func:`standardize` AFTER this to preserve that parity.
+    dynamic-slice crop, per-image flip. ``x`` is RAW-scale [B,32,32,3] —
+    uint8 or float in [0,1]; every op here is a pure index permutation
+    with zero padding, so augmenting the uint8 pixels and casting after
+    produces bit-identical floats to casting first, at 1/4 the gather
+    bandwidth (the hot-path callers in train/steps.py exploit that).
+    torchvision applies RandomCrop BEFORE Normalize, so the zero padding
+    means black pixels, not mean-color pixels; call :func:`standardize`
+    AFTER this (and after :func:`to_float` for uint8 inputs) to preserve
+    that parity.
     """
     b, h, w, c = x.shape
     k_crop, k_flip = jax.random.split(key)

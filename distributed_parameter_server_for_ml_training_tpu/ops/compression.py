@@ -80,11 +80,22 @@ def decompress_from_allreduce(grads: PyTree, mode: str = "bf16") -> PyTree:
 # Host-side wire codecs for the async parameter store.
 # ---------------------------------------------------------------------------
 
+def _stage_f32(a) -> np.ndarray:
+    """Zero-copy fp32 staging for the cast codecs: an array that is
+    already fp32 is returned AS ITSELF (``astype(copy=False)``), so the
+    narrowing cast is the push's only allocation — the old
+    ``np.asarray(a, np.float32)`` staging materialized an intermediate
+    fp32 copy for device arrays and non-f32 inputs before the real cast.
+    Pinned by tests/test_wire_zero_copy.py."""
+    return np.asarray(a).astype(np.float32, copy=False)
+
+
+# dpslint: hot-path
 def fp16_compress(tree: PyTree) -> PyTree:
     """fp32 -> fp16 cast, exactly the reference's compress_gradients
     (worker.py:264-268)."""
     return jax.tree_util.tree_map(
-        lambda a: np.asarray(a, np.float32).astype(np.float16), tree)
+        lambda a: _stage_f32(a).astype(np.float16, copy=False), tree)
 
 
 def fp16_decompress(tree: PyTree) -> PyTree:
@@ -93,6 +104,7 @@ def fp16_decompress(tree: PyTree) -> PyTree:
         lambda a: np.asarray(a).astype(np.float32), tree)
 
 
+# dpslint: hot-path
 def bf16_compress(tree: PyTree) -> PyTree:
     """fp32 -> bfloat16 cast (round-to-nearest-even via ml_dtypes).
 
@@ -104,7 +116,7 @@ def bf16_compress(tree: PyTree) -> PyTree:
     import ml_dtypes
 
     return jax.tree_util.tree_map(
-        lambda a: np.asarray(a, np.float32).astype(ml_dtypes.bfloat16), tree)
+        lambda a: _stage_f32(a).astype(ml_dtypes.bfloat16, copy=False), tree)
 
 
 def bf16_decompress(tree: PyTree) -> PyTree:

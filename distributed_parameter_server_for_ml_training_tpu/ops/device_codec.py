@@ -1,0 +1,315 @@
+"""Device-resident push codec: quantize + pack on the accelerator.
+
+The NumPy codec family (ops/compression.py) is the host reference: every
+quantized push starts with a full fp32 ``jax.device_get`` of the gradient
+tree — ~45 MB across the link for ResNet-18 — and then single-core NumPy
+quantize/pack arithmetic, all on the push critical path. This module keeps
+the codec ON the device: the error-feedback residual carry, the quantize,
+the int4 nibble pack, and the top-k select all run as jit-compiled device
+programs (Pallas kernels for the quantize on TPU, identical-math jnp
+elsewhere — ops/pallas/quantize.py), and the only bulk device->host
+transfer is the final WIRE buffers (int4: ceil(n/2) bytes, 1/8 of the
+fp32 pull; int8: 1/4; topk: ~frac of it).
+
+Bit-identity contract (property-tested by tests/test_quantize.py): the
+payload :meth:`DeviceCodec.encode` produces is byte-for-byte what
+:func:`..ops.compression.compress_push` produces for the same gradients,
+plan, shared-scale table, error-feedback history, and ``topk_frac`` — so
+the server side (NumPy decode, homomorphic aggregation, the negotiation
+matrix) is provably unaffected by which codec a worker runs. What makes
+that hold:
+
+- scales are computed ON THE HOST from device-reduced absmax scalars with
+  the reference's exact expression (``np.float32(float(amax) / 127.0)``:
+  a float64 divide then one fp32 round — a direct fp32 divide on device
+  would double-round differently for ~1 in 2^29 amax values);
+- quantization is a true division (never a reciprocal multiply) + fp32
+  round-half-even (``jnp.rint`` == ``np.rint``) + the same clip bounds;
+- nibble packing matches ops/packed.py bit for bit (low nibble = even
+  flat index, odd length zero-padded);
+- top-k selection (``jax.lax.top_k`` + ascending index sort) matches the
+  NumPy argpartition+sort selection whenever the k-th magnitude is unique
+  (boundary ties tie-break by index here, unspecified there; continuous
+  gradient values don't tie);
+- error-feedback residuals are ``total - decoded`` in fp32 on device —
+  the same two arithmetic ops the NumPy ``ErrorFeedback.store`` runs.
+
+Encode is two async dispatches (stats, then quantize+pack) around one
+small host pull of the per-tensor absmax scalars; ``encode()`` also
+starts ``copy_to_host_async()`` on every wire buffer, so by the time
+``finalize()`` (typically the comms pipeline thread) assembles the NumPy
+wire dict, the packed bytes are usually already on the host and the
+training thread never blocked on any of it.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .compression import (
+    _INT4_SCALE_SUFFIX,
+    _SCALE_SUFFIX,
+    _TOPK_IDX_SUFFIX,
+    _TOPK_SCALE_SUFFIX,
+    _TOPK_SHAPE_SUFFIX,
+    _TOPK_VAL_SUFFIX,
+)
+from .packed import as_packed_int4
+from .pallas.quantize import (
+    PALLAS_WIRE_MIN_SIZE,
+    _on_tpu,
+    _pad_to_blocks,
+    pack_nibbles_device,
+    topk_select_flat,
+    wire_quantize_flat,
+)
+
+__all__ = ["DeviceCodec", "DevicePayload", "is_device_tree"]
+
+
+def is_device_tree(tree: Any) -> bool:
+    """True when every leaf is a jax.Array — the precondition for running
+    the device codec without first paying the host pull it exists to
+    avoid. NumPy-leaf trees take the negotiated NumPy fallback."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    return bool(leaves) and all(isinstance(a, jax.Array) for a in leaves)
+
+
+# -- phase programs -----------------------------------------------------------
+#
+# Whole-tree jits (cached by tree structure + static plan) rather than
+# per-tensor jitted calls: a ResNet-sized model would otherwise pay ~60
+# tiny compilations per process. Plan/ks arrive as hashable tuples so a
+# changed bitwidth plan retraces exactly once.
+
+@partial(jax.jit, static_argnames=("plan", "ks", "use_ef"))
+def _phase_stats(flat, residuals, plan, ks, use_ef):  # dpslint: hot-path device
+    """Dispatch 1: EF-carried totals, per-tensor absmax, top-k selects."""
+    ks = dict(ks)
+    totals, amax, topk = {}, {}, {}
+    for name, kind in plan:
+        g = flat[name].astype(jnp.float32)
+        r = residuals.get(name) if use_ef else None
+        t = g if (r is None or kind == "none") else g + r
+        totals[name] = t
+        if kind == "none":
+            continue
+        # whole-tensor absmax doubles as the finite guard: NaN propagates
+        # through max, inf survives it — isfinite(amax) on the host is
+        # exactly the reference's _require_finite / np.all(isfinite).
+        amax[name] = jnp.max(jnp.abs(t)) if t.size else jnp.zeros((), jnp.float32)
+        if kind == "topk":
+            topk[name] = topk_select_flat(t, ks[name])
+    return totals, amax, topk
+
+
+@partial(jax.jit, static_argnames=("plan", "use_pallas", "use_ef"))
+def _phase_encode(totals, topk, scales, plan, use_pallas, use_ef):  # dpslint: hot-path device
+    """Dispatch 2: quantize + pack against host-computed scales; emit the
+    wire buffers (and, under EF, the DECODED dequantizations), all on
+    device. The residual subtraction ``total - decoded`` runs in a
+    separate program (:func:`_phase_residual`): fused into this one, XLA
+    contracts the dequantize multiply and the subtract into a single
+    rounded FMA — ~1 ulp off the NumPy reference's two roundings, enough
+    to break the bit-identity contract (``lax.optimization_barrier`` and
+    bitcast tricks do not survive the LLVM-level contraction)."""
+    wire, decoded = {}, {}
+    for name, kind in plan:
+        t = totals[name]
+        if kind == "none":
+            wire[name] = t
+            continue
+        s = scales[name]
+        if kind == "topk":
+            idx, vals = topk[name]
+            q = jnp.clip(jnp.rint(vals / s), -127, 127).astype(jnp.int8)
+            wire[name + _TOPK_IDX_SUFFIX] = idx
+            wire[name + _TOPK_VAL_SUFFIX] = q
+            if use_ef:
+                decoded[name] = jnp.zeros((t.size,), jnp.float32) \
+                    .at[idx].set(q.astype(jnp.float32) * s).reshape(t.shape)
+            continue
+        levels = 7 if kind == "int4" else 127
+        xb, n, _ = _pad_to_blocks(t)
+        q = wire_quantize_flat(
+            xb, s, levels,
+            use_pallas and n >= PALLAS_WIRE_MIN_SIZE).reshape(-1)[:n]
+        if kind == "int4":
+            wire[name] = pack_nibbles_device(q)
+        else:
+            wire[name] = q.reshape(t.shape)
+        if use_ef:
+            decoded[name] = (q.astype(jnp.float32) * s).reshape(t.shape)
+    return wire, decoded
+
+
+@jax.jit
+def _phase_residual(totals, decoded):  # dpslint: hot-path device
+    """Dispatch 3 (EF only): next residuals = total - decoded, with the
+    decoded values already materialized by the previous program so the
+    subtraction rounds separately, exactly like ``ErrorFeedback.store``."""
+    return {name: totals[name] - d for name, d in decoded.items()}
+
+
+# -- host orchestration -------------------------------------------------------
+
+@dataclass
+class DevicePayload:
+    """An in-flight device-encoded push.
+
+    ``device_entries`` are wire buffers still on device (their
+    ``copy_to_host_async`` is already running); ``host_entries`` are the
+    tiny host-built companions (fp32 scales, int64 shapes). ``order`` is
+    the exact wire-dict key order the NumPy reference would emit —
+    frame bytes depend on it."""
+    order: list
+    device_entries: dict
+    host_entries: dict
+    int4_shapes: dict
+    pre_bytes: int
+    encode_seconds: float
+    copy_started_at: float = field(default_factory=time.perf_counter)
+
+
+class DeviceCodec:
+    """Stateful device-side equivalent of ``compress_push`` + its
+    ``ErrorFeedback`` — residuals live as device arrays between pushes."""
+
+    def __init__(self, *, error_feedback: bool = True,
+                 topk_frac: float = 0.01,
+                 use_pallas: bool | None = None):
+        self.error_feedback = bool(error_feedback)
+        self.topk_frac = float(topk_frac)
+        self.use_pallas = use_pallas
+        self._residual: dict[str, jax.Array] = {}
+
+    def reset(self) -> None:
+        """Drop EF residuals (quarantine directive parity with
+        ``ErrorFeedback.reset``)."""
+        self._residual.clear()
+
+    # The reference's top-k sizing, verbatim (Python round half-even).
+    @staticmethod
+    def _topk_k(n: int, frac: float, min_k: int = 1) -> int:
+        return min(n, max(min_k, int(round(frac * n))))
+
+    def encode(self, flat: Mapping[str, jax.Array],
+               plan: Mapping[str, str] | None = None,
+               scales: Mapping[str, float] | None = None) -> DevicePayload:
+        """Dispatch the device encode for one push; returns immediately
+        with the transfers in flight. Argument semantics (plan kinds,
+        shared-scale table, non-finite ValueError) match
+        :func:`..ops.compression.compress_push`."""
+        t0 = time.perf_counter()
+        plan = plan or {}
+        scales = scales or {}
+        plan_t = tuple((name, plan.get(name, "int8")) for name in flat)
+        ks = tuple(sorted(
+            (name, self._topk_k(int(a.size), self.topk_frac))
+            for name, a in flat.items()
+            if plan.get(name, "int8") == "topk"))
+        use_pallas = self.use_pallas if self.use_pallas is not None \
+            else _on_tpu()
+
+        totals, amax_dev, topk = _phase_stats(
+            dict(flat), dict(self._residual), plan_t, ks,
+            self.error_feedback)
+        amax = jax.device_get(amax_dev)  # small scalars: the one sync point
+
+        scale_host: dict[str, np.float32] = {}
+        for name, kind in plan_t:
+            if kind == "none":
+                continue
+            a = float(amax[name])
+            if not np.isfinite(a):
+                raise ValueError(f"device codec [{kind}] '{name}': "
+                                 "non-finite values in input "
+                                 "(diverging gradients?)")
+            absmax = scales.get(name)
+            if kind == "topk":
+                continue  # scale comes from the SELECTED values, below
+            if kind == "int4":
+                scale_host[name] = np.float32(absmax / 7.0) \
+                    if absmax and absmax > 0 \
+                    else (np.float32(a / 7.0) if a > 0 else np.float32(1.0))
+            else:
+                scale_host[name] = np.float32(absmax / 127.0) \
+                    if absmax and absmax > 0 \
+                    else (np.float32(a / 127.0) if a > 0 else np.float32(1.0))
+        if topk:
+            # top-k scales need the selected values' absmax — one more
+            # small pull (k entries per topk layer, ~1% of the tensor).
+            vals_host = jax.device_get({n: v for n, (_, v) in topk.items()})
+            for name, vals in vals_host.items():
+                amax_v = float(np.max(np.abs(vals))) if vals.size else 0.0
+                scale_host[name] = np.float32(amax_v / 127.0) \
+                    if amax_v > 0 else np.float32(1.0)
+
+        wire_dev, decoded = _phase_encode(
+            totals, topk, scale_host, plan_t, use_pallas,
+            self.error_feedback)
+        if self.error_feedback:
+            self._residual = dict(_phase_residual(totals, decoded))
+
+        order, host_entries, int4_shapes = [], {}, {}
+        for name, kind in plan_t:
+            shape = tuple(flat[name].shape)
+            if kind == "none":
+                order.append(name)
+                continue
+            if kind == "topk":
+                order += [name + _TOPK_IDX_SUFFIX, name + _TOPK_VAL_SUFFIX,
+                          name + _TOPK_SCALE_SUFFIX, name + _TOPK_SHAPE_SUFFIX]
+                host_entries[name + _TOPK_SCALE_SUFFIX] = \
+                    np.asarray([scale_host[name]], np.float32)
+                host_entries[name + _TOPK_SHAPE_SUFFIX] = \
+                    np.asarray(shape, np.int64)
+                continue
+            suffix = _INT4_SCALE_SUFFIX if kind == "int4" else _SCALE_SUFFIX
+            order += [name, name + suffix]
+            host_entries[name + suffix] = \
+                np.asarray([scale_host[name]], np.float32)
+            if kind == "int4":
+                int4_shapes[name] = shape
+
+        for arr in wire_dev.values():
+            if hasattr(arr, "copy_to_host_async"):
+                arr.copy_to_host_async()
+        pre_bytes = sum(4 * int(a.size) for a in flat.values())
+        return DevicePayload(
+            order=order,
+            device_entries=dict(wire_dev),
+            host_entries=host_entries,
+            int4_shapes=int4_shapes,
+            pre_bytes=pre_bytes,
+            encode_seconds=time.perf_counter() - t0)
+
+    def finalize(self, payload: DevicePayload) -> dict:
+        """Assemble the NumPy wire dict from an in-flight payload. The
+        device_get here is the ONLY bulk transfer of the push — already
+        overlapped when the async copies had a head start."""
+        host = jax.device_get(payload.device_entries)
+        out: dict = {}
+        for name in payload.order:
+            if name in payload.host_entries:
+                out[name] = payload.host_entries[name]
+            elif name in payload.int4_shapes:
+                out[name] = as_packed_int4(
+                    np.ascontiguousarray(host[name]),
+                    payload.int4_shapes[name])
+            else:
+                out[name] = host[name]
+        return out
+
+    def encode_now(self, flat: Mapping[str, jax.Array],
+                   plan: Mapping[str, str] | None = None,
+                   scales: Mapping[str, float] | None = None) -> dict:
+        """Blocking encode (serial push path / tests / microbench)."""
+        return self.finalize(self.encode(flat, plan=plan, scales=scales))

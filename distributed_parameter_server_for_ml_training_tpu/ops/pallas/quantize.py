@@ -216,3 +216,108 @@ def quantize_dequantize_int8(x: jax.Array, *, stochastic: bool = False,
     v, s = quantize_int8(x, seed, stochastic=stochastic,
                          use_pallas=use_pallas)
     return dequantize_int8(v, s, tuple(x.shape), use_pallas=use_pallas)
+
+
+# -- fused wire-codec kernels (device-resident push codec) --------------------
+#
+# The wire codec family (ops/compression.py int8/int4/topk) is the NumPy
+# host reference: every quantized push starts with a full fp32 device_get
+# BEFORE the bytes shrink. These kernels run the SAME math on device, bit
+# identical to the reference (true division — never a reciprocal multiply,
+# which double-rounds; jnp.rint == np.rint round-half-even; identical clip
+# bounds), so only the already-quantized wire buffers cross the link. Tree
+# orchestration (host-computed scales, error feedback, the single packed
+# bytes pull) lives in ops/device_codec.py; these are the per-tensor
+# primitives it traces into its phase programs. Only the quantize runs as
+# a Pallas kernel — the nibble pack and top-k select stay jnp inside the
+# same jit program (XLA fuses them; Mosaic has no win for lane-pair bit
+# twiddling), which also serves as the CPU tier-1 fallback.
+
+# Below ~64k elements the pallas_call launch costs more than the fused XLA
+# elementwise it replaces; small tensors stay on the jnp path even on TPU.
+PALLAS_WIRE_MIN_SIZE = 65536
+
+
+def _wire_quantize_kernel(scale_ref, x_ref, values_ref, *, levels: int):
+    # One fp32 block / one shared SMEM scale -> int8 codes in [-levels,
+    # levels]. The divide must stay a true divide for bit-identity with
+    # the NumPy reference codec.
+    values_ref[:] = jnp.clip(jnp.rint(x_ref[:] / scale_ref[0]),
+                             -levels, levels).astype(jnp.int8)
+
+
+def wire_quantize_flat(x2d: jax.Array, scale: jax.Array, levels: int,
+                       use_pallas: bool) -> jax.Array:  # dpslint: hot-path device
+    """[rows,128] fp32 + scalar scale -> [rows,128] int8 codes.
+
+    Traced inside the device codec's phase programs (and the jitted
+    :func:`wire_quantize` wrapper) — not jitted itself. ``levels`` is 127
+    for int8 wire codes, 7 for int4 nibble codes.
+    """
+    rows = x2d.shape[0]
+    scale = jnp.asarray(scale, jnp.float32)
+    if use_pallas and rows:
+        import jax.experimental.pallas as pl
+        from jax.experimental.pallas import tpu as pltpu
+
+        br = block_rows_for(rows)
+        return pl.pallas_call(
+            partial(_wire_quantize_kernel, levels=levels),
+            grid=(rows // br,),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                      pl.BlockSpec((br, LANES), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((br, LANES), lambda i: (i, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((rows, LANES), jnp.int8),
+        )(scale.reshape(1), x2d)
+    return jnp.clip(jnp.rint(x2d / scale), -levels, levels).astype(jnp.int8)
+
+
+def pack_nibbles_device(q: jax.Array) -> jax.Array:  # dpslint: hot-path device
+    """int8 codes in [-8, 7] (any shape) -> packed uint8, flat ceil(n/2).
+
+    Bit-identical to ops/packed.py:pack_nibbles: low nibble = even flat
+    index, odd length padded with a zero code. Traced (not jitted) so the
+    device codec fuses it into the quantize program.
+    """
+    flat = q.reshape(-1)
+    if flat.size % 2:
+        flat = jnp.concatenate([flat, jnp.zeros((1,), jnp.int8)])
+    pairs = flat.reshape(-1, 2)
+    lo = pairs[:, 0].astype(jnp.uint8) & 0x0F
+    hi = (pairs[:, 1].astype(jnp.uint8) & 0x0F) << 4
+    return lo | hi
+
+
+def topk_select_flat(x: jax.Array, k: int):  # dpslint: hot-path device
+    """Flat top-k by |value|: (sorted int32 indices, fp32 values).
+
+    jax.lax.top_k + ascending index sort — identical to the NumPy
+    reference's argpartition+sort selection whenever the k-th magnitude
+    is unique (equal-magnitude ties at the boundary tie-break by index
+    here, unspecified there; continuous gradients don't tie). Traced,
+    not jitted.
+    """
+    flat = x.reshape(-1).astype(jnp.float32)
+    _, idx = jax.lax.top_k(jnp.abs(flat), k)
+    idx = jnp.sort(idx).astype(jnp.int32)
+    return idx, jnp.take(flat, idx)
+
+
+@partial(jax.jit, static_argnames=("levels", "use_pallas"))
+def wire_quantize(x: jax.Array, scale, *, levels: int = 127,
+                  use_pallas: bool | None = None) -> jax.Array:
+    """Tensor + scalar scale -> int8 wire codes with the tensor's shape.
+
+    Jitted per-tensor convenience surface over :func:`wire_quantize_flat`
+    (tests, microbench). The device codec uses the flat form directly so
+    a whole gradient tree compiles as one program.
+    """
+    if use_pallas is None:
+        use_pallas = _on_tpu() and x.size >= PALLAS_WIRE_MIN_SIZE
+    if x.size == 0:
+        return jnp.zeros(x.shape, jnp.int8)
+    xb, n, _ = _pad_to_blocks(x)
+    q = wire_quantize_flat(xb, scale, levels, use_pallas)
+    return q.reshape(-1)[:n].reshape(x.shape)

@@ -135,11 +135,13 @@ def make_sync_dp_step(mesh: Mesh, *, axis: str = DATA_AXIS,
         rng = jax.random.fold_in(jax.random.fold_in(rng, widx), state.step)
 
         # torchvision order (worker.py:145-154): crop/flip raw pixels
-        # (zero pad = black), then per-channel standardize.
-        images = to_float(images_u8)
+        # (zero pad = black), then per-channel standardize. Gathers run
+        # on uint8 — bit-identical floats at 1/4 the bandwidth
+        # (train/steps.py).
+        images = images_u8
         if augment:
             images = augment_batch(rng, images)
-        images = standardize(images)
+        images = standardize(to_float(images))
 
         def loss_fn(params):
             from ..train.steps import _variables

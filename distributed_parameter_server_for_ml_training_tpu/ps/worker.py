@@ -21,6 +21,7 @@ import time
 from dataclasses import dataclass, field
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from ..data.cifar import Dataset, make_batches, shard_range
@@ -31,13 +32,16 @@ from ..ops.compression import (  # hot-path imports hoisted, like ps/store
     fp16_compress,
     fp16_decompress,
 )
+from ..ops.device_codec import DeviceCodec, DevicePayload, is_device_tree
 from ..telemetry import (
     current_wire_trace,
     now as _tnow,
     trace_span,
     use_wire_context,
 )
-from ..train.steps import make_eval_step, make_grad_step
+from ..train.device_loop import prefetch_to_device
+from ..train.steps import make_eval_step, make_fused_local_step, \
+    make_grad_step
 from ..utils.pytree import flatten_params, unflatten_params
 from .store import ParameterStore
 
@@ -47,7 +51,14 @@ class WorkerConfig:
     batch_size: int = 128      # worker.py:474-482 distributed defaults
     num_epochs: int = 3
     sync_steps: int = 1        # K; CLI default 1 (worker.py:468)
-    k_step_mode: str = "faithful"  # 'faithful' | 'accumulate'
+    # 'faithful' | 'accumulate' | 'local_sgd'. local_sgd runs the DONATED
+    # fused step (train/steps.py make_fused_local_step): grads + plain-SGD
+    # apply + window accumulation as one compiled program, params updated
+    # in place on device — no param round-trip inside the K-step window.
+    # The window's gradient MEAN is pushed at the boundary (same payload
+    # shape as 'accumulate'); with K=1 it matches 'faithful' bit-for-bit
+    # up to +0/-0 on exactly-zero gradient entries.
+    k_step_mode: str = "faithful"
     augment: bool = True
     eval_batch_size: int = 1000
     eval_each_epoch: bool = True   # worker.py:393-394
@@ -100,12 +111,30 @@ class WorkerConfig:
     # Fraction of entries a 'topk' push keeps per tensor (largest
     # magnitude; int8-quantized values + int32 indices on the wire).
     topk_frac: float = 0.01
+    # Device-resident push codec (ops/device_codec.py): quantize/pack on
+    # the accelerator and pull only the packed wire bytes, instead of
+    # pulling fp32 gradients and encoding them with NumPy. Wire bytes and
+    # error-feedback residuals are bit-identical to the NumPy reference
+    # (property-tested, tests/test_quantize.py); engages only when a
+    # quantized codec was negotiated and the gradients are device arrays.
+    # False forces the NumPy reference path.
+    device_codec: bool = True
+    # Host->device input double buffering: keep this many batches'
+    # transfers in flight ahead of compute (train/device_loop.py
+    # prefetch_to_device), so batch N+1's upload overlaps batch N's
+    # compute. 0 feeds host batches directly (the prior behavior).
+    prefetch_batches: int = 2
+    # 'local_sgd' mode: the worker-local SGD learning rate; None adopts
+    # the store's configured learning_rate.
+    local_lr: float | None = None
 
     def __post_init__(self):
-        if self.k_step_mode not in ("faithful", "accumulate"):
+        if self.k_step_mode not in ("faithful", "accumulate", "local_sgd"):
             raise ValueError(self.k_step_mode)
         if self.sync_steps < 1:
             raise ValueError("sync_steps must be >= 1")
+        if self.prefetch_batches < 0:
+            raise ValueError("prefetch_batches must be >= 0")
 
 
 @dataclass
@@ -230,7 +259,9 @@ class _BitwidthController:
         """{tensor name: 'int8'|'int4'|'topk'} for this push."""
         out = {}
         for name, a in flat.items():
-            size = int(np.asarray(a).size)
+            # .size, not np.asarray(a).size: the flat dict may hold DEVICE
+            # arrays (device codec path) and the plan must not pull them.
+            size = int(a.size)
             if self.level >= 2 and size >= self.min_topk_size:
                 out[name] = "topk"
             elif self.level >= 1 and size >= self.min_int4_size:
@@ -365,6 +396,17 @@ class _CommsPipeline:
     def submit(self, grads, fetched_step: int, prefetch_current) -> None:
         self._done.wait()  # single-slot bound: previous item must be done
         self._raise_if_failed()
+        # Double-buffered gradient pull: start the device->host copies NOW,
+        # on the training thread, so they run behind the next window's
+        # compute and the comms thread's device_get finds the bytes already
+        # on the host. A DevicePayload started its own copies at encode
+        # time; device-resident stores never pull, so nothing to stage.
+        if grads is not None and not isinstance(grads, DevicePayload) \
+                and not getattr(self._worker.store, "keeps_device_arrays",
+                                False):
+            for leaf in jax.tree_util.tree_leaves(grads):
+                if hasattr(leaf, "copy_to_host_async"):
+                    leaf.copy_to_host_async()
         # Trace context captured on the TRAINING thread (the submitting
         # step's push_wait span) — the comms thread re-enters it.
         self._item = (grads, fetched_step, prefetch_current,
@@ -421,7 +463,7 @@ class PSWorker(threading.Thread):
 
     def __init__(self, store: ParameterStore, model, dataset: Dataset,
                  config: WorkerConfig | None = None,
-                 grad_step=None, eval_step=None,
+                 grad_step=None, eval_step=None, fused_step=None,
                  worker_name: str = ""):
         super().__init__(daemon=True)
         self.store = store
@@ -456,6 +498,10 @@ class PSWorker(threading.Thread):
         # the per-layer bitwidth controller (docs/WIRE_PROTOCOL.md).
         self._ef: ErrorFeedback | None = None
         self._bitwidth: _BitwidthController | None = None
+        # Device-resident codec (ops/device_codec.py): set in _run when a
+        # quantized codec is negotiated and config.device_codec is on.
+        # Carries its own error-feedback residuals ON DEVICE.
+        self._device_codec: DeviceCodec | None = None
         self._prev_push_done: float | None = None
         # Directive-channel state (docs/ROBUSTNESS.md "Self-healing"):
         # server->worker directives arrive on fetch/push reply meta and
@@ -478,6 +524,9 @@ class PSWorker(threading.Thread):
         self._grad_step = grad_step or make_grad_step(
             model, augment=self.config.augment)
         self._eval_step = eval_step or jax.jit(make_eval_step())
+        # local_sgd's donated fused step: built lazily in _run (only that
+        # mode pays the trace) unless a shared compile was passed in.
+        self._fused_step = fused_step
 
     # -- the training loop (worker.py:350-403) ------------------------------
 
@@ -619,6 +668,15 @@ class PSWorker(threading.Thread):
         self._tm_push_saved = reg.counter(
             "dps_worker_push_bytes_saved_total", worker=w)
         self._tm_push_bits = reg.gauge("dps_worker_push_bitwidth", worker=w)
+        # Push-codec seconds per push (device encode + packed-bytes pull,
+        # or the NumPy compress when the device codec is off), and the
+        # device->host gradient-pull seconds that ran on the comms
+        # pipeline thread instead of blocking the training thread — the
+        # double-buffered-transfer win, live (docs/OBSERVABILITY.md).
+        self._tm_codec_s = reg.histogram("dps_worker_codec_seconds",
+                                         worker=w)
+        self._tm_d2h_saved = reg.histogram(
+            "dps_worker_d2h_overlap_saved_seconds", worker=w)
         # Server->worker directives acted on, one series per catalog
         # action (docs/ROBUSTNESS.md "Self-healing").
         from ..comms.service import DIRECTIVE_CATALOG
@@ -739,6 +797,8 @@ class PSWorker(threading.Thread):
                 # The residual carry may hold the same poison the server
                 # quarantined us for — restart it clean.
                 self._ef = ErrorFeedback()
+            if self._device_codec is not None:
+                self._device_codec.reset()  # same carry, device-resident
             self._force_full_fetch = True
         elif action == "rebalance_shard":
             # Finish the current epoch early; the next epoch recomputes
@@ -772,6 +832,17 @@ class PSWorker(threading.Thread):
         if codec in QUANTIZED_PUSH_CODECS:
             self._ef = ErrorFeedback() if cfg.error_feedback else None
             self._bitwidth = _BitwidthController(codec)
+            if cfg.device_codec:
+                # Device-resident encode (ops/device_codec.py): when the
+                # gradients are device arrays the quantize/pack runs on
+                # the accelerator and only the packed wire bytes cross
+                # the link — bit-identical to the NumPy path, which
+                # remains the fallback (host-resident trees) and the
+                # server-side decode. Its EF carry supersedes self._ef
+                # whenever it engages (one push never pays both).
+                self._device_codec = DeviceCodec(
+                    error_feedback=cfg.error_feedback,
+                    topk_frac=cfg.topk_frac)
         # Health reports ride fetch/push/heartbeat envelopes when the
         # server advertised the capability at registration; otherwise the
         # note path stays disabled and costs nothing (the same degradation
@@ -809,6 +880,24 @@ class PSWorker(threading.Thread):
         k = cfg.sync_steps
         accum = None
         accum_n = 0
+        # local_sgd mode: the donated fused step walks a LOCAL parameter
+        # trajectory between push boundaries (train/steps.py). local_params
+        # is an explicit COPY of the fetched params — the fused step
+        # donates its inputs, and the fetched tree must stay intact as the
+        # delta-fetch basis.
+        local_sgd = cfg.k_step_mode == "local_sgd"
+        local_params = None
+        local_lr = None
+        if local_sgd:
+            if self._fused_step is None:
+                self._fused_step = make_fused_local_step(
+                    self.model, augment=cfg.augment)
+            local_lr = cfg.local_lr
+            if local_lr is None:
+                local_lr = float(getattr(
+                    getattr(self.store, "config", None),
+                    "learning_rate", 0.1) or 0.1)
+            local_lr = np.float32(local_lr)
         # Overlapped comms: pushes + prefetches ride a bounded single-slot
         # background thread; the RPC sequence is IDENTICAL to the serial
         # loop (see _CommsPipeline), only the training thread stops
@@ -852,9 +941,16 @@ class PSWorker(threading.Thread):
                 # on a shard.
                 x_shard, y_shard = self._compute_shard(worker_id,
                                                        total_workers)
-                for batch_idx, (xb, yb) in enumerate(make_batches(
-                        x_shard, y_shard, cfg.batch_size,
-                        seed=cfg.seed * 1000 + epoch)):
+                batches = make_batches(x_shard, y_shard, cfg.batch_size,
+                                       seed=cfg.seed * 1000 + epoch)
+                if cfg.prefetch_batches > 0:
+                    # Input double buffering: batch N+1's host->device
+                    # upload overlaps batch N's compute (device_put is
+                    # async dispatch; train/device_loop.py). Bitwise the
+                    # same batches, off the critical path.
+                    batches = prefetch_to_device(
+                        batches, depth=cfg.prefetch_batches)
+                for batch_idx, (xb, yb) in enumerate(batches):
                     boundary = batch_idx % k == 0
                     # One ROOT trace per loop iteration: fetch wait,
                     # compute, and push wait nest under it, the push's
@@ -875,19 +971,42 @@ class PSWorker(threading.Thread):
                             worker_id = self.result.worker_id
 
                         t_step = _tnow()
-                        with trace_span("worker.compute") as _csp:
-                            grads, batch_stats, loss, acc = \
-                                self._grad_step(
-                                    params, batch_stats, xb, yb, rng,
-                                    self.result.local_steps_completed)
-                            if _csp.ctx is not None:
-                                # Tracing: pin jax's async dispatch so
-                                # device time lands on THIS span instead
-                                # of on whichever later span first
-                                # materializes the grads (the codec's
-                                # device_get would otherwise absorb the
-                                # whole step and poison the attribution).
-                                jax.block_until_ready(grads)
+                        if local_sgd:
+                            if boundary:
+                                # Window open: adopt the fetched params as
+                                # the local trajectory (fresh copy — the
+                                # fused step donates) and zero the window
+                                # accumulator.
+                                local_params = jax.tree_util.tree_map(
+                                    lambda a: jnp.array(a), params)
+                                accum = jax.tree_util.tree_map(
+                                    jnp.zeros_like, local_params)
+                                accum_n = 0
+                            with trace_span("worker.compute") as _csp:
+                                (local_params, accum, batch_stats, loss,
+                                 acc) = self._fused_step(
+                                    local_params, accum, batch_stats,
+                                    xb, yb, rng,
+                                    self.result.local_steps_completed,
+                                    local_lr)
+                                if _csp.ctx is not None:
+                                    jax.block_until_ready(accum)
+                            grads = None
+                        else:
+                            with trace_span("worker.compute") as _csp:
+                                grads, batch_stats, loss, acc = \
+                                    self._grad_step(
+                                        params, batch_stats, xb, yb, rng,
+                                        self.result.local_steps_completed)
+                                if _csp.ctx is not None:
+                                    # Tracing: pin jax's async dispatch so
+                                    # device time lands on THIS span
+                                    # instead of on whichever later span
+                                    # first materializes the grads (the
+                                    # codec's device_get would otherwise
+                                    # absorb the whole step and poison the
+                                    # attribution).
+                                    jax.block_until_ready(grads)
                         if self._nan_step is not None \
                                 and self.result.local_steps_completed \
                                 == self._nan_step:
@@ -896,8 +1015,14 @@ class PSWorker(threading.Thread):
                             # poison THIS batch — the health report must
                             # flag it and the cluster monitor must alert.
                             nan = np.float32("nan")
-                            grads = jax.tree_util.tree_map(
-                                lambda a: a * nan, grads)
+                            if local_sgd:
+                                # Poison the window accumulator — that is
+                                # what gets pushed at the boundary.
+                                accum = jax.tree_util.tree_map(
+                                    lambda a: a * nan, accum)
+                            else:
+                                grads = jax.tree_util.tree_map(
+                                    lambda a: a * nan, grads)
                             loss = loss * nan
                             print(f"fault injection: NaN gradients/loss at "
                                   f"worker={self.worker_name} local_step="
@@ -918,7 +1043,18 @@ class PSWorker(threading.Thread):
                         self._tm_steps.inc()
                         self.result.local_steps_completed += 1
 
-                        if cfg.k_step_mode == "accumulate" and k > 1:
+                        if local_sgd:
+                            accum_n += 1
+                            if accum_n == k:
+                                self._note_health(loss, accum, epoch,
+                                                  grad_scale=1.0 / accum_n)
+                                params, fetched_step = \
+                                    self._dispatch_push_mean(
+                                        worker_id, accum, accum_n,
+                                        fetched_step, params)
+                                worker_id = self.result.worker_id
+                                accum, accum_n = None, 0
+                        elif cfg.k_step_mode == "accumulate" and k > 1:
                             accum = grads if accum is None else \
                                 jax.tree_util.tree_map(
                                     lambda a, b: a + b, accum, grads)
@@ -1187,34 +1323,46 @@ class PSWorker(threading.Thread):
         if self._skip_quarantined_push():
             return params, fetched_step
         with trace_span("worker.push_wait"):
+            item = grads_tree
             try:
                 if self._pipe is None:
                     self._push(worker_id, grads_tree, fetched_step)
                 else:
-                    self._pipe.submit(grads_tree, fetched_step,
+                    # Overlapped path: ENCODE at dispatch, on the training
+                    # thread — the device quantize/pack is dispatched (and
+                    # its EF residual carried) in program order before the
+                    # next window's gradients touch it; the comms thread
+                    # later pulls only the finished packed bytes.
+                    payload = self._maybe_encode_device(grads_tree)
+                    if payload is not None:
+                        item = payload
+                    self._pipe.submit(item, fetched_step,
                                       prefetch_current=params)
                 self._poll_directives()
                 return params, fetched_step
             except Exception as e:  # noqa: BLE001 — push recovery
-                return self._recover_push(e, grads_tree, fetched_step)
+                return self._recover_push(e, item, fetched_step)
 
     def _dispatch_push_mean(self, worker_id: int, accum_tree, n: int,
                             fetched_step: int, params):
         if self._skip_quarantined_push():
             return params, fetched_step
         with trace_span("worker.push_wait"):
-            mean_tree = None
+            item = None
             try:
                 if self._pipe is None:
                     self._push_mean(worker_id, accum_tree, n, fetched_step)
                 else:
-                    mean_tree = _window_mean(accum_tree, n)
-                    self._pipe.submit(mean_tree, fetched_step,
+                    item = _window_mean(accum_tree, n)
+                    payload = self._maybe_encode_device(item)
+                    if payload is not None:
+                        item = payload
+                    self._pipe.submit(item, fetched_step,
                                       prefetch_current=params)
                 self._poll_directives()
                 return params, fetched_step
             except Exception as e:  # noqa: BLE001 — push recovery
-                grads = mean_tree if mean_tree is not None \
+                grads = item if item is not None \
                     else _window_mean(accum_tree, n)
                 return self._recover_push(e, grads, fetched_step)
 
@@ -1306,6 +1454,31 @@ class PSWorker(threading.Thread):
         except Exception:  # noqa: BLE001 — scales are an optimization hint
             return {}
 
+    def _note_d2h_overlap(self, seconds: float) -> None:
+        """Record device->host gradient-pull seconds that ran on the comms
+        pipeline thread — pull time the training thread did NOT block on
+        (the double-buffered-transfer win). Serial pulls block the trainer
+        and are not 'saved'."""
+        pipe = self._pipe
+        if pipe is not None and threading.current_thread() is pipe._thread:
+            self._tm_d2h_saved.observe(seconds)
+
+    def _maybe_encode_device(self, grads_tree):
+        """Device-resident encode of a push, if it applies: returns a
+        DevicePayload (quantize/pack dispatched on the accelerator, packed
+        bytes copying to the host in the background) or None when the
+        NumPy reference path in ``_push`` should handle it (codec off,
+        non-quantized codec, or a host-resident tree)."""
+        if self._device_codec is None \
+                or isinstance(grads_tree, DevicePayload):
+            return None
+        flat = flatten_params(grads_tree, as_numpy=False)
+        if not is_device_tree(flat):
+            return None
+        plan = self._bitwidth.plan(flat) if self._bitwidth else None
+        return self._device_codec.encode(
+            flat, plan=plan, scales=self._gradient_scales())
+
     def _push(self, worker_id, grads_tree, fetched_step) -> None:
         with trace_span("worker.codec", stage="encode"):
             if getattr(self.store, "keeps_device_arrays", False):
@@ -1314,24 +1487,45 @@ class PSWorker(threading.Thread):
                 flat = flatten_params(grads_tree, as_numpy=False)
                 pre_bytes = 0
             else:
-                flat = flatten_params(jax.device_get(grads_tree))
-                pre_bytes = sum(int(v.nbytes) for v in flat.values())
-                # Worker-side compression (worker.py:264-268): the store/
-                # service advertises its codec; the encode happens here,
-                # once, before the wire (fp16 = the reference's cast; the
-                # quantized family — int8/int4/topk/adaptive — quantizes
-                # per the bitwidth controller's per-layer plan, against
-                # the server's shared scales when published, with error
-                # feedback carrying the residual).
-                codec = getattr(self.store, "push_codec", "none")
-                if codec == "fp16":
-                    flat = fp16_compress(flat)
-                elif codec in QUANTIZED_PUSH_CODECS:
-                    plan = self._bitwidth.plan(flat) if self._bitwidth \
-                        else None
-                    flat = compress_push(
-                        flat, plan, scales=self._gradient_scales(),
-                        ef=self._ef, topk_frac=self.config.topk_frac)
+                payload = grads_tree \
+                    if isinstance(grads_tree, DevicePayload) \
+                    else self._maybe_encode_device(grads_tree)
+                if payload is not None:
+                    # Device codec: the quantize/pack already ran on the
+                    # accelerator (at dispatch time when pipelined);
+                    # finalize pulls ONLY the packed wire bytes.
+                    t0 = _tnow()
+                    flat = self._device_codec.finalize(payload)
+                    pull_s = _tnow() - t0
+                    self._note_d2h_overlap(pull_s)
+                    self._tm_codec_s.observe(
+                        payload.encode_seconds + pull_s)
+                    pre_bytes = payload.pre_bytes
+                else:
+                    t0 = _tnow()
+                    flat = flatten_params(jax.device_get(grads_tree))
+                    self._note_d2h_overlap(_tnow() - t0)
+                    pre_bytes = sum(int(v.nbytes) for v in flat.values())
+                    # Worker-side compression (worker.py:264-268): the
+                    # store/service advertises its codec; the encode
+                    # happens here, once, before the wire (fp16 = the
+                    # reference's cast; the quantized family — int8/int4/
+                    # topk/adaptive — quantizes per the bitwidth
+                    # controller's per-layer plan, against the server's
+                    # shared scales when published, with error feedback
+                    # carrying the residual).
+                    codec = getattr(self.store, "push_codec", "none")
+                    t1 = _tnow()
+                    if codec == "fp16":
+                        flat = fp16_compress(flat)
+                        self._tm_codec_s.observe(_tnow() - t1)
+                    elif codec in QUANTIZED_PUSH_CODECS:
+                        plan = self._bitwidth.plan(flat) if self._bitwidth \
+                            else None
+                        flat = compress_push(
+                            flat, plan, scales=self._gradient_scales(),
+                            ef=self._ef, topk_frac=self.config.topk_frac)
+                        self._tm_codec_s.observe(_tnow() - t1)
                 wire_bytes = sum(int(v.nbytes) for v in flat.values())
                 self._tm_push_pre.inc(pre_bytes)
                 self._tm_push_wire.inc(wire_bytes)
@@ -1391,9 +1585,14 @@ def run_workers(store: ParameterStore, model, dataset: Dataset,
     config = config or WorkerConfig()
     grad_step = make_grad_step(model, augment=config.augment)
     eval_step = jax.jit(make_eval_step())
+    # local_sgd workers share ONE donated fused compile too (same shapes
+    # => one executable; each call donates its own buffers).
+    fused_step = make_fused_local_step(model, augment=config.augment) \
+        if config.k_step_mode == "local_sgd" else None
     workers = [
         PSWorker(store, model, dataset, config, grad_step=grad_step,
-                 eval_step=eval_step, worker_name=f"worker-{i}")
+                 eval_step=eval_step, fused_step=fused_step,
+                 worker_name=f"worker-{i}")
         for i in range(n_workers)
     ]
     for w in workers:

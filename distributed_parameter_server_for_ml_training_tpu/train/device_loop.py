@@ -25,13 +25,46 @@ document the difference: <0.3% of data at batch 128).
 
 from __future__ import annotations
 
-from typing import Callable
+from collections import deque
+from typing import Callable, Iterable, Iterator
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ..data.cifar import Dataset
+
+
+def prefetch_to_device(batches: Iterable, depth: int = 2,
+                       device_put: Callable = jax.device_put) -> Iterator:
+    """Host->device double buffering for a host batch iterator.
+
+    Keeps ``depth`` batches' transfers in flight: ``jax.device_put``
+    returns immediately (async dispatch), so batch N+1's host->device
+    copy overlaps the consumer's compute on batch N instead of serializing
+    in front of it — the input-side half of the double-buffered-transfer
+    story (the gradient pull's half lives in the worker's comms pipeline;
+    ps/worker.py). Yields ``(xb, yb)`` device pairs in the source order;
+    values are exactly the source's (``device_put`` is a bitwise copy).
+    ``depth=0`` degrades to a plain pass-through of host batches.
+    """
+    it = iter(batches)
+    if depth <= 0:
+        yield from it
+        return
+    buf: deque = deque()
+    try:
+        while len(buf) < depth:
+            xb, yb = next(it)
+            buf.append((device_put(xb), device_put(yb)))
+    except StopIteration:
+        pass  # fewer batches than the pipeline depth
+    while buf:
+        out = buf.popleft()
+        nxt = next(it, None)
+        if nxt is not None:
+            buf.append((device_put(nxt[0]), device_put(nxt[1])))
+        yield out
 
 
 class DeviceEpochLoop:

@@ -78,12 +78,16 @@ def make_train_step(augment: bool = True,
     def train_step(state: TrainState, images_u8: jax.Array,
                    labels: jax.Array, rng: jax.Array):
         rng = jax.random.fold_in(rng, state.step)
-        # torchvision order (worker.py:145-154): ToTensor -> RandomCrop/Flip
-        # on raw pixels (zero pad = black) -> Normalize.
-        images = to_float(images_u8)
+        # torchvision order (worker.py:145-154): RandomCrop/Flip on raw
+        # pixels (zero pad = black) -> ToTensor -> Normalize. The crop/flip
+        # gathers run on the uint8 pixels — bit-identical floats to casting
+        # first (pure index permutations, zero pad in either domain) at 1/4
+        # the gather bandwidth; the two batched gathers once cost ~45% of
+        # the ResNet-18 step.
+        images = images_u8
         if augment:
             images = augment_batch(rng, images)
-        images = standardize(images)
+        images = standardize(to_float(images))
 
         if moe_aux_weight is not None:
             def loss_fn(p):
@@ -144,10 +148,12 @@ def make_grad_step(model, augment: bool = True) -> Callable:
     @jax.jit
     def grad_step(params, batch_stats, images_u8, labels, rng, step):
         rng = jax.random.fold_in(rng, step)
-        images = to_float(images_u8)
+        # Augment on the raw uint8 pixels (see make_train_step): same
+        # floats, 1/4 the gather bandwidth.
+        images = images_u8
         if augment:
             images = augment_batch(rng, images)
-        images = standardize(images)
+        images = standardize(to_float(images))
 
         def loss_fn(p):
             outputs, mutated = model.apply(
@@ -163,6 +169,56 @@ def make_grad_step(model, augment: bool = True) -> Callable:
         return grads, new_stats, loss, accuracy
 
     return grad_step
+
+
+def make_fused_local_step(model, augment: bool = True) -> Callable:
+    """Build the DONATED fused worker-local step for ``local_sgd`` mode:
+    grads + SGD apply + window-accumulator update as ONE compiled program.
+
+    ``fused_step(params, accum, batch_stats, images_u8, labels, rng,
+    step, lr) -> (new_params, new_accum, new_batch_stats, loss, accuracy)``
+    with ``donate_argnums=(0, 1, 2)``: params, the gradient accumulator,
+    and batch_stats are donated, so XLA updates them in place — no
+    param-sized allocation and no device->host->device round-trip inside
+    the K-step window. The worker trains along its LOCAL trajectory
+    (params -= lr * grads each batch, the same plain-SGD apply the server
+    runs) and pushes the window's accumulated gradient sum at the
+    boundary; with K=1 the accumulator carries exactly one batch's
+    gradients at the fetched params, so the pushed payload matches
+    'faithful' mode bit-for-bit (up to +0/-0 on exactly-zero gradient
+    entries: the accumulator's ``0 + g``). ``lr`` is traced (one
+    executable serves any learning rate).
+    """
+
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2))
+    def fused_step(params, accum, batch_stats, images_u8, labels, rng,
+                   step, lr):
+        rng = jax.random.fold_in(rng, step)
+        images = images_u8
+        if augment:
+            images = augment_batch(rng, images)
+        images = standardize(to_float(images))
+
+        def loss_fn(p):
+            outputs, mutated = model.apply(
+                _variables(p, batch_stats),
+                images, train=True, mutable=["batch_stats"],
+            )
+            loss = cross_entropy_loss(outputs, labels)
+            return loss, (outputs, mutated.get("batch_stats", {}))
+
+        (loss, (logits, new_stats)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(params)
+        new_params = jax.tree_util.tree_map(
+            lambda p, g: p - lr * g, params, grads)
+        new_accum = jax.tree_util.tree_map(
+            lambda a, g: a + g, accum, grads)
+        accuracy = jnp.mean(jnp.argmax(logits, -1) == labels)
+        return new_params, new_accum, new_stats, loss, accuracy
+
+    return fused_step
 
 
 def make_eval_step() -> Callable:

@@ -1,0 +1,137 @@
+"""Push-codec microbench: NumPy reference vs device-resident codec.
+
+ISSUE 14 satellite: the worker's push path now quantizes+packs ON DEVICE
+(ops/device_codec.py) with the NumPy ``compress_push`` kept as fallback
+and server-side decode. This sweep measures both implementations over a
+layer-size ladder and every codec kind, and — because a fast codec that
+drifts from the wire contract is worse than a slow one — byte-compares
+the encoded wire frames per cell before recording a number. A cell with
+non-identical bytes records ``bytes_identical: false`` and fails the
+run's ``all_identical`` verdict (the slow test wrapper asserts it).
+
+Timing discipline matches bench.py: per cell, one warmup encode
+(compiles the whole-tree phase programs on the device side), then
+``--repeats`` timed encodes with the best wall kept. The device number
+includes ``finalize`` (the device->host pull of the packed bytes) —
+that's what the worker actually pays before the wire. Error feedback is
+OFF for both sides so every repeat encodes the same input.
+
+Artifact: experiments/results/codec/codec_bench.json
+Run:      python experiments/run_codec_bench.py [--quick]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+OUT = os.path.join(REPO, "experiments", "results", "codec")
+
+SIZES = [4096, 65536, 262144, 1048576, 4194304]
+KINDS = ["int8", "int4", "topk"]
+TOPK_FRAC = 0.01
+
+
+def _best(fn, repeats: int) -> float:
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def run_sweep(sizes, repeats: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from distributed_parameter_server_for_ml_training_tpu.comms import wire
+    from distributed_parameter_server_for_ml_training_tpu.ops.compression \
+        import compress_push
+    from distributed_parameter_server_for_ml_training_tpu.ops.device_codec \
+        import DeviceCodec
+
+    platform = jax.devices()[0].platform
+    rows = []
+    for size in sizes:
+        rng = np.random.default_rng(size)
+        host = {"g": rng.normal(size=size).astype(np.float32)}
+        dev = {"g": jnp.asarray(host["g"])}
+        jax.block_until_ready(dev["g"])
+        for kind in KINDS:
+            plan = {"g": kind}
+            codec = DeviceCodec(error_feedback=False, topk_frac=TOPK_FRAC)
+
+            def numpy_encode():
+                return compress_push(host, plan, topk_frac=TOPK_FRAC)
+
+            def device_encode():
+                return codec.finalize(codec.encode(dev, plan=plan))
+
+            ref = numpy_encode()
+            out = device_encode()  # warmup: compiles the phase programs
+            blob_ref = wire.encode_tensor_dict(ref)
+            blob_dev = wire.encode_tensor_dict(out)
+            identical = blob_ref == blob_dev
+
+            np_s = _best(numpy_encode, repeats)
+            dev_s = _best(device_encode, repeats)
+            mb = size * 4 / 1e6
+            rows.append({
+                "size": size,
+                "kind": kind,
+                "input_mb": round(mb, 3),
+                "bytes_identical": identical,
+                "wire_bytes": len(blob_dev),
+                "numpy_s": round(np_s, 6),
+                "device_s": round(dev_s, 6),
+                "numpy_mb_per_s": round(mb / np_s, 1),
+                "device_mb_per_s": round(mb / dev_s, 1),
+                "device_speedup": round(np_s / dev_s, 3),
+            })
+            print(f"size {size:>8} {kind:>5}: numpy "
+                  f"{rows[-1]['numpy_mb_per_s']:>8} MB/s, device "
+                  f"{rows[-1]['device_mb_per_s']:>8} MB/s "
+                  f"({'identical' if identical else 'BYTES DIFFER'})",
+                  file=sys.stderr)
+    return {
+        "metric": "push_codec_encode_mb_per_s",
+        "platform": platform,
+        "repeats": repeats,
+        "topk_frac": TOPK_FRAC,
+        "rows": rows,
+        "all_identical": all(r["bytes_identical"] for r in rows),
+    }
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true",
+                        help="small sizes + 2 repeats (test wrapper)")
+    parser.add_argument("--repeats", type=int, default=5)
+    parser.add_argument("--out", default=os.path.join(
+        OUT, "codec_bench.json"))
+    args = parser.parse_args()
+
+    sizes = [4096, 65536] if args.quick else SIZES
+    repeats = 2 if args.quick else args.repeats
+    summary = run_sweep(sizes, repeats)
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(summary, f, indent=2)
+        f.write("\n")
+    print(json.dumps({"out": args.out,
+                      "platform": summary["platform"],
+                      "all_identical": summary["all_identical"]}))
+    return 0 if summary["all_identical"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,109 @@
+"""Codec microbench (experiments/run_codec_bench.py): recorded artifact
+validated in tier-1, full rerun behind the slow marker — the same
+discipline as the other recorded demos."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCRIPT = os.path.join(REPO, "experiments", "run_codec_bench.py")
+ARTIFACT = os.path.join(REPO, "experiments", "results", "codec",
+                        "codec_bench.json")
+
+
+class TestRecordedArtifact:
+    def _summary(self) -> dict:
+        assert os.path.exists(ARTIFACT), \
+            "run experiments/run_codec_bench.py to record the sweep"
+        with open(ARTIFACT) as f:
+            return json.load(f)
+
+    def test_every_cell_is_byte_identical(self):
+        """The headline property: a codec cell only records a throughput
+        number if its wire frame matched the NumPy reference exactly."""
+        summary = self._summary()
+        assert summary["all_identical"]
+        assert summary["rows"], "empty sweep recorded"
+        for row in summary["rows"]:
+            assert row["bytes_identical"], row
+
+    def test_sweep_shape_and_sanity(self):
+        summary = self._summary()
+        assert summary["metric"] == "push_codec_encode_mb_per_s"
+        assert summary["platform"]  # never an unmarked number
+        kinds = {r["kind"] for r in summary["rows"]}
+        assert kinds == {"int8", "int4", "topk"}
+        for row in summary["rows"]:
+            assert row["numpy_mb_per_s"] > 0
+            assert row["device_mb_per_s"] > 0
+            assert row["wire_bytes"] > 0
+            # quantized frames beat raw fp32 on the wire
+            assert row["wire_bytes"] < row["size"] * 4
+
+
+PROFILE = os.path.join(REPO, "experiments", "results", "codec",
+                       "codec_profile.json")
+MERGED = os.path.join(REPO, "experiments", "results", "codec",
+                      "codec_perf_profile.json")
+
+
+class TestRecordedProfile:
+    """Phase-attribution artifact (experiments/run_codec_profile.py):
+    the committed evidence that the codec phase is attributed through
+    the perf observatory and that switching codec implementations moves
+    time, never wire bytes."""
+
+    def _summary(self) -> dict:
+        assert os.path.exists(PROFILE), \
+            "run experiments/run_codec_profile.py to record the artifact"
+        with open(PROFILE) as f:
+            return json.load(f)
+
+    def test_all_checks_recorded_passing(self):
+        summary = self._summary()
+        assert summary["all_pass"]
+        assert {c["check"] for c in summary["checks"]} >= {
+            "codec_phase_attributed_in_both_cells",
+            "identical_wire_bytes_across_codecs",
+            "merged_profile_artifact_reconciles"}
+
+    def test_cells_moved_identical_wire_bytes(self):
+        cells = {c["cell"]: c for c in self._summary()["cells"]}
+        assert set(cells) == {"numpy_codec", "device_codec"}
+        assert cells["numpy_codec"]["push_bytes"] == \
+            cells["device_codec"]["push_bytes"]
+        assert cells["device_codec"]["push_bytes"]["wire"] > 0
+        for cell in cells.values():
+            assert cell["phase_totals_s"]["codec"] > 0
+            assert cell["platform"]  # attribution is always platform-marked
+        assert cells["device_codec"]["codec_observations"] > 0
+
+    def test_merged_profile_reconciles_with_residual(self):
+        assert os.path.exists(MERGED), \
+            "codec_perf_profile.json missing beside codec_profile.json"
+        with open(MERGED) as f:
+            merged = json.load(f)
+        assert merged["trace_files"]
+        assert not merged["parse_errors"]
+        rec = merged["reconciliation"]
+        # the join reports its residual instead of hiding it
+        assert {"step_wall_s", "attributed_s"} <= set(rec)
+        assert merged["critical_path"]["steps"] > 0
+
+
+@pytest.mark.slow
+def test_codec_bench_quick_rerun(tmp_path):
+    out = tmp_path / "codec_bench.json"
+    proc = subprocess.run(
+        [sys.executable, SCRIPT, "--quick", "--out", str(out)],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    with open(out) as f:
+        summary = json.load(f)
+    assert summary["all_identical"]
+    assert len(summary["rows"]) == 6  # 2 sizes x 3 kinds

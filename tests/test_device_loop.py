@@ -68,3 +68,59 @@ def test_baseline_trainer_device_loop_mode(devices):
     metrics = trainer.train()
     assert len(metrics.test_accuracies) == 2
     assert len(metrics.epoch_times) == 2
+
+
+class TestPrefetchToDevice:
+    """`prefetch_to_device`: the input half of the double-buffered
+    transfer story (ISSUE 14) — order-preserving, bitwise, lazy."""
+
+    def _batches(self, n=7, size=4):
+        rng = np.random.default_rng(0)
+        return [(rng.integers(0, 256, (size, 8, 8, 3)).astype(np.uint8),
+                 rng.integers(0, 10, (size,)).astype(np.int32))
+                for _ in range(n)]
+
+    def test_values_and_order_preserved(self):
+        from distributed_parameter_server_for_ml_training_tpu.train.device_loop import (
+            prefetch_to_device)
+        src = self._batches()
+        out = list(prefetch_to_device(iter(src), depth=2))
+        assert len(out) == len(src)
+        for (xs, ys), (xd, yd) in zip(src, out):
+            np.testing.assert_array_equal(np.asarray(xd), xs)
+            np.testing.assert_array_equal(np.asarray(yd), ys)
+
+    def test_depth_zero_is_passthrough(self):
+        from distributed_parameter_server_for_ml_training_tpu.train.device_loop import (
+            prefetch_to_device)
+        src = self._batches(n=3)
+        out = list(prefetch_to_device(iter(src), depth=0))
+        # no device_put at depth 0 — the very same host arrays come back
+        assert all(xd is xs and yd is ys
+                   for (xs, ys), (xd, yd) in zip(src, out))
+
+    def test_keeps_depth_transfers_in_flight(self):
+        from distributed_parameter_server_for_ml_training_tpu.train.device_loop import (
+            prefetch_to_device)
+        puts = []
+
+        def counting_put(a):
+            puts.append(len(puts))
+            return a
+
+        src = self._batches(n=5)
+        it = prefetch_to_device(iter(src), depth=2, device_put=counting_put)
+        assert puts == []  # lazy: nothing moves until first pull
+        next(it)
+        # first pull primes the pipeline (2 batches = 4 arrays) and
+        # immediately dispatches the replacement for the consumed one
+        assert len(puts) == 6
+        assert len(list(it)) == 4
+
+    def test_fewer_batches_than_depth(self):
+        from distributed_parameter_server_for_ml_training_tpu.train.device_loop import (
+            prefetch_to_device)
+        src = self._batches(n=2)
+        out = list(prefetch_to_device(iter(src), depth=8))
+        assert len(out) == 2
+        np.testing.assert_array_equal(np.asarray(out[1][0]), src[1][0])

@@ -243,6 +243,52 @@ class TestHotPathAlloc:
         assert hot_path.run([src]) == []
 
 
+# -- rule: hot-path-sync (device variant) ------------------------------------
+
+class TestHotPathSync:
+    def test_device_marked_host_materializations_flagged(self, tmp_path):
+        src = _src(tmp_path, "m.py", """\
+            import jax
+            import numpy as np
+
+            def encode(tree):  # dpslint: hot-path device
+                a = jax.device_get(tree)
+                b = np.asarray(tree)
+                c = np.array(tree)
+                return a, b, c
+            """)
+        found = hot_path.run([src])
+        assert len(found) == 3
+        assert all(f.rule == "hot-path-sync" for f in found)
+        msgs = " | ".join(f.message for f in found)
+        assert "jax.device_get()" in msgs
+        assert "np.asarray()" in msgs
+        assert "np.array()" in msgs
+
+    def test_device_marked_skips_numpy_alloc_rules(self, tmp_path):
+        # jnp .astype never copies on device — the host allocation
+        # budget must NOT fire inside a device-marked kernel.
+        src = _src(tmp_path, "m.py", """\
+            import jax.numpy as jnp
+
+            # dpslint: hot-path device — fixture
+            def quantize(x, s):
+                return jnp.rint(x / s).astype(jnp.int8)
+            """)
+        assert hot_path.run([src]) == []
+
+    def test_host_marked_does_not_run_device_rule(self, tmp_path):
+        src = _src(tmp_path, "m.py", """\
+            import jax
+            import numpy as np
+
+            def pull(tree):  # dpslint: hot-path
+                return np.asarray(jax.device_get(tree))
+            """)
+        assert all(f.rule == "hot-path-alloc"
+                   for f in hot_path.run([src]))
+
+
 # -- rules: meta-key / cap-gate ----------------------------------------------
 
 class TestCapabilityGating:

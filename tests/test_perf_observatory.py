@@ -428,6 +428,37 @@ class TestBenchwatchRegression:
         assert v["status"] == "malformed"
         assert v["malformed"][0]["file"] == "BENCH_r01.json"
 
+    def test_codec_mb_per_s_tracked_as_secondary_series(self, tmp_path):
+        """ISSUE 14: the device-codec throughput extra becomes its own
+        watched series — absent/null in old records (no point, no gate),
+        regression-flagged once enough rounds carry it."""
+        from tools.benchwatch import EXTRA_METRIC_FIELDS
+        assert EXTRA_METRIC_FIELDS["codec_mb_per_s"] == "MB/s"
+        ledger = _write_ledger(tmp_path, [
+            _bench_record(100.0),  # pre-codec round: no extra field
+            _bench_record(100.0, parsed_extra={"codec_mb_per_s": None}),
+            _bench_record(100.0, parsed_extra={"codec_mb_per_s": 900.0}),
+            _bench_record(100.0, parsed_extra={"codec_mb_per_s": 910.0}),
+            _bench_record(100.0, parsed_extra={"codec_mb_per_s": 905.0}),
+            _bench_record(100.0, parsed_extra={"codec_mb_per_s": 400.0}),
+        ])
+        v = check_regressions(ledger)
+        assert v["status"] == "regression"
+        assert v["regressions"] == ["codec_mb_per_s"]
+        row = v["metrics"]["codec_mb_per_s"]
+        assert row["runs"] == 4  # null/absent rounds contribute nothing
+        assert row["unit"] == "MB/s"
+        # with only the three good rounds there is no verdict yet
+        sub = tmp_path / "short"
+        sub.mkdir()
+        short = _write_ledger(sub, [
+            _bench_record(100.0, parsed_extra={"codec_mb_per_s": x})
+            for x in (900.0, 910.0, 905.0)] + [_bench_record(100.0)] * 2)
+        vs = check_regressions(short)
+        assert vs["metrics"]["codec_mb_per_s"]["status"] == \
+            "insufficient_history"
+        assert vs["status"] == "pass"
+
     def test_insufficient_history_reports_not_flags(self, tmp_path):
         ledger = _write_ledger(tmp_path,
                                [_bench_record(100.0),

@@ -411,3 +411,90 @@ def test_bitwidth_controller_escalates_and_deescalates():
     f = _BitwidthController("int4")
     f.note_push(0.9, 1.0)
     assert f.level == 1 and f.describe() == "int4"
+
+
+def _final_params(store):
+    return {k: np.array(v) for k, v in store.parameters.items()}
+
+
+def _run_once(model, small_dataset, *, store_kw, cfg_kw):
+    store = ParameterStore(
+        init_flat(model),
+        StoreConfig(mode="sync", total_workers=1, learning_rate=0.05,
+                    **store_kw))
+    base = dict(batch_size=32, num_epochs=1, augment=False,
+                eval_each_epoch=False, seed=0)
+    base.update(cfg_kw)
+    results = run_workers(store, model, small_dataset, n_workers=1,
+                          config=WorkerConfig(**base))
+    assert results[0].error is None
+    return store, results[0]
+
+
+def test_local_sgd_k1_matches_faithful_bitwise(model, small_dataset):
+    """ISSUE 14: with K=1 the donated fused step's window accumulator holds
+    exactly one batch's gradients at the fetched params, so `local_sgd`
+    must reproduce `faithful` mode's store trajectory bit-for-bit (up to
+    +0/-0 on exactly-zero gradients, which compare equal)."""
+    finals = {}
+    for mode in ("faithful", "local_sgd"):
+        store, r = _run_once(model, small_dataset,
+                             store_kw=dict(push_codec="none"),
+                             cfg_kw=dict(sync_steps=1, k_step_mode=mode))
+        assert r.pushes_accepted == len(small_dataset.x_train) // 32
+        finals[mode] = _final_params(store)
+    assert finals["faithful"].keys() == finals["local_sgd"].keys()
+    for k in finals["faithful"]:
+        np.testing.assert_array_equal(finals["faithful"][k],
+                                      finals["local_sgd"][k], err_msg=k)
+
+
+def test_local_sgd_window_push_pattern_and_epoch_flush(model, small_dataset):
+    """K=3 local_sgd: 20 batches -> 6 full windows + a 2-batch partial that
+    the epoch boundary must flush (as a mean over the actual batch count),
+    mirroring the accumulate-mode flush contract."""
+    store, r = _run_once(model, small_dataset,
+                         store_kw=dict(),
+                         cfg_kw=dict(sync_steps=3, k_step_mode="local_sgd"))
+    n_batches = len(small_dataset.x_train) // 32
+    assert r.local_steps_completed == n_batches
+    assert r.pushes_accepted == n_batches // 3 + 1  # 6 windows + flush
+    init = init_flat(model)
+    moved = any(not np.array_equal(np.array(v), init[k])
+                for k, v in store.parameters.items())
+    assert moved, "local_sgd run left the store at its initial params"
+
+
+@pytest.mark.parametrize("codec", ["int8", "int4"])
+def test_device_codec_store_state_matches_numpy_path(model, small_dataset,
+                                                     codec):
+    """ISSUE 14 acceptance: the device-resident codec must be invisible to
+    the server — same seeds with `device_codec` on vs off land the store
+    on bit-identical parameters (wire bytes and EF residuals both proven
+    equal at the unit level in test_quantize.py; this pins the whole
+    worker loop)."""
+    finals = {}
+    for on in (True, False):
+        store, r = _run_once(model, small_dataset,
+                             store_kw=dict(push_codec=codec),
+                             cfg_kw=dict(device_codec=on))
+        assert r.pushes_accepted > 0
+        finals[on] = _final_params(store)
+    assert finals[True].keys() == finals[False].keys()
+    for k in finals[True]:
+        np.testing.assert_array_equal(finals[True][k], finals[False][k],
+                                      err_msg=k)
+
+
+def test_prefetch_batches_is_transparent(model, small_dataset):
+    """Double-buffered host->device input staging (train/device_loop.py)
+    must not change training: `jax.device_put` is a bitwise copy and the
+    batch order is preserved, so prefetch depth 0 vs 3 are identical."""
+    finals = {}
+    for depth in (0, 3):
+        store, _ = _run_once(model, small_dataset,
+                             store_kw=dict(push_codec="none"),
+                             cfg_kw=dict(prefetch_batches=depth))
+        finals[depth] = _final_params(store)
+    for k in finals[0]:
+        np.testing.assert_array_equal(finals[0][k], finals[3][k], err_msg=k)

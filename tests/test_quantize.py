@@ -294,6 +294,149 @@ class TestWireQuantCodecs:
             int4_quantize(np.asarray([1.0, np.nan], np.float32))
 
 
+class TestDeviceCodecBitIdentity:
+    """The device codec's contract (ops/device_codec.py; ISSUE 14): for
+    the same gradients, plan, shared scales, EF history, and topk_frac,
+    the device-encoded payload is BYTE-FOR-BYTE what compress_push emits
+    — keys, key order, dtypes, and frame bytes — so the server side
+    cannot tell which codec a worker ran."""
+
+    # Shapes chosen to hit every packing corner: odd flat lengths (nibble
+    # pad), 1-element tensors, non-contiguous-in-rows 2D/4D, and a size
+    # above the Pallas engagement floor's padding logic.
+    SHAPES = [(7,), (1,), (33, 5), (257, 3), (4, 3, 3, 8), (1024,)]
+
+    def _flat(self, seed=0, scale=1.0):
+        rng = np.random.default_rng(seed)
+        return {f"t{i}": (rng.normal(size=s) * scale).astype(np.float32)
+                for i, s in enumerate(self.SHAPES)}
+
+    def _assert_identical(self, dev: dict, ref: dict):
+        from distributed_parameter_server_for_ml_training_tpu.comms import (
+            wire)
+        assert list(dev) == list(ref)  # key ORDER is part of the frame
+        for k in ref:
+            assert np.asarray(dev[k]).dtype == np.asarray(ref[k]).dtype, k
+            np.testing.assert_array_equal(np.asarray(dev[k]),
+                                          np.asarray(ref[k]), err_msg=k)
+        assert wire.encode_tensor_dict(dict(dev)) \
+            == wire.encode_tensor_dict(dict(ref))
+
+    @pytest.mark.parametrize("kind", ["int8", "int4", "topk"])
+    def test_wire_bytes_match_numpy_reference(self, kind):
+        from distributed_parameter_server_for_ml_training_tpu.ops.compression import (
+            compress_push)
+        from distributed_parameter_server_for_ml_training_tpu.ops.device_codec import (
+            DeviceCodec)
+        flat = self._flat(seed=3)
+        plan = {k: kind for k in flat}
+        codec = DeviceCodec(error_feedback=False, use_pallas=False)
+        dev = codec.encode_now(
+            {k: jnp.asarray(v) for k, v in flat.items()}, plan=plan)
+        ref = compress_push(dict(flat), plan=plan)
+        self._assert_identical(dev, ref)
+
+    def test_mixed_plan_and_shared_scales_match(self):
+        from distributed_parameter_server_for_ml_training_tpu.ops.compression import (
+            compress_push)
+        from distributed_parameter_server_for_ml_training_tpu.ops.device_codec import (
+            DeviceCodec)
+        flat = self._flat(seed=11, scale=2.5)
+        names = list(flat)
+        plan = {names[0]: "none", names[1]: "int8", names[2]: "int4",
+                names[3]: "int8", names[4]: "int4", names[5]: "topk"}
+        # Server-published absmax table for a subset (the rest fall back
+        # to per-push scales), including a degenerate 0 entry.
+        scales = {names[1]: 3.25, names[2]: 0.0, names[4]: 1.125}
+        codec = DeviceCodec(error_feedback=False, use_pallas=False)
+        dev = codec.encode_now(
+            {k: jnp.asarray(v) for k, v in flat.items()},
+            plan=plan, scales=scales)
+        ref = compress_push(dict(flat), plan=plan, scales=dict(scales))
+        self._assert_identical(dev, ref)
+
+    def test_error_feedback_residuals_track_numpy_over_pushes(self):
+        """Multi-push sequence: EF residuals feed back into each encode,
+        so a single-ulp drift anywhere would compound and break the byte
+        match by push 2. Also pins the residual carry itself."""
+        from distributed_parameter_server_for_ml_training_tpu.ops.compression import (
+            ErrorFeedback, compress_push)
+        from distributed_parameter_server_for_ml_training_tpu.ops.device_codec import (
+            DeviceCodec)
+        plan = {f"t{i}": k for i, k in enumerate(
+            ["int8", "int4", "topk", "int8", "int4", "int8"])}
+        ef = ErrorFeedback()
+        codec = DeviceCodec(error_feedback=True, use_pallas=False)
+        for push in range(4):
+            flat = self._flat(seed=100 + push)
+            dev = codec.encode_now(
+                {k: jnp.asarray(v) for k, v in flat.items()}, plan=plan)
+            ref = compress_push(dict(flat), plan=plan, ef=ef)
+            self._assert_identical(dev, ref)
+        for name, res in ef._residual.items():
+            np.testing.assert_array_equal(
+                np.asarray(codec._residual[name]), res,
+                err_msg=f"EF residual diverged for {name}")
+
+    def test_topk_frac_and_k_sizing_match(self):
+        from distributed_parameter_server_for_ml_training_tpu.ops.compression import (
+            compress_push)
+        from distributed_parameter_server_for_ml_training_tpu.ops.device_codec import (
+            DeviceCodec)
+        x = {"g": np.random.default_rng(7).normal(size=1000)
+             .astype(np.float32)}
+        for frac in (0.003, 0.01, 0.25, 1.0):
+            codec = DeviceCodec(error_feedback=False, topk_frac=frac,
+                                use_pallas=False)
+            dev = codec.encode_now({"g": jnp.asarray(x["g"])},
+                                   plan={"g": "topk"})
+            ref = compress_push(dict(x), plan={"g": "topk"},
+                                topk_frac=frac)
+            self._assert_identical(dev, ref)
+
+    def test_server_aggregation_cannot_tell_codecs_apart(self):
+        """homomorphic_mean over a mixed round (half the pushes device-
+        encoded, half NumPy) equals the all-NumPy round exactly."""
+        from distributed_parameter_server_for_ml_training_tpu.ops.compression import (
+            compress_push, homomorphic_mean)
+        from distributed_parameter_server_for_ml_training_tpu.ops.device_codec import (
+            DeviceCodec)
+        plan = {"w": "int8", "v": "int4"}
+        scales = {"w": 2.0, "v": 1.5}
+        rng = np.random.default_rng(5)
+        grads = [{"w": rng.normal(size=(64, 3)).astype(np.float32),
+                  "v": rng.normal(size=129).astype(np.float32)}
+                 for _ in range(4)]
+        codec = DeviceCodec(error_feedback=False, use_pallas=False)
+        mixed = [
+            codec.encode_now({k: jnp.asarray(v) for k, v in g.items()},
+                             plan=plan, scales=scales)
+            if i % 2 else compress_push(dict(g), plan=plan,
+                                        scales=dict(scales))
+            for i, g in enumerate(grads)]
+        ref = [compress_push(dict(g), plan=plan, scales=dict(scales))
+               for g in grads]
+        got, want = homomorphic_mean(mixed), homomorphic_mean(ref)
+        for k in want:
+            np.testing.assert_array_equal(got[k], want[k])
+
+    def test_nonfinite_raises_like_reference(self):
+        from distributed_parameter_server_for_ml_training_tpu.ops.device_codec import (
+            DeviceCodec)
+        codec = DeviceCodec(error_feedback=False, use_pallas=False)
+        bad = {"g": jnp.asarray([1.0, np.nan], jnp.float32)}
+        with pytest.raises(ValueError, match="non-finite"):
+            codec.encode_now(bad, plan={"g": "int8"})
+
+    def test_is_device_tree_gates_the_fast_path(self):
+        from distributed_parameter_server_for_ml_training_tpu.ops.device_codec import (
+            is_device_tree)
+        assert is_device_tree({"a": jnp.zeros(3)})
+        assert not is_device_tree({"a": np.zeros(3)})
+        assert not is_device_tree({"a": jnp.zeros(3), "b": np.zeros(3)})
+        assert not is_device_tree({})
+
+
 def test_int8_sync_allreduce_trains(devices, tiny_model):
     """compression='int8' end-to-end: the quantized all-reduce must stay
     close to fp32 for one step and still learn over a short run."""

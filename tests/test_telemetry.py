@@ -434,6 +434,24 @@ class TestBenchCpuFallback:
                 cpu_fallback=False)
         assert ei.value.bench_attempts == 2
 
+    def test_silent_jax_level_cpu_fallback_is_marked(self, monkeypatch):
+        """ISSUE 14 hardening: xla_bridge can fail TPU init WITHOUT
+        raising — jax.devices() answers CpuDevice after a warning. With
+        nothing pinning JAX_PLATFORMS=cpu that is a fallback and must be
+        marked (or refused under --no-cpu-fallback), never recorded as a
+        chip number."""
+        import bench
+        monkeypatch.setattr(bench, "_fail_inject_remaining", 0)
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        devices, fallback = bench.acquire_backend_with_fallback(
+            retries=0, backoff=1.0, sleep=lambda s: None)
+        assert devices and devices[0].platform == "cpu"
+        assert fallback == "cpu"
+        with pytest.raises(RuntimeError, match="silently fell back"):
+            bench.acquire_backend_with_fallback(
+                retries=0, backoff=1.0, sleep=lambda s: None,
+                cpu_fallback=False)
+
     def test_fallback_also_failing_raises_original_error(self, monkeypatch):
         """When even the CPU fallback fails, the diagnostic must describe
         the ORIGINAL failure (with its attempt count), not the fallback's."""

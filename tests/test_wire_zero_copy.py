@@ -96,6 +96,28 @@ class TestQuantizedPushPath:
         for name, reasons in copy_counts.items():
             assert reasons == ["frame_write"], (name, reasons)
 
+    def test_fp32_staging_is_identity_for_f32_input(self):
+        """The cast codecs' staging step (ISSUE 14 satellite): an fp32
+        array must be staged AS ITSELF — ``_stage_f32`` returning a copy
+        would double-allocate every fp16/bf16 push (the pre-fix
+        ``np.asarray(a, np.float32)`` did exactly that for non-trivial
+        inputs)."""
+        from distributed_parameter_server_for_ml_training_tpu.ops import (
+            compression)
+        a = np.random.default_rng(2).normal(size=257).astype(np.float32)
+        assert compression._stage_f32(a) is a
+        # Narrowing casts allocate exactly the narrow output, nothing else.
+        out = compression.fp16_compress({"g": a})["g"]
+        assert out.dtype == np.float16 and out.nbytes == a.nbytes // 2
+        import ml_dtypes
+        out = compression.bf16_compress({"g": a})["g"]
+        assert out.dtype == ml_dtypes.bfloat16
+        assert out.nbytes == a.nbytes // 2
+        # Non-f32 input still stages through ONE fp32 intermediate.
+        half = a.astype(np.float16)
+        np.testing.assert_array_equal(
+            compression.fp16_compress({"g": half})["g"], half)
+
     def test_decompress_passes_fp32_entries_through_without_copy(self):
         from distributed_parameter_server_for_ml_training_tpu.ops.compression import (
             int8_wire_compress, int8_wire_decompress, wire_decompress)

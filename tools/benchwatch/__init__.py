@@ -33,6 +33,7 @@ import os
 import statistics
 
 __all__ = [
+    "EXTRA_METRIC_FIELDS",
     "check_regressions",
     "load_ledger",
     "render_markdown",
@@ -47,6 +48,14 @@ _BENCH_FIELDS = {"n": int, "cmd": str, "rc": int, "tail": str}
 _PARSED_FIELDS = {"metric": str, "value": (int, float), "unit": str}
 _MULTICHIP_FIELDS = {"n_devices": int, "rc": int, "ok": bool,
                      "skipped": bool, "tail": str}
+
+#: Secondary higher-is-better series lifted out of ``parsed`` extras and
+#: watched alongside the headline metric: field name -> unit. Optional by
+#: design — records that predate a field (or record it null) simply don't
+#: contribute a point, so a new field starts at insufficient_history and
+#: only gates once enough rounds carry it. ``codec_mb_per_s`` (ISSUE 14)
+#: is the device-resident push codec's encode throughput.
+EXTRA_METRIC_FIELDS = {"codec_mb_per_s": "MB/s"}
 
 
 def _type_errors(obj: dict, fields: dict, ctx: str) -> list:
@@ -141,6 +150,12 @@ def check_regressions(ledger: dict, tolerance: float = 0.05,
         by_metric.setdefault(parsed["metric"], []).append(
             {"file": entry["file"], "value": float(parsed["value"]),
              "unit": parsed.get("unit", "")})
+        for field, unit in EXTRA_METRIC_FIELDS.items():
+            v = parsed.get(field)
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                by_metric.setdefault(field, []).append(
+                    {"file": entry["file"], "value": float(v),
+                     "unit": unit})
     metrics = {}
     regressions = []
     for metric, points in by_metric.items():

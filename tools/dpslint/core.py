@@ -43,6 +43,11 @@ RULE_CATALOG = {
         "error", "a `# dpslint: hot-path` function calls np.copy / "
                  ".tobytes() / .astype without copy=False / np.array — "
                  "allocations the zero-copy wire discipline forbids"),
+    "hot-path-sync": (
+        "error", "a `# dpslint: hot-path device` function calls "
+                 "jax.device_get / np.asarray / np.array — a host "
+                 "materialization that stalls the device pipeline the "
+                 "function exists to keep full"),
     "meta-key": (
         "error", "an envelope-meta key read in comms/ is missing from "
                  "META_KEY_CATALOG — new wire fields must be cataloged "
@@ -67,6 +72,12 @@ GUARD_RE = re.compile(r"#\s*guarded by:\s*(?:self\.)?(\w+)")
 
 #: Hot-path marker comment (same line as the def or the line above).
 HOT_PATH_RE = re.compile(r"#\s*dpslint:\s*hot-path\b")
+
+#: Device-resident hot-path marker: the function body is jnp/lax device
+#: code (ops/device_codec.py, ops/pallas/quantize.py wire codec). The
+#: numpy allocation rules don't apply (jnp .astype never copies on
+#: device); what must never appear is a host materialization.
+HOT_PATH_DEVICE_RE = re.compile(r"#\s*dpslint:\s*hot-path\s+device\b")
 
 #: Inline suppression:  # dpslint: ignore[rule-a, rule-b]
 IGNORE_RE = re.compile(r"#\s*dpslint:\s*ignore\[([a-z\-,\s]+)\]")

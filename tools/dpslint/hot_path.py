@@ -18,22 +18,39 @@ these calls are findings:
 
 The marker is opt-in per function: the rule is a contract for paths whose
 budget is "one copy per tensor or less", not a global style ban.
+
+The ``# dpslint: hot-path device`` variant (rule ``hot-path-sync``) marks
+DEVICE-resident hot paths — jit/Pallas codec kernels (ops/device_codec.py,
+ops/pallas/quantize.py) whose whole point is keeping tensors on the
+accelerator until the final packed-bytes pull. There the numpy allocation
+rules don't apply (``jnp`` ``.astype`` never copies on device), and the
+findings are host materializations instead:
+
+- ``jax.device_get(...)`` — a blocking device->host transfer;
+- ``np.asarray(...)`` / ``np.array(...)`` — silently pull a device array
+  to the host (and block on it) to build the numpy view.
 """
 
 from __future__ import annotations
 
 import ast
 
-from .core import HOT_PATH_RE, Finding, SourceFile
+from .core import HOT_PATH_DEVICE_RE, HOT_PATH_RE, Finding, SourceFile
 
 _NP_NAMES = {"np", "numpy"}
+_JAX_NAMES = {"jax"}
 
 
-def _is_hot(src: SourceFile, node: ast.FunctionDef) -> bool:
+def _marker(src: SourceFile, node: ast.FunctionDef) -> str:
     deco_top = min((d.lineno for d in node.decorator_list),
                    default=node.lineno)
-    return bool(HOT_PATH_RE.search(src.comment_at(node.lineno))
-                or HOT_PATH_RE.search(src.own_line_comment(deco_top - 1)))
+    text = src.comment_at(node.lineno) + "\n" \
+        + src.own_line_comment(deco_top - 1)
+    if HOT_PATH_DEVICE_RE.search(text):
+        return "device"
+    if HOT_PATH_RE.search(text):
+        return "host"
+    return ""
 
 
 def _violation(node: ast.Call) -> str | None:
@@ -58,10 +75,22 @@ def _violation(node: ast.Call) -> str | None:
     return None
 
 
+def _device_violation(node: ast.Call) -> str | None:
+    f = node.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+        if f.value.id in _JAX_NAMES and f.attr == "device_get":
+            return ("jax.device_get() blocks on a device->host transfer "
+                    "inside a device-resident path")
+        if f.value.id in _NP_NAMES and f.attr in ("asarray", "array"):
+            return (f"np.{f.attr}() on a device array pulls it to the "
+                    "host (and blocks) to build the numpy view")
+    return None
+
+
 def run(sources: list[SourceFile]) -> list[Finding]:
     findings: list[Finding] = []
     for src in sources:
-        hot: list[tuple[str, ast.FunctionDef]] = []
+        hot: list[tuple[str, str, ast.FunctionDef]] = []
         parents = {src.tree: None}
 
         def qualname(fn: ast.AST) -> str:
@@ -78,16 +107,20 @@ def run(sources: list[SourceFile]) -> list[Finding]:
             for child in ast.iter_child_nodes(node):
                 parents[child] = node
         for node in ast.walk(src.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                    and _is_hot(src, node):
-                hot.append((qualname(node), node))
-        for qual, fn in hot:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                kind = _marker(src, node)
+                if kind:
+                    hot.append((kind, qualname(node), node))
+        for kind, qual, fn in hot:
+            rule = "hot-path-sync" if kind == "device" \
+                else "hot-path-alloc"
+            check = _device_violation if kind == "device" else _violation
             for sub in ast.walk(fn):
                 if not isinstance(sub, ast.Call):
                     continue
-                why = _violation(sub)
+                why = check(sub)
                 if why is not None:
                     findings.append(Finding(
-                        "hot-path-alloc", src.rel, sub.lineno,
+                        rule, src.rel, sub.lineno,
                         f"{qual}", f"hot-path {qual}(): {why}"))
     return findings
