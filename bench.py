@@ -496,6 +496,14 @@ def run_bench(args) -> dict:
             # mean the measurement overlapped a recovery.
             "reshard_resumes": 0,
             "corrupt_frames_refused": 0,
+            # Tenancy attribution (ISSUE 15): the bench measures a
+            # single-tenant in-process store — one (default) job, no
+            # admission throttling by construction; the multi-job QoS
+            # numbers live in experiments/results/tenancy/. A non-zero
+            # qos_throttled_total means the measurement ran against a
+            # contended multi-job server (docs/TENANCY.md).
+            "job_count": 1,
+            "qos_throttled_total": 0,
             # Perf-observatory fields (ISSUE 12): null unless this run
             # captured a profile (--profile-dir). device_time_fraction is
             # attributed time / (timed wall x chips); the basis says

@@ -12,6 +12,7 @@ from .manager import (
     CheckpointManager,
     PeriodicStoreCheckpointer,
     STORE_SNAPSHOT_VERSION,
+    check_job_identity,
     check_shard_identity,
     load_store_record,
     restore_server_state,
@@ -20,6 +21,6 @@ from .manager import (
 )
 
 __all__ = ["CheckpointManager", "PeriodicStoreCheckpointer",
-           "STORE_SNAPSHOT_VERSION", "check_shard_identity",
-           "load_store_record", "restore_server_state", "restore_store",
-           "save_store"]
+           "STORE_SNAPSHOT_VERSION", "check_job_identity",
+           "check_shard_identity", "load_store_record",
+           "restore_server_state", "restore_store", "save_store"]

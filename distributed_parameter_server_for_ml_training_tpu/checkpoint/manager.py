@@ -83,9 +83,11 @@ class CheckpointManager:
 #: server restart transparent to retrying clients (docs/ROBUSTNESS.md); v3
 #: adds the npz CRC-32 integrity stamp (torn/corrupt snapshots detected at
 #: restore, falling back to the previous valid record) and the in-flight
-#: migration ledger block (docs/ROBUSTNESS.md "Migration failure matrix").
-#: Restore accepts all three.
-STORE_SNAPSHOT_VERSION = 3
+#: migration ledger block (docs/ROBUSTNESS.md "Migration failure matrix");
+#: v4 adds the ``job`` identity (docs/TENANCY.md) so a restore into the
+#: wrong job's namespace is refused like a cross-shard restore — pre-v4
+#: records count as the ``default`` job. Restore accepts all four.
+STORE_SNAPSHOT_VERSION = 4
 
 
 def save_store(store: ParameterStore, directory: str,
@@ -142,6 +144,12 @@ def save_store(store: ParameterStore, directory: str,
             "shard_index": int(getattr(cfg, "shard_index", 0)),
             "shard_count": int(getattr(cfg, "shard_count", 1)),
         },
+        # Job identity (v4, docs/TENANCY.md): each job's checkpointer
+        # writes its own lineage directory, and a snapshot is only valid
+        # for the SAME job — restore refuses cross-job exactly like the
+        # shard block above refuses cross-shard. Absent pre-v4
+        # (== "default").
+        "job": str(getattr(cfg, "job_id", "default")),
         "saved_at": time.time(),
     }
     # In-flight migration ledger (docs/ROBUSTNESS.md "Migration failure
@@ -252,6 +260,7 @@ def restore_store(store: ParameterStore, directory: str,
     gauge, so telemetry streams show where a restarted server resumed)."""
     params, meta = load_store_record(directory, step)
     check_shard_identity(store, meta)
+    check_job_identity(store, meta)
     store.load_snapshot(params, int(meta["global_step"]))
     from ..telemetry import get_registry
     get_registry().gauge(
@@ -281,6 +290,21 @@ def check_shard_identity(store: ParameterStore, meta: dict) -> None:
             f"cross-shard restore")
 
 
+def check_job_identity(store: ParameterStore, meta: dict) -> None:
+    """Refuse restoring a snapshot into a different job's namespace
+    (docs/TENANCY.md): each job owns its own parameters, step, and push
+    journal, so a cross-job restore would silently replace one tenant's
+    model with another's — the tenancy analogue of the cross-shard
+    refusal above. Pre-v4 records carry no ``job`` and count as the
+    ``default`` job."""
+    rec_job = str(meta.get("job") or "default")
+    cur_job = str(getattr(store.config, "job_id", "default"))
+    if rec_job != cur_job:
+        raise ValueError(
+            f"snapshot belongs to job {rec_job!r} but this store is job "
+            f"{cur_job!r} — refusing a cross-job restore")
+
+
 def restore_server_state(store: ParameterStore, service, directory: str,
                          step: int | None = None,
                          record: tuple | None = None) -> tuple[int, int]:
@@ -294,6 +318,7 @@ def restore_server_state(store: ParameterStore, service, directory: str,
     params, meta = record if record is not None \
         else load_store_record(directory, step)
     check_shard_identity(store, meta)
+    check_job_identity(store, meta)
     store.load_snapshot(params, int(meta["global_step"]))
     from ..telemetry import get_registry
     get_registry().gauge(

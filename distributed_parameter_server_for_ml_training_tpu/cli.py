@@ -377,6 +377,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "server's apply/aggregation hot path into this "
                         "directory (same bracket as train/worker; parse "
                         "with `cli perf profile`)")
+    s.add_argument("--jobs", default=_env("DPS_JOBS", None),
+                   help="multi-job tenancy (docs/TENANCY.md): declare "
+                        "extra jobs beside the implicit 'default' one, "
+                        "each with its own parameter namespace, "
+                        "aggregation config, membership, and checkpoint "
+                        "lineage. Grammar: 'name[:k=v,...];...', e.g. "
+                        "'vision:weight=3,mode=sync,sync_quorum=2;"
+                        "ranker:weight=1,mode=async'. Enables the "
+                        "weighted-fair admission scheduler "
+                        "(per-job QoS) and the per-job /cluster view")
     s.add_argument("--no-slo", action="store_true",
                    help="disable the serve-tier SLO evaluator (on by "
                         "default with the health monitor): multi-window "
@@ -448,6 +458,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "fan out per shard and reassemble "
                         "(docs/SHARDING.md); overrides --server")
     w.add_argument("--worker-name", default=_env("WORKER_NAME", ""))
+    w.add_argument("--job", default=_env("DPS_JOB", None),
+                   help="job this worker trains (docs/TENANCY.md): "
+                        "rides registration and every push/fetch "
+                        "envelope, capability-gated — against a server "
+                        "without --jobs the worker lands in the "
+                        "'default' job unchanged")
     w.add_argument("--sync-steps", type=int,
                    default=_env("SYNC_STEPS", 1, int))
     w.add_argument("--k-step-mode", choices=["faithful", "accumulate"],
@@ -519,6 +535,34 @@ def build_parser() -> argparse.ArgumentParser:
                     metavar="SLOT:KEY=VALUE",
                     help="env var for one slot's first spawn only, e.g. "
                          "'1:DPS_NAN_STEP=4'; repeatable")
+    sv.add_argument("--autoscale-job", default=None,
+                    help="worker autoscaling (docs/TENANCY.md): poll the "
+                         "server's per-job /cluster view and grow/shrink "
+                         "this supervisor's slot count with the named "
+                         "job's admission-queue/straggler pressure "
+                         "(worker_grow/worker_shrink actions). Pass the "
+                         "job's --job flag in the child worker args too")
+    sv.add_argument("--autoscale-url", default=None,
+                    help="base URL of the serve process's metrics "
+                         "endpoint (e.g. http://host:9400); required "
+                         "with --autoscale-job")
+    sv.add_argument("--autoscale-min", type=int, default=1,
+                    help="worker-slot floor the autoscaler keeps alive")
+    sv.add_argument("--autoscale-max", type=int, default=4,
+                    help="worker-slot ceiling")
+    sv.add_argument("--autoscale-depth-high", type=float, default=4.0,
+                    help="admission queue depth above which the fleet "
+                         "grows (after --autoscale-sustain ticks)")
+    sv.add_argument("--autoscale-depth-low", type=float, default=1.0,
+                    help="queue depth below which it shrinks "
+                         "(hysteresis band with --autoscale-depth-high)")
+    sv.add_argument("--autoscale-sustain", type=int, default=3,
+                    help="consecutive polls a condition must hold "
+                         "before acting")
+    sv.add_argument("--autoscale-cooldown", type=float, default=15.0,
+                    help="minimum seconds between scaling actions")
+    sv.add_argument("--autoscale-poll", type=float, default=2.0,
+                    help="seconds between /cluster pressure polls")
     add_platform(sv)
     add_telemetry(sv)
     sv.add_argument("worker_args", nargs=argparse.REMAINDER,
@@ -588,6 +632,11 @@ def build_parser() -> argparse.ArgumentParser:
     lg.add_argument("--concurrency", type=int, default=4,
                     help="total client threads (each with its own "
                          "channel)")
+    lg.add_argument("--job", default=None,
+                    help="stamp fetches with a job id (docs/TENANCY.md); "
+                         "a comma list round-robins threads over the "
+                         "jobs and the LOADGEN_JSON gains a per-job "
+                         "QPS/latency breakdown")
     lg.add_argument("--fetch-mode", choices=["full", "delta", "infer"],
                     default="full",
                     help="full = whole model every fetch; delta = poll "
@@ -1007,6 +1056,27 @@ def _cmd_serve(args) -> int:
                     sync_quorum=getattr(args, "sync_quorum", None),
                     round_deadline=getattr(args, "round_deadline", None),
                     shard_index=shard_index, shard_count=shard_count))
+    jobs_mgr = None
+    jobs_spec = getattr(args, "jobs", None)
+    if jobs_spec:
+        # Multi-job tenancy (docs/TENANCY.md): the primary store becomes
+        # the implicit 'default' job; each declared job gets its own
+        # store (namespace + aggregation config + membership) seeded
+        # from the primary's current params.
+        from .ps.tenancy import JobManager, parse_jobs_spec
+        if sharding is not None:
+            raise SystemExit("--jobs does not compose with --shard-count "
+                             "yet (a job is a set of slots; run one "
+                             "tenancy server per shard group)")
+        if args.store_backend != "python":
+            raise SystemExit("--jobs needs --store-backend python "
+                             "(per-job stores)")
+        try:
+            jobs_mgr = JobManager(store, parse_jobs_spec(jobs_spec))
+        except ValueError as e:
+            raise SystemExit(f"--jobs: {e}") from e
+        print(f"tenancy: jobs {', '.join(jobs_mgr.names())} "
+              f"(weighted-fair QoS on)", file=sys.stderr, flush=True)
     monitor = None
     if not getattr(args, "no_health_monitor", False):
         # Cluster health monitor (docs/OBSERVABILITY.md): aggregates the
@@ -1030,6 +1100,10 @@ def _cmd_serve(args) -> int:
             # Shard identity + replica lag ride the same /cluster payload
             # cli status renders (docs/SHARDING.md, docs/OBSERVABILITY.md).
             monitor.sharding = sharding
+        if jobs_mgr is not None:
+            # Per-job membership/last_seen union + the "jobs" view block
+            # + the worker-row job column (docs/TENANCY.md).
+            monitor.jobs = jobs_mgr
         if not getattr(args, "no_slo", False):
             # Serve-tier SLOs (docs/OBSERVABILITY.md): multi-window
             # error-budget burn over the server-side RPC histograms,
@@ -1050,7 +1124,8 @@ def _cmd_serve(args) -> int:
                   f"{monitor.slo.objectives[1].target:.3g})",
                   file=sys.stderr, flush=True)
     svc = ParameterService(store, faults=getattr(args, "faults", None),
-                           monitor=monitor, sharding=sharding)
+                           monitor=monitor, sharding=sharding,
+                           jobs=jobs_mgr)
     if getattr(args, "remediate", False) \
             or getattr(args, "remediate_dry_run", False):
         # Remediation policy engine (docs/ROBUSTNESS.md): turns the
@@ -1118,13 +1193,41 @@ def _cmd_serve(args) -> int:
             print(f"restored store at step {step} "
                   f"(+{journal_n} journaled push tokens) from {ckpt_dir}",
                   file=sys.stderr)
+        if jobs_mgr is not None:
+            # Per-job lineage (docs/TENANCY.md): each job restores from
+            # its OWN subdirectory; check_job_identity refuses a
+            # snapshot that belongs to another job.
+            from .checkpoint import restore_server_state as _restore_job
+            from .ps.tenancy import DEFAULT_JOB as _DJ
+            for jname in jobs_mgr.names():
+                if jname == _DJ:
+                    continue
+                jdir = os.path.join(ckpt_dir, f"job-{jname}")
+                try:
+                    jstep, jn = _restore_job(jobs_mgr.store_for(jname),
+                                             svc, jdir)
+                except FileNotFoundError:
+                    continue
+                print(f"restored job {jname!r} at step {jstep} "
+                      f"(+{jn} journaled push tokens) from {jdir}",
+                      file=sys.stderr)
+    job_ckpts = []
     if ckpt_dir:
+        import functools
+
         from .checkpoint import PeriodicStoreCheckpointer
         from .telemetry import add_shutdown_flush, install_shutdown_hooks
+        from .ps.tenancy import DEFAULT_JOB as _DJ
+        # With tenancy on, the primary's snapshot journals ONLY the
+        # default job's tokens — each job's lineage carries its own
+        # (byte-verifiable zero cross-job leakage, docs/TENANCY.md).
+        primary_journal = (svc.journal_snapshot if jobs_mgr is None
+                           else functools.partial(svc.journal_snapshot,
+                                                  job=_DJ))
         ckpt = PeriodicStoreCheckpointer(
             store, ckpt_dir,
             interval=getattr(args, "checkpoint_interval", 30.0),
-            journal_fn=svc.journal_snapshot,
+            journal_fn=primary_journal,
             migration_fn=svc.migration_snapshot)
         ckpt.start()
         # SIGTERM drains the store's end state through the same shutdown
@@ -1132,6 +1235,19 @@ def _cmd_serve(args) -> int:
         # resumes exactly where it was killed (docs/ROBUSTNESS.md).
         install_shutdown_hooks(role="server")
         add_shutdown_flush(ckpt.flush_now)
+        if jobs_mgr is not None:
+            for jname in jobs_mgr.names():
+                if jname == _DJ:
+                    continue
+                jc = PeriodicStoreCheckpointer(
+                    jobs_mgr.store_for(jname),
+                    os.path.join(ckpt_dir, f"job-{jname}"),
+                    interval=getattr(args, "checkpoint_interval", 30.0),
+                    journal_fn=functools.partial(svc.journal_snapshot,
+                                                 job=jname))
+                jc.start()
+                add_shutdown_flush(jc.flush_now)
+                job_ckpts.append(jc)
     server, port = serve(store, port=args.port, service=svc)
     pool = None
     if getattr(args, "autoscale", False):
@@ -1166,6 +1282,8 @@ def _cmd_serve(args) -> int:
           + (f", restored_step={restored}" if restored is not None else "")
           + (f", shard={shard_index}/{shard_count}"
              if sharding is not None else "")
+          + (f", jobs={len(jobs_mgr.names())}"
+             if jobs_mgr is not None else "")
           + (", faults=on" if svc.faults is not None else "")
           + ")", file=sys.stderr)
     try:
@@ -1177,7 +1295,9 @@ def _cmd_serve(args) -> int:
         # profile` parses the dump).
         with _profiler_session(getattr(args, "profile_dir", None)):
             while not store.wait_all_finished(timeout=1.0):
-                expired = store.expire_stale_workers()
+                expired = (store.expire_stale_workers()
+                           if jobs_mgr is None
+                           else jobs_mgr.expire_stale_workers())
                 if expired:
                     print(f"expired silent workers: {expired}",
                           file=sys.stderr)
@@ -1204,6 +1324,12 @@ def _cmd_serve(args) -> int:
             if err is not None:
                 print(f"warning: last periodic snapshot failed: {err!r}",
                       file=sys.stderr)
+            for jc in job_ckpts:
+                remove_shutdown_flush(jc.flush_now)
+                jerr = jc.stop(final_snapshot=True)
+                if jerr is not None:
+                    print(f"warning: job snapshot failed: {jerr!r}",
+                          file=sys.stderr)
     if args.emit_metrics:
         emit_metrics_json(store.metrics())
     return 0
@@ -1222,13 +1348,19 @@ def _cmd_worker(args) -> int:
 
     dataset = _load_dataset(args)
     shards = getattr(args, "shards", None)
+    job = getattr(args, "job", None)
     if shards:
+        if job:
+            raise SystemExit("--job does not compose with --shards "
+                             "(tenancy and sharding run on separate "
+                             "servers, docs/TENANCY.md)")
         from .comms.sharded import ShardedRemoteStore
         store = ShardedRemoteStore(shards,
                                    faults=getattr(args, "faults", None))
     else:
         store = RemoteStore(args.server,
-                            faults=getattr(args, "faults", None))
+                            faults=getattr(args, "faults", None),
+                            job=job or None)
     import jax.numpy as jnp
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     # Honor --model/--dataset like cmd_train does — a mismatched architecture
@@ -1314,8 +1446,70 @@ def _cmd_supervise(args) -> int:
     print(f"supervisor: {args.workers} worker slot(s), "
           f"respawn={'on' if not args.no_respawn else 'off'}",
           file=sys.stderr, flush=True)
+    scaler_thread = None
+    scaler_stop = None
+    if getattr(args, "autoscale_job", None):
+        # Worker autoscaling (docs/TENANCY.md): the policy head polls
+        # the serve process's per-job /cluster view for admission-queue
+        # and straggler pressure; this supervisor's slot count is the
+        # actuator (worker_grow/worker_shrink).
+        if not getattr(args, "autoscale_url", None):
+            raise SystemExit("--autoscale-job needs --autoscale-url "
+                             "(the serve process's metrics endpoint)")
+        import json as _json
+        import threading as _threading
+        from urllib.request import urlopen
+
+        from .telemetry.remediation import (WorkerAutoscalePolicy,
+                                            WorkerAutoscaler)
+        cluster_url = args.autoscale_url.rstrip("/") + "/cluster"
+        scale_job = args.autoscale_job
+
+        def pressure() -> dict:
+            view = _json.loads(urlopen(cluster_url, timeout=5).read())
+            row = (view.get("jobs") or {}).get(scale_job) or {}
+            members = set(row.get("workers") or [])
+            stragglers = sum(
+                1 for a in view.get("alerts") or []
+                if a.get("rule") == "straggler_lag"
+                and a.get("worker") in members)
+            return {"queue_depth": row.get("waiting") or 0,
+                    "stragglers": stragglers,
+                    "workers": len(members)}
+
+        scaler = WorkerAutoscaler(
+            scale_job, pressure, supervisor=sup,
+            policy=WorkerAutoscalePolicy(
+                depth_high=args.autoscale_depth_high,
+                depth_low=args.autoscale_depth_low,
+                sustain_ticks=args.autoscale_sustain,
+                min_workers=args.autoscale_min,
+                max_workers=args.autoscale_max,
+                cooldown_s=args.autoscale_cooldown))
+        scaler_stop = _threading.Event()
+
+        def _scale_loop() -> None:
+            while not scaler_stop.wait(args.autoscale_poll):
+                scaler.tick()  # never raises
+
+        scaler_thread = _threading.Thread(target=_scale_loop,
+                                          daemon=True,
+                                          name="worker-autoscaler")
+        print(f"worker-autoscale: job={scale_job} slots "
+              f"{args.autoscale_min}..{args.autoscale_max} "
+              f"depth {args.autoscale_depth_low:g}/"
+              f"{args.autoscale_depth_high:g} "
+              f"sustain={args.autoscale_sustain}",
+              file=sys.stderr, flush=True)
     sup.start()
-    return sup.run()
+    if scaler_thread is not None:
+        scaler_thread.start()
+    try:
+        return sup.run()
+    finally:
+        if scaler_stop is not None:
+            scaler_stop.set()
+            scaler_thread.join(timeout=5.0)
 
 
 def _render_status(view: dict) -> str:
@@ -1329,9 +1523,15 @@ def _render_status(view: dict) -> str:
               f"alerts: critical={totals.get('critical', 0)} "
               f"warning={totals.get('warning', 0)} "
               f"info={totals.get('info', 0)}")
-    cols = [("worker", 7), ("alive", 6), ("step", 8), ("epoch", 6),
-            ("loss", 10), ("grad_norm", 11), ("ex/s", 9), ("pipe", 5),
-            ("codec", 19), ("reconn", 7), ("hb_err", 7), ("age_s", 7)]
+    # The job column renders only when the server is tenancy-enabled
+    # (worker rows carry "job") — a pre-tenancy /cluster payload draws
+    # the exact pre-tenancy table.
+    has_jobs = any("job" in r for r in view.get("workers", []))
+    cols = [("worker", 7)] \
+        + ([("job", 10)] if has_jobs else []) \
+        + [("alive", 6), ("step", 8), ("epoch", 6),
+           ("loss", 10), ("grad_norm", 11), ("ex/s", 9), ("pipe", 5),
+           ("codec", 19), ("reconn", 7), ("hb_err", 7), ("age_s", 7)]
     lines = [header, "-" * len(header)]
     rnd = view.get("round")
     if rnd:
@@ -1369,6 +1569,7 @@ def _render_status(view: dict) -> str:
             gn = "NaN"
         lines.append("".join([
             cell(row.get("worker"), 7),
+            *([cell(row.get("job"), 10)] if has_jobs else []),
             cell("yes" if row.get("alive") else "NO", 6),
             cell(row.get("step"), 8),
             cell(row.get("epoch"), 6),
@@ -1477,6 +1678,40 @@ def _render_status(view: dict) -> str:
                     f"{b.get('burn')}x budget over "
                     f"{b.get('window_s', 0):g}s "
                     f"({b.get('bad')}/{b.get('total')} bad)")
+    jb = view.get("jobs")
+    if jb:
+        # Tenancy view (docs/TENANCY.md): one line per job — aggregation
+        # config, live workers, and the weighted-fair QoS counters when
+        # the admission scheduler is on. Absent block (pre-tenancy
+        # server) renders nothing.
+        lines.append("")
+        lines.append("jobs:")
+        for name in sorted(jb, key=lambda n: jb[n].get("index", 0)):
+            row = jb[name]
+            qos = ""
+            if "inflight" in row:
+                qos = (f" inflight={row.get('inflight')} "
+                       f"waiting={row.get('waiting')} "
+                       f"fair_share={row.get('fair_share')}")
+            spec = ""
+            if "weight" in row:
+                spec = (f" weight={row.get('weight'):g} "
+                        f"max_inflight={row.get('max_inflight')}")
+            lines.append(
+                f"  {name}: mode={row.get('mode')} "
+                f"step={row.get('global_step')} "
+                f"workers={len(row.get('workers') or [])} "
+                f"slots={len(row.get('slots') or [])}{spec}{qos}")
+    wa = view.get("worker_autoscale")
+    if wa:
+        acts = wa.get("actions") or {}
+        lines.append("")
+        lines.append(
+            f"worker autoscale: job={wa.get('job')} "
+            f"bounds {wa.get('min')}..{wa.get('max')} "
+            f"depth {wa.get('depth_low'):g}/{wa.get('depth_high'):g} "
+            f"grew={acts.get('worker_grow', 0)} "
+            f"shrank={acts.get('worker_shrink', 0)}")
     return "\n".join(lines)
 
 
@@ -1629,7 +1864,8 @@ def cmd_loadgen(args) -> int:
 
     result = run_loadgen(args.targets, duration_s=args.duration,
                          concurrency=args.concurrency,
-                         mode=args.fetch_mode)
+                         mode=args.fetch_mode,
+                         job=getattr(args, "job", None))
     print("LOADGEN_JSON " + _json.dumps(result), flush=True)
     lat = result["latency_ms"]
     print(f"{result['qps']:.1f} fetch/s aggregate over "
@@ -1642,6 +1878,11 @@ def cmd_loadgen(args) -> int:
         print(f"  arm={arm}: {row['ok']} served, "
               f"quality={row['quality_mean']}, steps="
               f"{row['serving_steps']}", file=sys.stderr)
+    for jname, row in (result.get("jobs") or {}).items():
+        jlat = row["latency_ms"]
+        print(f"  job={jname}: {row['qps']:.1f} fetch/s "
+              f"({row['err']} errors, p50/p99 "
+              f"{jlat['p50']:g}/{jlat['p99']:g} ms)", file=sys.stderr)
     return 0 if result["fetches_ok"] > 0 else 1
 
 

@@ -89,8 +89,18 @@ class RemoteStore:
                  rpc_timeout: float = 60.0,
                  rpc_retries: int = 3,
                  rpc_backoff: float = 0.5,
-                 faults=None):
+                 faults=None,
+                 job: str | None = None):
         self.address = address
+        #: Tenancy (docs/TENANCY.md): the job this client asks to join at
+        #: registration. None joins the server's default job. The value
+        #: is re-adopted from the registration reply's echo (the server
+        #: may degrade an unknown/garbled id to the default job), and
+        #: attached to every push/fetch envelope ONLY once the server
+        #: advertises the ``jobs`` capability — a legacy server never
+        #: sees the key (the delta_fetch gating discipline).
+        self.job = job
+        self.supports_jobs = False
         self.register_retries = register_retries
         self.rpc_timeout = rpc_timeout
         self.rpc_retries = rpc_retries
@@ -229,7 +239,7 @@ class RemoteStore:
         reg = self._telemetry = get_registry()
         self._tm_rpc: dict[str, tuple] = {}
         for name in ["RegisterWorker", "PushGradrients", "FetchParameters",
-                     "JobFinished", "Reshard"]:
+                     "JobFinished", "Reshard", "SubmitJob"]:
             self._tm_rpc[name] = (
                 reg.histogram("dps_rpc_client_seconds", rpc=name),
                 reg.counter("dps_rpc_client_bytes_total", rpc=name,
@@ -320,7 +330,8 @@ class RemoteStore:
                 f"/{SERVICE_NAME}/{name}",
                 request_serializer=ident, response_deserializer=ident)
             for name in ["RegisterWorker", "PushGradrients",
-                         "FetchParameters", "JobFinished", "Reshard"]
+                         "FetchParameters", "JobFinished", "Reshard",
+                         "SubmitJob"]
         }
         if self.faults is not None:
             from .faults import install_client_faults
@@ -481,9 +492,14 @@ class RemoteStore:
             try:
                 # ``capabilities`` advertises what THIS client can act on
                 # (directives flow server->worker); an old server ignores
-                # the field (docs/ROBUSTNESS.md).
-                request = pack_msg({"worker_name": worker_name,
-                                    "capabilities": ["directives"]})
+                # the field (docs/ROBUSTNESS.md). The requested job rides
+                # the same envelope: a pre-tenancy server ignores it and
+                # the worker lands in the only job there is.
+                req_meta = {"worker_name": worker_name,
+                            "capabilities": ["directives"]}
+                if self.job is not None:
+                    req_meta["job"] = str(self.job)
+                request = pack_msg(req_meta)
                 # Deadline like the hot RPCs: an undeadlined registration
                 # against a half-up server would hang the worker (and the
                 # reconnect state machine) indefinitely.
@@ -508,6 +524,14 @@ class RemoteStore:
                     reply.get("directives", False))
                 self.supports_checksum = bool(
                     reply.get("checksum", False))
+                # Tenancy handshake (docs/TENANCY.md): adopt the job the
+                # server placed us in — it may differ from the request
+                # (garbled/unknown ids degrade to the default job), and
+                # every subsequent envelope must carry the SERVER's
+                # answer, not our wish.
+                self.supports_jobs = bool(reply.get("jobs", False))
+                if self.supports_jobs:
+                    self.job = reply.get("job") or self.job
                 # A fresh registration (incl. session resume against a
                 # restarted server) starts a fresh directive stream: the
                 # new server's seqs restart from 1, so a stale watermark
@@ -551,6 +575,14 @@ class RemoteStore:
             f"registration failed after {register_retries} attempts: "
             f"{last_err}")
 
+    def _attach_job(self, meta: dict) -> None:
+        """Label an outbound envelope with this client's job
+        (capability-gated: only after the server advertised ``jobs`` at
+        registration — a legacy server never sees the key, the
+        delta_fetch discipline; docs/TENANCY.md)."""
+        if self.supports_jobs and self.job:
+            meta["job"] = str(self.job)
+
     def _attach_health(self, meta: dict) -> None:
         """Piggyback the worker's current health report on an outbound
         fetch/push envelope (capability-gated; docs/OBSERVABILITY.md).
@@ -593,6 +625,7 @@ class RemoteStore:
         the round trip costs a header instead of the full model."""
         from .wire import decode_tensor_dict
         meta = {} if worker_id is None else {"worker_id": worker_id}
+        self._attach_job(meta)
         if worker_id is not None:
             self._attach_health(meta)
             self._attach_directive_ack(meta)
@@ -655,6 +688,7 @@ class RemoteStore:
         token = f"{self._push_nonce}:{self._push_count}"
         meta = {"worker_id": worker_id, "fetched_step": fetched_step,
                 "push_token": token}
+        self._attach_job(meta)
         if wt is not None:
             meta["trace"] = wt
         self._attach_health(meta)
@@ -692,6 +726,22 @@ class RemoteStore:
         reply = self._invoke("Reshard", request)
         return unpack_msg(reply)
 
+    def submit_job(self, spec: str) -> dict:
+        """Admin-plane SubmitJob RPC (docs/TENANCY.md): declare a new
+        job from a one-entry ``--jobs``-grammar spec string. Returns the
+        reply meta ({"submitted", "index", "jobs"}). Single-job servers
+        answer FAILED_PRECONDITION."""
+        reply = self._invoke("SubmitJob", pack_msg({"job_spec": str(spec)}))
+        meta, _ = unpack_msg(reply)
+        return meta
+
+    def drain_job(self, name: str) -> dict:
+        """Admin-plane job drain (docs/TENANCY.md): remove a drained
+        job and its per-job metric series server-side."""
+        reply = self._invoke("SubmitJob", pack_msg({"drain_job": str(name)}))
+        meta, _ = unpack_msg(reply)
+        return meta
+
     def repush_last(self, worker_id: int) -> bool | None:
         """Re-send the most recent push — same token, same payload, same
         ``fetched_step`` — under (possibly) a new worker id. The session-
@@ -706,6 +756,7 @@ class RemoteStore:
         token, payload, fetched_step = self._last_push
         meta = {"worker_id": worker_id, "fetched_step": fetched_step,
                 "push_token": token}
+        self._attach_job(meta)
         reply = self._invoke("PushGradrients", pack_msg(meta, payload))
         rmeta, _ = unpack_msg(reply)
         return bool(rmeta["accepted"])

@@ -32,6 +32,8 @@ import grpc
 
 from ..ps.sharding import key_slot
 from ..ps.store import ParameterStore
+from ..ps.tenancy import DEFAULT_JOB, WID_STRIDE, job_key, \
+    normalize_job_id, parse_jobs_spec, split_job_key
 from .wire import decode_tensor_dict, encode_tensor_dict, \
     frame_checksum_ok
 
@@ -69,6 +71,21 @@ PUSH_SEEN_CAP = 128
 #: a flat 120 s outlived the client's 60 s rpc_timeout and pinned server
 #: threads (round-5 ADVICE).
 DUP_WAIT_CAP_S = 30.0
+
+#: Ceiling on how long an RPC queues for weighted-fair admission
+#: (docs/TENANCY.md "QoS semantics") before it is throttled with
+#: RESOURCE_EXHAUSTED — which is in the client's RETRYABLE_CODES, so a
+#: throttled worker backs off and retries instead of dying. Short on
+#: purpose: backpressure should surface as bounded handler queueing plus
+#: client-side backoff, never as pinned pool threads (the DUP_WAIT
+#: lesson above).
+ADMISSION_WAIT_CAP_S = 2.0
+
+#: Handler slots the admission scheduler hands out concurrently — kept
+#: below the 20-thread gRPC pool (server.py:381 parity) so a saturated
+#: job throttles at admission while threads remain to ANSWER the
+#: throttles and serve other jobs.
+ADMISSION_CAPACITY = 16
 
 #: Server->worker control directives (docs/ROBUSTNESS.md "Self-healing"):
 #: the remediation layer posts these and the fetch/push reply envelope
@@ -160,12 +177,135 @@ def unpack_msg(data: bytes) -> tuple[dict, memoryview]:
     return meta, mv[4 + hlen:]
 
 
+class WeightedFairAdmission:
+    """Weighted-fair admission over the push/fetch handler path
+    (docs/TENANCY.md "QoS semantics"): one job's storm cannot starve
+    another's trickle.
+
+    Each job holds at most ``max_inflight`` admitted RPCs (its spec's
+    hard cap), and once the shared ``capacity`` is contended, at most
+    its *fair share* — ``capacity * weight / total_weight``, floored at
+    1 so every live job always makes progress. Under the cap an RPC
+    waits (bounded by the caller's deadline and
+    :data:`ADMISSION_WAIT_CAP_S`) for a slot; on timeout it is
+    throttled and the handler aborts RESOURCE_EXHAUSTED, which the
+    client retries with backoff. Per-job instruments:
+    ``dps_job_queue_depth{job}`` (admitted + waiting),
+    ``dps_job_admitted_total{job}``, ``dps_job_throttled_total{job}`` —
+    series are dropped on job drain (JobManager.drain), the PR 11
+    replica-lag lifecycle pattern.
+    """
+
+    def __init__(self, jobs, capacity: int = ADMISSION_CAPACITY,
+                 registry=None):
+        self.jobs = jobs  # JobManager: live weight/max_inflight source
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._inflight: dict[str, int] = {}  # guarded by: self._lock
+        self._waiting: dict[str, int] = {}  # guarded by: self._lock
+        from ..telemetry import get_registry
+        self._reg = registry or get_registry()
+        # job -> (depth gauge, admitted ctr, throttled ctr); created on a
+        # job's first admission, removed at drain (registry.remove).
+        self._instr: dict[str, tuple] = {}  # guarded by: self._lock
+
+    def _instruments_locked(self, job: str) -> tuple:
+        tup = self._instr.get(job)
+        if tup is None:
+            tup = (self._reg.gauge("dps_job_queue_depth", job=job),
+                   self._reg.counter("dps_job_admitted_total", job=job),
+                   self._reg.counter("dps_job_throttled_total", job=job))
+            self._instr[job] = tup
+        return tup
+
+    def _limits(self, job: str) -> tuple[int, int]:
+        """(fair share, hard max-inflight) from the live job table."""
+        table = self.jobs.qos_table()
+        weight, max_inflight = table.get(job, (1.0, 8))
+        total_w = sum(w for w, _ in table.values()) or 1.0
+        fair = max(1, int(self.capacity * weight / total_w))
+        return fair, int(max_inflight)
+
+    def _depth_locked(self, job: str, gauge) -> None:
+        gauge.set(self._inflight.get(job, 0) + self._waiting.get(job, 0))
+
+    def admit(self, job: str, budget_s: float) -> bool:
+        """Take an admission slot for ``job``, waiting up to
+        ``budget_s``; False means throttled (counted)."""
+        deadline = time.monotonic() + max(0.0, float(budget_s))
+        with self._lock:
+            depth_g, admitted_c, throttled_c = self._instruments_locked(job)
+            self._waiting[job] = self._waiting.get(job, 0) + 1
+            self._depth_locked(job, depth_g)
+            try:
+                while True:
+                    fair, cap = self._limits(job)
+                    mine = self._inflight.get(job, 0)
+                    total = sum(self._inflight.values())
+                    if mine < cap and (total < self.capacity
+                                       or mine < fair):
+                        self._inflight[job] = mine + 1
+                        admitted_c.inc()
+                        return True
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        throttled_c.inc()
+                        return False
+                    self._cond.wait(remaining)
+            finally:
+                self._waiting[job] -= 1
+                self._depth_locked(job, depth_g)
+
+    def release(self, job: str) -> None:
+        with self._lock:
+            n = self._inflight.get(job, 0)
+            if n <= 1:
+                self._inflight.pop(job, None)
+            else:
+                self._inflight[job] = n - 1
+            tup = self._instr.get(job)
+            if tup is not None:
+                self._depth_locked(job, tup[0])
+            self._cond.notify_all()
+
+    def forget_job(self, job: str) -> None:
+        """Drop a drained job's scheduler state. The metric series
+        themselves are removed by JobManager.drain."""
+        with self._lock:
+            self._inflight.pop(job, None)
+            self._waiting.pop(job, None)
+            self._instr.pop(job, None)
+            self._cond.notify_all()
+
+    def view(self) -> dict:
+        """Per-job admission state for /cluster and cli status."""
+        with self._lock:
+            names = (set(self._inflight) | set(self._waiting)
+                     | set(self._instr))
+            out = {}
+            for j in sorted(names):
+                fair, cap = self._limits(j)
+                out[j] = {"inflight": self._inflight.get(j, 0),
+                          "waiting": self._waiting.get(j, 0),
+                          "fair_share": fair, "max_inflight": cap}
+            return out
+
+
 class ParameterService:
     """Generic-handler implementation of the 4-RPC lifecycle."""
 
     def __init__(self, store: ParameterStore, faults=None, monitor=None,
-                 reject_nonfinite: bool = False, sharding=None):
+                 reject_nonfinite: bool = False, sharding=None,
+                 jobs=None):
         self.store = store
+        # Tenancy (docs/TENANCY.md): when a ps/tenancy.JobManager is
+        # attached, every envelope routes by its ``job`` meta key to that
+        # job's own store, worker ids stride per job, and push/fetch pass
+        # through the weighted-fair admission scheduler below. None (the
+        # default) is the single-job server, byte-identical to every
+        # prior PR — same legacy-degradation discipline as sharding.
+        self.jobs = jobs
         # Sharding state (ps/sharding.py ShardInfo): when set, this server
         # is ONE shard primary of a consistent-hash partition — the
         # registration reply publishes the shard map (that presence IS the
@@ -261,8 +401,15 @@ class ParameterService:
                                  buckets=LATENCY_BUCKETS, method=name),
                    reg.counter("dps_rpc_server_errors_total", method=name))
             for name in ["RegisterWorker", "PushGradrients",
-                         "FetchParameters", "JobFinished", "Reshard"]
+                         "FetchParameters", "JobFinished", "Reshard",
+                         "SubmitJob"]
         }
+        # Per-job QoS (docs/TENANCY.md): constructed with the job table
+        # so drain can tear down scheduler state alongside the job.
+        self.qos = None
+        if jobs is not None:
+            self.qos = WeightedFairAdmission(jobs, registry=reg)
+            jobs.qos = self.qos
         # Live-reshard state (docs/SHARDING.md "Migration protocol"):
         # slots this primary froze at export and is handing away. A push
         # touching a draining slot is disowned — dropped from the apply
@@ -406,7 +553,10 @@ class ParameterService:
                 return
             self._last_expire_check = now
         try:
-            expired = self.store.expire_stale_workers()
+            # Tenancy sweeps every job's store and reports GLOBAL ids;
+            # the single-job path is the primary store, ids untouched.
+            expired = self.store.expire_stale_workers() \
+                if self.jobs is None else self.jobs.expire_stale_workers()
         except Exception:  # noqa: BLE001 — expiry must not fail the RPC
             return
         if expired:
@@ -419,23 +569,57 @@ class ParameterService:
 
     # -- RPC bodies (request bytes -> reply bytes) --------------------------
 
-    def _membership_fields(self) -> dict:
+    def _job_of(self, meta: dict) -> str:
+        """Resolve the envelope's job id (docs/TENANCY.md). Tenancy off
+        means everything is the default job and the ``job`` key is never
+        read — the key is capability-gated on this server advertising
+        ``jobs`` at registration. Garbled ids degrade to the default
+        namespace, never fail the RPC (the health-report discipline)."""
+        if self.jobs is None:
+            return DEFAULT_JOB
+        return normalize_job_id(meta.get("job"))
+
+    def _route(self, meta: dict):
+        """``(job, store, local_worker_id)`` for an envelope: the job
+        from the ``job`` meta key (falling back to the global id's
+        stride for a capable peer whose ping omitted the label), the
+        store from the job table, and the LOCAL worker id from stripping
+        the per-job stride off the global id the wire carries
+        (ps/tenancy.WID_STRIDE). Tenancy off routes everything to the
+        primary store with ids untouched."""
+        wid = meta.get("worker_id")
+        wid = None if wid is None else int(wid)
+        if self.jobs is None:
+            return DEFAULT_JOB, self.store, wid
+        job = normalize_job_id(meta.get("job"))
+        if job == DEFAULT_JOB and wid is not None:
+            job = self.jobs.job_name_of(wid)
+        lwid = None if wid is None else wid % WID_STRIDE
+        return job, self.jobs.store_for(job), lwid
+
+    def _membership_fields(self, store=None) -> dict:
         """Live membership for elastic remote workers (round-2 VERDICT item
         3): the wire now carries what in-process workers read directly from
         the store, so remote workers reshard at epoch boundaries too — fixing
         across the process boundary what the reference's restart pollution
-        broke there (README.md:368-371)."""
-        if not getattr(self.store.config, "elastic", False):
+        broke there (README.md:368-371). ``store`` routes the view to a
+        job's own store under tenancy; membership is per-job (local ids:
+        the worker reshards its data among its OWN job's peers)."""
+        store = self.store if store is None else store
+        if not getattr(store.config, "elastic", False):
             return {}
-        return {"active_workers": self.store.membership_snapshot()}
+        return {"active_workers": store.membership_snapshot()}
 
-    def _qscale_fields(self, have_step: int | None = None) -> dict:
+    def _qscale_fields(self, have_step: int | None = None,
+                       store=None) -> dict:
         """Shared-scale table fields for a reply (docs/WIRE_PROTOCOL.md):
         the store's per-layer gradient absmax table + version, attached
         when the store publishes one AND the client's known version
         (``have_qscales``) is older. Stores without the capability (native
-        arena, device) contribute nothing."""
-        fn = getattr(self.store, "gradient_scales", None)
+        arena, device) contribute nothing. ``store`` routes to a job's
+        own table under tenancy (scales are per-job state)."""
+        store = self.store if store is None else store
+        fn = getattr(store, "gradient_scales", None)
         if not callable(fn):
             return {}
         try:
@@ -800,8 +984,18 @@ class ParameterService:
     def register_worker(self, request: bytes, ctx) -> bytes:
         meta, _ = unpack_msg(request)
         self._expire_tick()
-        worker_id, total = self.store.register_worker(
+        # Tenancy routing (docs/TENANCY.md): register into the job's own
+        # store (its own membership, config, params), then stride the
+        # local id so the cluster keeps ONE flat worker-id space. A
+        # legacy peer sends no ``job`` and lands in the default job,
+        # whose ids are the local ids — the pre-tenancy wire exactly.
+        job = self._job_of(meta)
+        store = self.store if self.jobs is None \
+            else self.jobs.store_for(job)
+        worker_id, total = store.register_worker(
             meta.get("worker_name", ""))
+        if self.jobs is not None:
+            worker_id = self.jobs.to_global(job, worker_id)
         # Directive capability is advertised by the WORKER (the directives
         # flow server->worker, so the server must know the peer can act on
         # them): legacy clients send no capabilities list and their
@@ -825,23 +1019,26 @@ class ParameterService:
             "total_workers": total,
             # Client needs the server's codecs/mode to compress correctly
             # (the store PROPERTY — the config field may hold the
-            # backend-default sentinel None).
-            "push_codec": self.store.push_codec,
-            "fetch_codec": getattr(self.store, "fetch_codec", "none"),
-            "mode": self.store.config.mode,
-            "learning_rate": self.store.config.learning_rate,
+            # backend-default sentinel None). Under tenancy these are the
+            # JOB store's fields: per-job aggregation config is exactly
+            # what the client must adopt (sync quorum for job A, async
+            # staleness for job B, same server).
+            "push_codec": store.push_codec,
+            "fetch_codec": getattr(store, "fetch_codec", "none"),
+            "mode": store.config.mode,
+            "learning_rate": store.config.learning_rate,
             # The async staleness bound, so a reconnecting client can make
             # the worker-side discard-or-repush call for its in-flight
             # gradient without a wasted round trip (docs/ROBUSTNESS.md).
-            "staleness_bound": int(getattr(self.store.config,
+            "staleness_bound": int(getattr(store.config,
                                            "staleness_bound", 5)),
-            "elastic": bool(getattr(self.store.config, "elastic", False)),
+            "elastic": bool(getattr(store.config, "elastic", False)),
             # Delta-fetch capability (docs/WIRE_PROTOCOL.md): clients may
             # send ``have_step`` on FetchParameters and must then handle a
             # NOT_MODIFIED reply. Advertised so old clients (which never
             # send have_step) and new clients against old servers (which
             # would ignore it) both keep working.
-            "delta_fetch": bool(getattr(self.store, "supports_delta_fetch",
+            "delta_fetch": bool(getattr(store, "supports_delta_fetch",
                                         False)),
             # Trace-context capability (docs/WIRE_PROTOCOL.md): clients may
             # attach a trace field to push frame headers / fetch meta and
@@ -863,7 +1060,7 @@ class ParameterService:
             # delta_fetch — legacy clients ignore the field and keep
             # pushing fp16/int8 with their own scales.
             "compressed_domain": bool(getattr(
-                self.store, "supports_compressed_domain", False)),
+                store, "supports_compressed_domain", False)),
             # Directive-channel capability (docs/ROBUSTNESS.md): this
             # server may attach control directives to fetch/push reply
             # meta. Clients that advertised the capability above attach
@@ -878,8 +1075,15 @@ class ParameterService:
             # trace_context (a server that never advertised would choke
             # on the 4 trailer bytes, so the client must gate on this).
             "checksum": True,
-            **self._qscale_fields(),
-            **self._membership_fields(),
+            # Tenancy capability (docs/TENANCY.md): advertised ONLY when
+            # a job table is attached, with the job the peer landed in
+            # echoed back (a capable client adopts it and labels every
+            # subsequent envelope). Single-job servers add neither key —
+            # the legacy reply stays byte-identical.
+            **({"jobs": True, "job": job} if self.jobs is not None
+               else {}),
+            **self._qscale_fields(store=store),
+            **self._membership_fields(store),
             # Shard-map capability (docs/SHARDING.md): present only when
             # this server runs as a shard primary. A capable client fans
             # pushes/fetches out per the map and refreshes it via
@@ -902,12 +1106,13 @@ class ParameterService:
         except Exception:  # noqa: BLE001
             pass
 
-    def _refuse_corrupt(self, wid, meta: dict) -> bytes:
+    def _refuse_corrupt(self, wid, meta: dict, store=None) -> bytes:
         """Refuse a push whose payload failed integrity verification
         (CRC trailer mismatch, or a frame the decoder rejects): counted
         (``dps_wire_corrupt_total``), surfaced to the health engine
         (``wire_corrupt`` rule), never applied — and never journaled, so
         the client's clean retry of the same token can still apply."""
+        store = self.store if store is None else store
         self._tm_wire_corrupt.inc()
         if self.monitor is not None:
             try:
@@ -917,18 +1122,47 @@ class ParameterService:
         print(f"WIRE_CORRUPT push refused worker={wid}", flush=True)
         return pack_msg({"received": False, "accepted": False,
                          "corrupt": True,
-                         "global_step": self.store.global_step,
+                         "global_step": store.global_step,
                          **self._directive_fields(wid, meta)})
 
     def push_gradrients(self, request: bytes, ctx) -> bytes:
         meta, payload = unpack_msg(request)
+        job, store, lwid = self._route(meta)
+        if self.qos is not None and not self.qos.admit(
+                job, self._admission_budget(ctx)):
+            if ctx is not None:
+                ctx.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                          f"job {job!r} throttled (weighted-fair "
+                          f"admission); retry with backoff")
+            raise TimeoutError(f"push throttled for job {job!r}")
+        try:
+            return self._push_body(meta, payload, ctx, job, store, lwid)
+        finally:
+            if self.qos is not None:
+                self.qos.release(job)
+
+    @staticmethod
+    def _admission_budget(ctx) -> float:
+        """QoS admission wait, bounded by the CALLER's remaining
+        deadline minus a reply margin (the DUP_WAIT_CAP_S lesson: a
+        server-side wait must never outlive the client's patience)."""
+        budget = ADMISSION_WAIT_CAP_S
+        if ctx is not None and callable(getattr(ctx, "time_remaining",
+                                                None)):
+            remaining = ctx.time_remaining()
+            if remaining is not None:
+                budget = max(0.0, min(budget, remaining - 1.0))
+        return budget
+
+    def _push_body(self, meta: dict, payload, ctx, job: str, store,
+                   lwid: int) -> bytes:
         wid = int(meta["worker_id"])
         # Integrity gate FIRST — before the dedupe lifecycle records
         # anything for this token. frame_checksum_ok is None (no
         # trailer: legacy peer, nothing to verify) or a verdict; only an
         # explicit False refuses.
         if len(payload) and frame_checksum_ok(payload) is False:
-            return self._refuse_corrupt(wid, meta)
+            return self._refuse_corrupt(wid, meta, store)
         self._ingest_health(wid, meta)
         self._expire_tick()
         health = meta.get("health")
@@ -948,6 +1182,12 @@ class ParameterService:
         entry = None
         if token is not None:
             nonce, count = parse_push_token(token)
+            # Job-scoped dedupe namespace (docs/TENANCY.md): the nonce is
+            # prefixed with the job, so IDENTICAL tokens under two jobs
+            # are distinct entries — no cross-job dedupe collision — and
+            # the journal filters per job at checkpoint time. The default
+            # job's nonces stay bare (pre-tenancy journals round-trip).
+            nonce = job_key(job, nonce)
             with self._push_seen_lock:
                 prev = self._push_seen.get(nonce)
                 if prev is not None and count <= prev[0]:
@@ -976,7 +1216,7 @@ class ParameterService:
                     return pack_msg({
                         "received": True, "accepted": False,
                         "duplicate": True, "stale_token": True,
-                        "global_step": self.store.global_step})
+                        "global_step": store.global_step})
                 # Retry of the push most recently seen from this client.
                 # If the original is still in flight, wait for its
                 # outcome — answering early with a fabricated
@@ -1008,7 +1248,7 @@ class ParameterService:
                 return pack_msg({
                     "received": True, "accepted": bool(dup[1]),
                     "duplicate": True,
-                    "global_step": self.store.global_step})
+                    "global_step": store.global_step})
         if blocked:
             # Quarantine refusal for a NEW push: acknowledge (the worker
             # must not die retrying) but never apply — a suspected-
@@ -1017,7 +1257,7 @@ class ParameterService:
             self._tm_quarantined.inc()
             return pack_msg({"received": True, "accepted": False,
                              "quarantined": True,
-                             "global_step": self.store.global_step,
+                             "global_step": store.global_step,
                              **self._directive_fields(wid, meta)})
         try:
             grads = decode_tensor_dict(payload)
@@ -1033,7 +1273,7 @@ class ParameterService:
                     if self._push_seen.get(nonce) is entry:
                         del self._push_seen[nonce]
                 entry[2].set()
-            return self._refuse_corrupt(wid, meta)
+            return self._refuse_corrupt(wid, meta, store)
         # Ownership filter (docs/SHARDING.md "Migration protocol"): keys
         # whose slot this primary no longer owns — the map moved while
         # the client pushed on a cached one, or the slot is mid-handoff
@@ -1050,7 +1290,7 @@ class ParameterService:
             shard_extra = {"disowned": disowned, **self._shard_fields()}
         accepted = False
         try:
-            accepted = self.store.push(wid, grads, int(meta["fetched_step"]))
+            accepted = store.push(lwid, grads, int(meta["fetched_step"]))
         finally:
             # On an exception the event still fires (outcome False) so a
             # waiting retry is never stranded until its timeout. The
@@ -1058,27 +1298,32 @@ class ParameterService:
             # LRU bound evicted mid-flight still wakes its waiters.
             if entry is not None:
                 entry[1] = accepted
-                entry[4] = self.store.global_step
+                entry[4] = store.global_step
                 entry[2].set()
         return pack_msg({"received": True, "accepted": accepted,
-                         "global_step": self.store.global_step,
+                         "global_step": store.global_step,
                          **shard_extra,
                          **self._directive_fields(wid, meta)})
 
     # -- durable push-token journal (docs/ROBUSTNESS.md) ---------------------
 
-    def journal_snapshot(self) -> list[dict]:
+    def journal_snapshot(self, job: str | None = None) -> list[dict]:
         """COMPLETED push-token outcomes, oldest first — the bounded
         journal a store snapshot persists (checkpoint/manager.py) so a
         restarted server still dedupes in-flight push retries from before
         the crash. In-flight entries are skipped: their outcome is
         unknown, and claiming one either way would be a lie the retry
-        acts on."""
+        acts on. ``job`` filters to one job's namespace (nonces carry
+        the job prefix, docs/TENANCY.md) so each job's checkpoint
+        lineage journals ONLY its own tokens — cross-job journal leakage
+        is structurally impossible."""
         with self._push_seen_lock:
             return [
                 {"nonce": nonce, "count": e[0], "accepted": bool(e[1]),
                  "worker_id": e[3], "step": e[4]}
-                for nonce, e in self._push_seen.items() if e[2].is_set()
+                for nonce, e in self._push_seen.items()
+                if e[2].is_set()
+                and (job is None or split_job_key(nonce)[0] == job)
             ]
 
     def load_journal(self, entries) -> int:
@@ -1110,9 +1355,25 @@ class ParameterService:
                 self._push_seen.popitem(last=False)
         return loaded
 
-    # dpslint: hot-path — every worker ping; NM replies serve a cached encode
     def fetch_parameters(self, request: bytes, ctx) -> bytes:
         meta, _ = unpack_msg(request)
+        job, store, lwid = self._route(meta)
+        if self.qos is not None and not self.qos.admit(
+                job, self._admission_budget(ctx)):
+            if ctx is not None:
+                ctx.abort(grpc.StatusCode.RESOURCE_EXHAUSTED,
+                          f"job {job!r} throttled (weighted-fair "
+                          f"admission); retry with backoff")
+            raise TimeoutError(f"fetch throttled for job {job!r}")
+        try:
+            return self._fetch_body(meta, job, store, lwid)
+        finally:
+            if self.qos is not None:
+                self.qos.release(job)
+
+    # dpslint: hot-path — every worker ping; NM replies serve a cached encode
+    def _fetch_body(self, meta: dict, job: str, store,
+                    lwid) -> bytes:
         wid = None if meta.get("worker_id") is None \
             else int(meta["worker_id"])
         # Heartbeat pings are fetches — the report rides the ping's
@@ -1125,28 +1386,30 @@ class ParameterService:
         # client's known version): new rounds move both the params and
         # the shared scales, so one fetch refreshes both. Legacy clients
         # never send have_qscales and never pay for a table they ignore.
-        qfields = self._qscale_fields(meta["have_qscales"]) \
+        qfields = self._qscale_fields(meta["have_qscales"], store=store) \
             if "have_qscales" in meta else {}
         dfields = self._directive_fields(wid, meta)
         sfields = self._shard_fields(meta["have_shard_map"]) \
             if "have_shard_map" in meta else {}
         if have is not None \
-                and getattr(self.store, "supports_delta_fetch", False):
-            params, step = self.store.fetch(wid, have_step=int(have))
+                and getattr(store, "supports_delta_fetch", False):
+            params, step = store.fetch(lwid, have_step=int(have))
             if not params and step == int(have):
                 # Version-gated delta fetch: the canonical step hasn't
                 # advanced past what the client holds — the reply costs a
                 # header instead of the full model (the straggler-wait /
                 # polling fetch win; docs/WIRE_PROTOCOL.md).
-                mfields = self._membership_fields()
+                mfields = self._membership_fields(store)
                 if qfields or dfields or sfields:
                     return pack_msg({"global_step": step,
                                      "not_modified": True, **qfields,
                                      **dfields, **sfields, **mfields})
                 # Attachment-free NM reply: serve the cached encode. The
                 # key folds in the membership view so an elastic join/
-                # leave at an unchanged step still invalidates.
-                key = (step, repr(mfields))
+                # leave at an unchanged step still invalidates — and the
+                # job, so two jobs idling at the same step never serve
+                # each other's cached header.
+                key = (job, step, repr(mfields))
                 with self._nm_lock:
                     if self._nm_cache is not None \
                             and self._nm_cache[0] == key:
@@ -1158,15 +1421,52 @@ class ParameterService:
                     self._nm_cache = (key, reply)
                 return reply
         else:
-            params, step = self.store.fetch(wid)
+            params, step = store.fetch(lwid)
         return pack_msg({"global_step": step, **qfields, **dfields,
-                         **sfields, **self._membership_fields()},
+                         **sfields, **self._membership_fields(store)},
                         encode_tensor_dict(params))
 
     def job_finished(self, request: bytes, ctx) -> bytes:
         meta, _ = unpack_msg(request)
-        self.store.job_finished(int(meta["worker_id"]))
+        _, store, lwid = self._route(meta)
+        store.job_finished(int(lwid))
         return pack_msg({"acknowledged": True})
+
+    def submit_job(self, request: bytes, ctx) -> bytes:
+        """Admin-plane job control (docs/TENANCY.md): submit a job from
+        a one-entry ``--jobs``-grammar spec (``job_spec`` meta key), or
+        drain one (``drain_job``). Requires tenancy to be enabled —
+        single-job servers answer FAILED_PRECONDITION, the Reshard-on-
+        a-replica discipline."""
+        meta, _ = unpack_msg(request)
+        if self.jobs is None:
+            if ctx is not None:
+                ctx.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          "submit_job: tenancy is not enabled on this "
+                          "server (start it with --jobs)")
+            raise ValueError("submit_job on a single-job server")
+        drain = meta.get("drain_job")
+        if drain is not None:
+            try:
+                drained = self.jobs.drain(str(drain))
+            except ValueError as e:
+                if ctx is not None:
+                    ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+                raise
+            return pack_msg({"drained": bool(drained),
+                             "jobs": self.jobs.names()})
+        try:
+            specs = parse_jobs_spec(str(meta.get("job_spec") or ""))
+            if len(specs) != 1:
+                raise ValueError(
+                    "job_spec must declare exactly one job")
+            state = self.jobs.submit(specs[0])
+        except ValueError as e:
+            if ctx is not None:
+                ctx.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            raise
+        return pack_msg({"submitted": state.name, "index": state.index,
+                         "jobs": self.jobs.names()})
 
     # -- wiring --------------------------------------------------------------
 
@@ -1228,6 +1528,9 @@ class ParameterService:
             # Admin plane (docs/SHARDING.md "Migration protocol"): only
             # primaries register it; replicas answer UNIMPLEMENTED.
             "Reshard": self.reshard,
+            # Admin plane (docs/TENANCY.md): job submit/drain; answers
+            # FAILED_PRECONDITION on single-job servers.
+            "SubmitJob": self.submit_job,
         }
         def wire(name, fn):
             # Fault injection sits INSIDE the instrumentation wrapper, so

@@ -127,8 +127,19 @@ class StoreConfig:
     # silently serving another shard's tensors.
     shard_index: int = 0
     shard_count: int = 1
+    # Tenancy (docs/TENANCY.md): which job's namespace this store IS.
+    # "default" is the pre-tenancy server (bare key names, legacy wire);
+    # non-default stores are built by ps/tenancy.JobManager from a job
+    # spec and carry the id into checkpoint meta (v4) so restore refuses
+    # cross-job, mirroring shard_index/shard_count above.
+    job_id: str = "default"
 
     def __post_init__(self):
+        from .tenancy import is_valid_job_id  # cold path; avoids cycle
+        if not is_valid_job_id(self.job_id):
+            raise ValueError(
+                f"job_id must match [A-Za-z0-9][A-Za-z0-9_-]* "
+                f"(<= 64 chars), got {self.job_id!r}")
         if self.mode not in ("sync", "async"):
             raise ValueError(f"mode must be sync|async, got {self.mode!r}")
         if not 1 <= self.total_workers <= MAX_WORKERS:

@@ -74,8 +74,9 @@ class _Slot:
     respawns: int = 0
     last_rc: int | None = None
     next_spawn_ts: float = 0.0    # backoff gate
-    done: bool = False            # exited 0 (or latched)
+    done: bool = False            # exited 0 (or latched/retired)
     latched: bool = False
+    retired: bool = False         # removed by a worker_shrink
 
 
 class WorkerSupervisor:
@@ -99,6 +100,10 @@ class WorkerSupervisor:
         self.log = log
         self._spawn_fn = spawn or self._default_spawn
         self.slots = [_Slot(index=i) for i in range(n_workers)]
+        # Next index for a grown slot — indices are never reused, so a
+        # grown worker's ``--worker-name sup-w{slot}`` never collides
+        # with a retired one's. guarded by: self._slots_lock
+        self._next_slot_index = n_workers
         self._stop = threading.Event()
         # Serializes supervision passes against stop(): stop() is called
         # from signal handlers / other threads, and snapshotting the
@@ -217,6 +222,66 @@ class WorkerSupervisor:
                          f"attempt={slot.attempt} "
                          f"after_rc={slot.last_rc}", flush=True)
 
+    # -- elastic slots (worker autoscaling) ------------------------------------
+
+    def add_slot(self) -> int:
+        """Grow by one slot: append a fresh slot and spawn it NOW, under
+        the slots lock — a grow landing mid-supervision-pass (or during
+        a respawn) either fully precedes or fully follows the pass, so
+        the new child can never miss stop()'s snapshot. Returns the new
+        slot index (never a reused one)."""
+        with self._slots_lock:
+            slot = _Slot(index=self._next_slot_index)
+            self._next_slot_index += 1
+            self.slots.append(slot)
+            self._spawn(slot)
+        self._tm_children.set(self.running_count())
+        self.log(f"SUPERVISOR_GROW slot={slot.index}", flush=True)
+        return slot.index
+
+    def remove_slot(self) -> int | None:
+        """Shrink by one: retire the YOUNGEST live slot (highest index
+        not yet done — the replica-pool discipline: the worker the job
+        has depended on for the shortest time). The slot stays in the
+        list marked done (its history keeps rendering in status); the
+        child gets SIGTERM then SIGKILL after the grace window. Returns
+        the retired index, or None when no slot is removable."""
+        with self._slots_lock:
+            live = [s for s in self.slots if not s.done]
+            if not live:
+                return None
+            slot = max(live, key=lambda s: s.index)
+            slot.done = True
+            slot.retired = True
+            proc, slot.proc = slot.proc, None
+        if proc is not None and proc.poll() is None:
+            try:
+                proc.terminate()
+            except OSError:
+                pass
+            try:
+                proc.wait(timeout=self.config.graceful_timeout)
+            except subprocess.TimeoutExpired:
+                try:
+                    proc.kill()
+                    proc.wait(timeout=5)
+                except (OSError, subprocess.TimeoutExpired):
+                    pass
+        self._tm_children.set(self.running_count())
+        self.log(f"SUPERVISOR_SHRINK slot={slot.index}", flush=True)
+        return slot.index
+
+    # WorkerAutoscaler actuator surface (telemetry/remediation.py) —
+    # the same verbs ReplicaPool exposes to the replica autoscaler.
+    def grow(self) -> int:
+        return self.add_slot()
+
+    def shrink(self) -> int | None:
+        return self.remove_slot()
+
+    def count(self) -> int:
+        return self.running_count()
+
     def run(self) -> int:
         """Supervise until every slot is done. Exit code: 0 when all
         slots finished cleanly, 1 when any latched as crash-looping or
@@ -231,8 +296,11 @@ class WorkerSupervisor:
             self.stop()
         # A slot only ends on a nonzero rc by latching (respawn on) or by
         # dying with respawn disabled — either way the run is degraded.
+        # Retired slots are a deliberate shrink, not a failure (their
+        # last_rc may be stale from a pre-retirement respawn).
         bad = [s for s in self.slots
-               if s.latched or (s.done and s.last_rc not in (0, None))]
+               if s.latched or (s.done and not s.retired
+                                and s.last_rc not in (0, None))]
         latched = [s.index for s in self.slots if s.latched]
         if latched:
             self.log(f"SUPERVISOR_EXIT latched_slots={latched}",
@@ -282,6 +350,7 @@ class WorkerSupervisor:
                 "last_rc": s.last_rc,
                 "latched": s.latched,
                 "done": s.done,
+                "retired": s.retired,
             } for s in self.slots],
             "running": self.running_count(),
         }

@@ -188,6 +188,16 @@ class ClusterMonitor:
         #: cluster_view() carries its state under "slo" (cli serve
         #: wires it unless --no-slo).
         self.slo = None
+        #: Optional JobManager (ps/tenancy.py); when set, membership and
+        #: last_seen come from the UNION of every job's store (global,
+        #: strided worker ids), worker rows carry a "job" column, and
+        #: cluster_view() serves the per-job block under "jobs" (cli
+        #: serve --jobs wires it).
+        self.jobs = None
+        #: Optional WorkerAutoscaler (telemetry/remediation.py); when
+        #: set, the background tick drives its control loop and
+        #: cluster_view() carries its state under "worker_autoscale".
+        self.worker_autoscaler = None
 
         reg = registry or get_registry()
         # Alert counters pre-created for every rule so a scrape shows the
@@ -262,11 +272,15 @@ class ClusterMonitor:
                 int(getattr(stats, "gradients_rejected", 0)))
 
     def _build_state(self, now: float) -> ClusterState:
+        # Tenancy: the JobManager unions every job store's membership /
+        # last_seen under GLOBAL strided worker ids, so one flat rule
+        # engine covers all jobs.
+        source = self.jobs if self.jobs is not None else self.store
         try:
-            membership = list(self.store.membership_snapshot())
+            membership = list(source.membership_snapshot())
         except Exception:  # noqa: BLE001 — any store backend, any failure
             membership = []
-        last_seen = dict(getattr(self.store, "last_seen", {}) or {})
+        last_seen = dict(getattr(source, "last_seen", {}) or {})
         cfg = getattr(self.store, "config", None)
         with self._lock:
             reports = dict(self._reports)
@@ -410,6 +424,8 @@ class ClusterMonitor:
             row: dict = {"worker": wid, "alive": ws.in_membership
                          and ("dead_worker", wid)
                          not in self.engine._active}
+            if self.jobs is not None:
+                row["job"] = self.jobs.job_name_of(wid)
             if ws.report:
                 row.update(ws.report)
                 row["report_age_s"] = round(max(0.0, now - ws.received_ts),
@@ -460,6 +476,16 @@ class ClusterMonitor:
                 out["slo"] = self.slo.view()
             except Exception:  # noqa: BLE001
                 pass
+        if self.jobs is not None:
+            try:
+                out["jobs"] = self.jobs.view()
+            except Exception:  # noqa: BLE001
+                pass
+        if self.worker_autoscaler is not None:
+            try:
+                out["worker_autoscale"] = self.worker_autoscaler.view()
+            except Exception:  # noqa: BLE001
+                pass
         return out
 
     # -- snapshot-stream record ---------------------------------------------
@@ -496,6 +522,11 @@ class ClusterMonitor:
                     self.autoscaler.tick()
                 except Exception:  # noqa: BLE001
                     pass  # scaling must never take the server down
+            if self.worker_autoscaler is not None:
+                try:
+                    self.worker_autoscaler.tick()
+                except Exception:  # noqa: BLE001
+                    pass
 
     def start(self) -> "ClusterMonitor":
         if self._thread is not None:

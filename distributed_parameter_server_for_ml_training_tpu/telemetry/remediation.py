@@ -52,6 +52,8 @@ __all__ = [
     "DEFAULT_POLICY_RULES",
     "RemediationEngine",
     "RemediationPolicy",
+    "WorkerAutoscalePolicy",
+    "WorkerAutoscaler",
     "note_action",
 ]
 
@@ -80,6 +82,14 @@ ACTION_CATALOG = {
     "replica_shrink": "retire the youngest read replica when fetch "
                       "load stays under the low-water mark and no "
                       "replica lags",
+    "worker_grow": "add one worker slot for a job whose admission "
+                   "queue depth / straggler pressure stays high — "
+                   "decided by the WorkerAutoscaler, executed by the "
+                   "WorkerSupervisor colocated with the workers "
+                   "(outcome `delegated` when recorded server-side)",
+    "worker_shrink": "retire a job's youngest worker slot once "
+                     "pressure stays under the low-water mark for the "
+                     "full sustain window",
 }
 
 #: Every outcome an action decision can record. Counters are pre-created
@@ -345,3 +355,167 @@ class RemediationEngine:
             except Exception:  # noqa: BLE001
                 pass
         return out
+
+
+@dataclass
+class WorkerAutoscalePolicy:
+    """Per-job worker-scaling knobs (docs/TENANCY.md "Scaling policy").
+
+    Same discipline as :class:`~.autoscale.AutoscalePolicy`, but the
+    signal is QUEUE PRESSURE, not QPS: admission queue depth is spiky
+    (one push storm fills it for a tick), so both directions require the
+    condition to hold for ``sustain_ticks`` CONSECUTIVE ticks before
+    acting — the hysteresis band plus the sustain window together keep a
+    job hovering near one threshold from flapping its worker fleet.
+    """
+
+    #: Grow when the job's admission queue depth (waiting RPCs) exceeds
+    #: this for ``sustain_ticks`` consecutive ticks — or when any of the
+    #: job's workers holds an active straggler alert.
+    depth_high: float = 4.0
+    #: Shrink when depth stays below this (and no straggler pressure)
+    #: for the full sustain window. Must sit under ``depth_high``.
+    depth_low: float = 1.0
+    #: Consecutive ticks a condition must hold before it acts.
+    sustain_ticks: int = 3
+    min_workers: int = 1
+    max_workers: int = 4
+    #: Minimum seconds between consecutive scaling actions.
+    cooldown_s: float = 15.0
+    #: Compute and record every decision; touch the supervisor never.
+    dry_run: bool = False
+
+    def __post_init__(self):
+        if self.depth_low >= self.depth_high:
+            raise ValueError(f"depth_low ({self.depth_low}) must be < "
+                             f"depth_high ({self.depth_high})")
+        if not 0 <= self.min_workers <= self.max_workers:
+            raise ValueError(f"need 0 <= min ({self.min_workers}) <= "
+                             f"max ({self.max_workers})")
+        if self.sustain_ticks < 1:
+            raise ValueError(f"sustain_ticks must be >= 1, "
+                             f"got {self.sustain_ticks}")
+
+
+class WorkerAutoscaler:
+    """Queue-pressure policy head scaling ONE job's worker count.
+
+    ``pressure_fn() -> dict`` supplies the signals (``queue_depth``,
+    ``stragglers``, and — when no actuator is attached — ``workers``);
+    ``cli supervise --autoscale-job`` builds one that polls the server's
+    ``GET /cluster`` jobs block, and tests inject a fake. The EXECUTE
+    half is ``supervisor.grow()/shrink()/count()``
+    (:class:`~..ps.supervisor.WorkerSupervisor` slot add/remove); with
+    ``supervisor=None`` the autoscaler is a server-side policy recorder
+    — decisions land with outcome ``delegated`` (the remediation
+    engine's respawn idiom: the process restart belongs to the
+    supervisor colocated with the workers).
+    """
+
+    def __init__(self, job: str, pressure_fn, supervisor=None,
+                 policy: WorkerAutoscalePolicy | None = None,
+                 registry=None, clock=time.time):
+        self.job = str(job)
+        self.pressure_fn = pressure_fn
+        self.supervisor = supervisor
+        self.policy = policy or WorkerAutoscalePolicy()
+        self.clock = clock
+        self._reg = registry or get_registry()
+        self._lock = threading.Lock()
+        # Consecutive ticks the grow/shrink condition held.
+        self._hot = 0    # guarded by: self._lock
+        self._cold = 0   # guarded by: self._lock
+        # -inf: the first action is never cooldown-held.
+        self._last_action_ts = float("-inf")  # guarded by: self._lock
+        self._events: deque = deque(maxlen=EVENTS_KEPT)  # guarded by: self._lock
+        self.actions = {"worker_grow": 0, "worker_shrink": 0}
+        self._tm_target = self._reg.gauge(
+            "dps_job_autoscale_target_workers", job=self.job)
+
+    def _live(self, signals: dict) -> int:
+        if self.supervisor is not None:
+            return int(self.supervisor.count())
+        return int(signals.get("workers") or 0)
+
+    def tick(self) -> dict | None:
+        """One control pass; returns the decision record when one was
+        made, None while pressure is in-band or still building its
+        sustain window. Never raises (monitor-loop hosted)."""
+        now = self.clock()
+        try:
+            signals = dict(self.pressure_fn() or {})
+        except Exception:  # noqa: BLE001 — a poll miss is not a crash
+            return None
+        depth = float(signals.get("queue_depth") or 0.0)
+        stragglers = int(signals.get("stragglers") or 0)
+        live = self._live(signals)
+        p = self.policy
+        with self._lock:
+            if depth > p.depth_high or stragglers > 0:
+                self._hot += 1
+                self._cold = 0
+            elif depth < p.depth_low:
+                self._cold += 1
+                self._hot = 0
+            else:
+                self._hot = self._cold = 0
+            hot, cold = self._hot, self._cold
+        action = None
+        if live < p.min_workers:
+            action = "worker_grow"  # floor breach: act NOW, no sustain
+        elif hot >= p.sustain_ticks and live < p.max_workers:
+            action = "worker_grow"
+        elif cold >= p.sustain_ticks and live > p.min_workers:
+            action = "worker_shrink"
+        if action is None:
+            self._tm_target.set(live)
+            return None
+        with self._lock:
+            if now - self._last_action_ts < p.cooldown_s:
+                outcome = "rate_limited"
+            elif p.dry_run:
+                outcome = "dry_run"
+            else:
+                self._last_action_ts = now
+                outcome = ("ok" if self.supervisor is not None
+                           else "delegated")
+                # An executed decision spends the sustain window; the
+                # pressure must rebuild before the next one.
+                self._hot = self._cold = 0
+        if outcome == "ok":
+            try:
+                if action == "worker_grow":
+                    self.supervisor.grow()
+                    live += 1
+                elif self.supervisor.shrink() is not None:
+                    live -= 1
+            except Exception:  # noqa: BLE001 — a failed spawn is an
+                outcome = "error"  # outcome, not a host-loop crash
+        self._tm_target.set(live)
+        note_action(action, outcome, registry=self._reg)
+        if outcome in ("ok", "delegated"):
+            self.actions[action] += 1
+        event = {"ts": round(now, 3), "job": self.job, "action": action,
+                 "outcome": outcome, "queue_depth": round(depth, 1),
+                 "stragglers": stragglers, "live": live}
+        with self._lock:
+            self._events.append(event)
+        print(f"WORKER_AUTOSCALE job={self.job} action={action} "
+              f"outcome={outcome} depth={depth:.1f} live={live}",
+              flush=True)
+        return event
+
+    def view(self) -> dict:
+        with self._lock:
+            events = list(self._events)
+            hot, cold = self._hot, self._cold
+        return {"job": self.job,
+                "min": self.policy.min_workers,
+                "max": self.policy.max_workers,
+                "depth_high": self.policy.depth_high,
+                "depth_low": self.policy.depth_low,
+                "sustain_ticks": self.policy.sustain_ticks,
+                "hot_ticks": hot, "cold_ticks": cold,
+                "dry_run": self.policy.dry_run,
+                "actions": dict(self.actions),
+                "events": events[-16:]}

@@ -299,3 +299,63 @@ def test_all_records_damaged_raises_with_evidence(tmp_path):
                     staleness_bound=100))
     with pytest.raises(FileNotFoundError, match="no valid store snapshot"):
         restore_store(fresh, str(tmp_path))
+
+
+def test_cross_job_restore_refused(tmp_path):
+    """Tenancy lineage (docs/TENANCY.md): a v4 snapshot names its job,
+    and restore refuses a different job's record exactly like the
+    cross-shard identity check — one tenant's model must never silently
+    replace another's."""
+    from distributed_parameter_server_for_ml_training_tpu.checkpoint import (
+        STORE_SNAPSHOT_VERSION, load_store_record)
+
+    def _store(job_id):
+        return ParameterStore(
+            {"w": np.ones(4, np.float32)},
+            StoreConfig(mode="async", total_workers=1, push_codec="none",
+                        job_id=job_id))
+
+    joba = _store("joba")
+    joba.push(0, {"w": np.full(4, 0.5, np.float32)}, 0)
+    save_store(joba, str(tmp_path))
+    _, meta = load_store_record(str(tmp_path))
+    assert meta["format_version"] == STORE_SNAPSHOT_VERSION
+    assert meta["job"] == "joba"
+
+    with pytest.raises(ValueError, match="cross-job"):
+        restore_store(_store("jobb"), str(tmp_path))
+    with pytest.raises(ValueError, match="cross-job"):
+        restore_store(_store("default"), str(tmp_path))
+    # The SAME job restores fine.
+    same = _store("joba")
+    assert restore_store(same, str(tmp_path)) == 1
+    np.testing.assert_array_equal(same.parameters["w"],
+                                  joba.parameters["w"])
+
+
+def test_pre_v4_record_counts_as_default_job(tmp_path):
+    """A pre-tenancy snapshot (no ``job`` key) restores into the default
+    job and ONLY the default job — forward compatibility without a
+    loophole."""
+    import json
+
+    default = ParameterStore(
+        {"w": np.ones(4, np.float32)},
+        StoreConfig(mode="async", total_workers=1, push_codec="none"))
+    save_store(default, str(tmp_path))
+    # Simulate a pre-v4 writer: strip the job key from the meta record.
+    meta_path = next(tmp_path.glob("*.json"))
+    meta = json.loads(meta_path.read_text())
+    del meta["job"]
+    meta_path.write_text(json.dumps(meta))
+
+    joba = ParameterStore(
+        {"w": np.zeros(4, np.float32)},
+        StoreConfig(mode="async", total_workers=1, push_codec="none",
+                    job_id="joba"))
+    with pytest.raises(ValueError, match="cross-job"):
+        restore_store(joba, str(tmp_path))
+    fresh = ParameterStore(
+        {"w": np.zeros(4, np.float32)},
+        StoreConfig(mode="async", total_workers=1, push_codec="none"))
+    assert restore_store(fresh, str(tmp_path)) == 0

@@ -1,0 +1,492 @@
+"""Multi-job tenancy tests (docs/TENANCY.md).
+
+Covers the namespace layer (job keys, worker-id stride, spec grammar),
+the JobManager lifecycle (submit/drain with metric-series teardown),
+the service routing contract — including THE isolation crucible:
+identical push tokens under two jobs both apply — the weighted-fair
+admission scheduler, the per-job worker autoscaler, and the
+supervisor's elastic slot surface (grow during an in-flight respawn
+must be safe; indices are never reused).
+"""
+
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from distributed_parameter_server_for_ml_training_tpu.comms import (
+    encode_tensor_dict)
+from distributed_parameter_server_for_ml_training_tpu.comms.service import (
+    ParameterService, WeightedFairAdmission, pack_msg, unpack_msg)
+from distributed_parameter_server_for_ml_training_tpu.ps import (
+    ParameterStore, StoreConfig)
+from distributed_parameter_server_for_ml_training_tpu.ps.tenancy import (
+    DEFAULT_JOB, WID_STRIDE, JobManager, JobSpec, job_key, job_slots,
+    normalize_job_id, parse_jobs_spec, split_job_key, split_wid)
+from distributed_parameter_server_for_ml_training_tpu.telemetry import (
+    get_registry)
+from distributed_parameter_server_for_ml_training_tpu.telemetry.remediation \
+    import WorkerAutoscalePolicy, WorkerAutoscaler
+
+
+def _primary(**kw):
+    cfg = dict(mode="async", total_workers=2, push_codec="none")
+    cfg.update(kw)
+    return ParameterStore({"layer/w": np.ones(4, np.float32)},
+                          StoreConfig(**cfg))
+
+
+def _push_request(wid, token, value, fetched_step=0, job=None):
+    meta = {"worker_id": wid, "fetched_step": fetched_step,
+            "push_token": token}
+    if job is not None:
+        meta["job"] = job
+    return pack_msg(meta,
+                    encode_tensor_dict(
+                        {"layer/w": np.full(4, value, np.float32)}))
+
+
+class TestNamespacePrimitives:
+    def test_job_key_roundtrip_with_slashes(self):
+        # Parameter names contain "/" — the separator must not collide.
+        k = job_key("joba", "conv/kernel:0")
+        assert k == "joba::conv/kernel:0"
+        assert split_job_key(k) == ("joba", "conv/kernel:0")
+
+    def test_default_job_keys_stay_bare(self):
+        assert job_key(DEFAULT_JOB, "w") == "w"
+        assert split_job_key("w") == (DEFAULT_JOB, "w")
+
+    def test_wid_stride(self):
+        assert split_wid(0) == (0, 0)
+        assert split_wid(WID_STRIDE + 3) == (1, 3)
+        assert split_wid(2 * WID_STRIDE) == (2, 0)
+
+    def test_normalize_garbled_ids_degrade_to_default(self):
+        assert normalize_job_id(None) == DEFAULT_JOB
+        assert normalize_job_id("") == DEFAULT_JOB
+        assert normalize_job_id("no spaces!") == DEFAULT_JOB
+        assert normalize_job_id("joba") == "joba"
+
+    def test_job_slots_compose_with_shard_math(self):
+        # A job is a SET OF SLOTS in the same consistent-hash space
+        # shards partition — the namespaced key moves the slot, so two
+        # jobs' copies of one parameter land on (generally) different
+        # slots, and the math stays ps/sharding.key_slot.
+        names = [f"layer{i}/w" for i in range(16)]
+        a = job_slots("joba", names)
+        b = job_slots("jobb", names)
+        assert a and all(isinstance(s, int) for s in a)
+        assert a == job_slots("joba", names)  # deterministic
+        assert a != b  # distinct namespaces hash apart
+
+
+class TestSpecGrammar:
+    def test_parse_full_spec(self):
+        specs = parse_jobs_spec(
+            "joba:weight=2,mode=sync,sync_quorum=2;"
+            "jobb:mode=async,staleness_bound=4,max_inflight=3")
+        assert [s.name for s in specs] == ["joba", "jobb"]
+        a, b = specs
+        assert a.weight == 2.0 and a.mode == "sync" and a.sync_quorum == 2
+        assert b.staleness_bound == 4 and b.max_inflight == 3
+
+    def test_bare_name_gets_defaults(self):
+        (s,) = parse_jobs_spec("solo")
+        assert s.weight == 1.0 and s.max_inflight == 8
+        assert s.min_workers == 1 and s.max_workers == 4
+
+    @pytest.mark.parametrize("bad", [
+        "default",                 # reserved
+        "joba;joba",               # duplicate
+        "joba:nosuchfield=1",      # unknown field
+        "has space:weight=1",      # invalid name
+        "joba:weight=0",           # weight must be > 0
+        "joba:max_inflight=0",     # cap must be >= 1
+        "joba:min_workers=5,max_workers=2",  # floor above ceiling
+        "joba:mode=mixed",         # unknown mode
+    ])
+    def test_bad_specs_rejected(self, bad):
+        with pytest.raises(ValueError):
+            parse_jobs_spec(bad)
+
+
+class TestJobManager:
+    def test_default_wraps_primary(self):
+        primary = _primary()
+        jobs = JobManager(primary)
+        assert jobs.names() == [DEFAULT_JOB]
+        assert jobs.store_for(DEFAULT_JOB) is primary
+
+    def test_submit_inherits_primary_params_with_overrides(self):
+        primary = _primary(mode="async")
+        jobs = JobManager(primary)
+        jobs.submit(JobSpec("joba", mode="sync", sync_quorum=1,
+                            total_workers=1))
+        store = jobs.store_for("joba")
+        assert store is not primary
+        assert store.config.mode == "sync"
+        assert store.config.job_id == "joba"
+        np.testing.assert_array_equal(store.parameters["layer/w"],
+                                      primary.parameters["layer/w"])
+
+    def test_drain_removes_job_and_metric_series(self):
+        reg = get_registry()
+        jobs = JobManager(_primary())
+        jobs.submit(JobSpec("gone"))
+        reg.gauge("dps_job_queue_depth", job="gone").set(2.0)
+        reg.counter("dps_job_throttled_total", job="gone").inc()
+        assert jobs.drain("gone") is True
+        assert "gone" not in jobs.names()
+        # Drained series must disappear, not freeze (the replica-lag
+        # lifecycle rule): re-creating reads back at zero.
+        assert reg.gauge("dps_job_queue_depth", job="gone").value == 0.0
+        assert reg.counter("dps_job_throttled_total",
+                           job="gone").value == 0.0
+        reg.remove("dps_job_queue_depth", job="gone")
+        reg.remove("dps_job_throttled_total", job="gone")
+
+    def test_drain_default_refused_and_index_never_reused(self):
+        jobs = JobManager(_primary())
+        first = jobs.submit(JobSpec("a"))
+        with pytest.raises(ValueError):
+            jobs.drain(DEFAULT_JOB)
+        jobs.drain("a")
+        second = jobs.submit(JobSpec("b"))
+        # A newcomer must never inherit a drained job's wid range.
+        assert second.index > first.index
+
+    def test_global_wid_mapping(self):
+        jobs = JobManager(_primary(), [JobSpec("joba", total_workers=1)])
+        g = jobs.to_global("joba", 2)
+        assert g == WID_STRIDE + 2
+        assert jobs.job_name_of(g) == "joba"
+        assert jobs.job_name_of(5) == DEFAULT_JOB
+
+
+class TestServiceRouting:
+    def _rig(self, specs="joba:mode=async;jobb:mode=async"):
+        primary = _primary()
+        jobs = JobManager(primary, parse_jobs_spec(specs))
+        svc = ParameterService(primary, jobs=jobs)
+        return primary, jobs, svc
+
+    def _register(self, svc, job=None, caps=()):
+        meta = {"capabilities": list(caps)}
+        if job is not None:
+            meta["job"] = job
+        reply, _ = unpack_msg(svc.register_worker(pack_msg(meta), None))
+        return reply
+
+    def test_legacy_register_lands_in_default(self):
+        _, _, svc = self._rig()
+        reply = self._register(svc)
+        assert reply["worker_id"] == 0
+        assert reply["jobs"] is True and reply["job"] == DEFAULT_JOB
+
+    def test_job_register_strides_and_adopts_job_config(self):
+        _, jobs, svc = self._rig("joba:mode=sync,sync_quorum=1,"
+                                 "total_workers=1")
+        reply = self._register(svc, job="joba")
+        idx = jobs.names().index("joba")
+        assert reply["worker_id"] == idx * WID_STRIDE
+        assert reply["mode"] == "sync"
+
+    def test_identical_push_tokens_under_two_jobs_both_apply(self):
+        """THE tenancy dedupe contract: the dedupe journal is per-job,
+        so two tenants' clients using the same nonce both land — and a
+        per-job journal snapshot sees only its own entry."""
+        _, jobs, svc = self._rig()
+        wa = self._register(svc, job="joba")["worker_id"]
+        wb = self._register(svc, job="jobb")["worker_id"]
+        sa, sb = jobs.store_for("joba"), jobs.store_for("jobb")
+
+        ma, _ = unpack_msg(svc.push_gradrients(
+            _push_request(wa, "n:1", 0.5, job="joba"), None))
+        mb, _ = unpack_msg(svc.push_gradrients(
+            _push_request(wb, "n:1", 0.25, job="jobb"), None))
+        assert ma["accepted"] and not ma.get("duplicate")
+        assert mb["accepted"] and not mb.get("duplicate")
+        assert sa.global_step == 1 and sb.global_step == 1
+        # The two applied DIFFERENT gradients to DIFFERENT stores.
+        assert not np.array_equal(sa.parameters["layer/w"],
+                                  sb.parameters["layer/w"])
+        # A retry under the SAME job still dedupes (replays, no apply).
+        mr, _ = unpack_msg(svc.push_gradrients(
+            _push_request(wa, "n:1", 0.5, job="joba"), None))
+        assert mr.get("duplicate") is True and sa.global_step == 1
+        # Per-job journal filter: one entry each, zero leakage.
+        ja = svc.journal_snapshot(job="joba")
+        jb = svc.journal_snapshot(job="jobb")
+        assert len(ja) == 1 and len(jb) == 1
+        assert ja[0]["nonce"] != jb[0]["nonce"]
+        assert split_job_key(ja[0]["nonce"])[0] == "joba"
+
+    def test_fetch_routes_to_the_jobs_store(self):
+        _, jobs, svc = self._rig()
+        wa = self._register(svc, job="joba")["worker_id"]
+        svc.push_gradrients(_push_request(wa, "f:1", 0.5, job="joba"),
+                            None)
+        ma, pa = unpack_msg(svc.fetch_parameters(
+            pack_msg({"worker_id": wa, "job": "joba"}), None))
+        mdef, pdef = unpack_msg(svc.fetch_parameters(pack_msg({}), None))
+        assert ma["global_step"] == 1 and mdef["global_step"] == 0
+        assert bytes(pa) != bytes(pdef)
+
+    def test_submit_and_drain_over_the_admin_op(self):
+        _, jobs, svc = self._rig()
+        reply, _ = unpack_msg(svc.submit_job(
+            pack_msg({"job_spec": "jobc:weight=2"}), None))
+        assert reply["submitted"] == "jobc" and "jobc" in reply["jobs"]
+        assert "jobc" in jobs.names()
+        reply, _ = unpack_msg(svc.submit_job(
+            pack_msg({"drain_job": "jobc"}), None))
+        assert reply["drained"] is True and "jobc" not in reply["jobs"]
+
+
+class TestWeightedFairAdmission:
+    def _jobs(self, spec):
+        return JobManager(_primary(), parse_jobs_spec(spec))
+
+    def test_fair_share_follows_weights(self):
+        qos = WeightedFairAdmission(
+            self._jobs("joba:weight=1;jobb:weight=3"), capacity=15)
+        # weights: default 1, joba 1, jobb 3 -> total 5
+        assert qos._limits("joba") == (3, 8)
+        assert qos._limits("jobb") == (9, 8)
+        assert qos._limits(DEFAULT_JOB) == (3, 8)
+
+    def test_max_inflight_caps_even_with_free_capacity(self):
+        qos = WeightedFairAdmission(
+            self._jobs("joba:max_inflight=2,weight=100"), capacity=16)
+        assert qos.admit("joba", 0.0) and qos.admit("joba", 0.0)
+        assert qos.admit("joba", 0.0) is False  # hard cap, counted
+        assert get_registry().counter("dps_job_throttled_total",
+                                      job="joba").value >= 1
+        qos.release("joba")
+        assert qos.admit("joba", 0.0)  # slot freed -> admitted again
+        qos.release("joba")
+        qos.release("joba")
+
+    def test_contention_throttles_to_fair_share_then_recovers(self):
+        qos = WeightedFairAdmission(
+            self._jobs("joba:weight=1;jobb:weight=1"), capacity=2)
+        # Fill the shared capacity from joba (fair share 1, but idle
+        # capacity is borrowable up to the cap).
+        assert qos.admit("joba", 0.0)
+        assert qos.admit("jobb", 0.0)
+        # Capacity full AND joba at its fair share: throttled...
+        assert qos.admit("joba", 0.0) is False
+        # ...and a waiter is admitted the moment a slot frees.
+        got = []
+        t = threading.Thread(
+            target=lambda: got.append(qos.admit("joba", 5.0)),
+            daemon=True)
+        t.start()
+        time.sleep(0.1)
+        qos.release("jobb")
+        t.join(timeout=5)
+        assert got == [True]
+
+    def test_throttled_push_aborts_resource_exhausted(self):
+        import grpc
+
+        primary = _primary()
+        jobs = JobManager(primary,
+                          parse_jobs_spec("joba:max_inflight=1"))
+        svc = ParameterService(primary, jobs=jobs)
+        reply, _ = unpack_msg(svc.register_worker(
+            pack_msg({"job": "joba"}), None))
+        wid = reply["worker_id"]
+        # Occupy joba's only admission slot out-of-band.
+        assert svc.qos.admit("joba", 0.0)
+
+        class Ctx:
+            aborted = None
+
+            def time_remaining(self):
+                return 1.2  # budget after margin: ~0.2 s
+
+            def abort(self, code, detail):
+                self.aborted = (code, detail)
+                raise grpc.RpcError(detail)
+
+        ctx = Ctx()
+        with pytest.raises(grpc.RpcError):
+            svc.push_gradrients(
+                _push_request(wid, "t:1", 0.5, job="joba"), ctx)
+        assert ctx.aborted[0] == grpc.StatusCode.RESOURCE_EXHAUSTED
+        svc.qos.release("joba")
+        # With the slot free the same push sails through.
+        m, _ = unpack_msg(svc.push_gradrients(
+            _push_request(wid, "t:1", 0.5, job="joba"), None))
+        assert m["accepted"]
+
+
+class _FakeSup:
+    def __init__(self, n=1):
+        self.n = n
+
+    def grow(self):
+        self.n += 1
+        return self.n
+
+    def shrink(self):
+        self.n -= 1
+        return self.n
+
+    def count(self):
+        return self.n
+
+
+class TestWorkerAutoscaler:
+    def _scaler(self, sup, depth, **policy_kw):
+        clock = [1000.0]
+        policy = dict(sustain_ticks=2, cooldown_s=10.0,
+                      min_workers=1, max_workers=3)
+        policy.update(policy_kw)
+        scaler = WorkerAutoscaler(
+            "jobb", lambda: {"queue_depth": depth[0],
+                             "stragglers": depth[1]},
+            supervisor=sup, policy=WorkerAutoscalePolicy(**policy),
+            clock=lambda: clock[0])
+        return scaler, clock
+
+    def test_grow_needs_sustained_pressure_then_cooldown_gates(self):
+        sup = _FakeSup(1)
+        depth = [10.0, 0]
+        scaler, clock = self._scaler(sup, depth)
+        assert scaler.tick() is None          # hot tick 1 of 2
+        ev = scaler.tick()                    # sustained -> grow
+        assert ev["action"] == "worker_grow" and ev["outcome"] == "ok"
+        assert sup.count() == 2
+        clock[0] += 1.0                       # inside cooldown
+        scaler.tick()
+        ev = scaler.tick()
+        assert ev["outcome"] == "rate_limited" and sup.count() == 2
+        clock[0] += 20.0                      # cooldown over
+        # rate_limited never spent the streak, so the pressure is still
+        # sustained: the next tick acts.
+        ev = scaler.tick()
+        assert ev["outcome"] == "ok" and sup.count() == 3
+        assert scaler.tick() is None          # executed -> streak spent
+
+    def test_shrink_on_sustained_idle_respects_floor(self):
+        sup = _FakeSup(2)
+        depth = [0.0, 0]
+        scaler, clock = self._scaler(sup, depth)
+        scaler.tick()
+        ev = scaler.tick()
+        assert ev["action"] == "worker_shrink" and sup.count() == 1
+        clock[0] += 20.0
+        scaler.tick()
+        assert scaler.tick() is None          # at min_workers: hold
+        assert sup.count() == 1
+
+    def test_straggler_pressure_counts_as_hot(self):
+        sup = _FakeSup(1)
+        depth = [0.0, 2]                      # idle queue, live stragglers
+        scaler, clock = self._scaler(sup, depth)
+        scaler.tick()
+        ev = scaler.tick()
+        assert ev["action"] == "worker_grow" and sup.count() == 2
+
+    def test_floor_breach_grows_without_sustain(self):
+        sup = _FakeSup(0)
+        scaler, _ = self._scaler(sup, [0.0, 0], min_workers=1)
+        ev = scaler.tick()                    # first tick, no sustain
+        assert ev["action"] == "worker_grow" and sup.count() == 1
+
+    def test_no_supervisor_records_delegated(self):
+        depth = [10.0, 0]
+        clock = [0.0]
+        scaler = WorkerAutoscaler(
+            "jobb", lambda: {"queue_depth": depth[0], "workers": 1},
+            policy=WorkerAutoscalePolicy(sustain_ticks=1),
+            clock=lambda: clock[0])
+        ev = scaler.tick()
+        assert ev["action"] == "worker_grow"
+        assert ev["outcome"] == "delegated"
+
+
+class TestSupervisorElasticSlots:
+    def _config(self, **kw):
+        from distributed_parameter_server_for_ml_training_tpu.ps. \
+            supervisor import SupervisorConfig
+        defaults = dict(backoff_initial=0.05, backoff_max=0.2,
+                        healthy_after=0.01, poll_interval=0.02,
+                        graceful_timeout=2.0)
+        defaults.update(kw)
+        return SupervisorConfig(**defaults)
+
+    def test_grow_then_retire_all_exits_clean(self):
+        from distributed_parameter_server_for_ml_training_tpu.ps. \
+            supervisor import WorkerSupervisor
+
+        def argv_for(slot, attempt):
+            return [sys.executable, "-c",
+                    "import time; time.sleep(30)"], None
+
+        sup = WorkerSupervisor(argv_for, 1, self._config())
+        sup.start()
+        runner = threading.Thread(target=lambda: setattr(
+            sup, "_test_rc", sup.run()), daemon=True)
+        runner.start()
+        assert sup.add_slot() == 1
+        deadline = time.time() + 5
+        while sup.running_count() < 2 and time.time() < deadline:
+            time.sleep(0.02)
+        assert sup.running_count() == 2
+        # Retire youngest-first until the fleet is empty.
+        assert sup.remove_slot() == 1
+        assert sup.remove_slot() == 0
+        assert sup.remove_slot() is None
+        runner.join(timeout=10)
+        assert not runner.is_alive()
+        # SIGTERM'd retirees must not read as bad exits.
+        assert sup._test_rc == 0
+        rows = sup.status()["slots"]
+        assert [r["slot"] for r in rows] == [0, 1]
+        assert all(r["retired"] for r in rows)
+
+    def test_grow_during_respawn_never_collides(self):
+        """Regression: a slot added WHILE another slot is mid-respawn
+        (crashed, inside its backoff window) must take a fresh index —
+        the supervision pass and the grow serialize on the slots lock,
+        and `--worker-name sup-w{slot}` stays unique."""
+        from distributed_parameter_server_for_ml_training_tpu.ps. \
+            supervisor import WorkerSupervisor
+
+        spawned = []
+        lock = threading.Lock()
+
+        def argv_for(slot, attempt):
+            with lock:
+                spawned.append((slot, attempt))
+            # slot 0 crashes once then finishes; grown slots finish fast
+            code = ("import sys; sys.exit(1)"
+                    if slot == 0 and attempt == 0
+                    else "import sys; sys.exit(0)")
+            return [sys.executable, "-c", code], None
+
+        sup = WorkerSupervisor(
+            argv_for, 1, self._config(backoff_initial=0.3,
+                                      crash_loop_after=5))
+        sup.start()
+        runner = threading.Thread(target=lambda: setattr(
+            sup, "_test_rc", sup.run()), daemon=True)
+        runner.start()
+        # Slot 0's first child exits 1 almost immediately; grow while
+        # its respawn backoff is pending.
+        time.sleep(0.1)
+        new_index = sup.add_slot()
+        assert new_index == 1
+        runner.join(timeout=15)
+        assert not runner.is_alive()
+        assert sup._test_rc == 0
+        slots_spawned = [s for s, _ in spawned]
+        assert slots_spawned.count(1) == 1       # grown slot: one spawn
+        assert slots_spawned.count(0) == 2       # original: spawn+respawn
+        assert sup._next_slot_index == 2

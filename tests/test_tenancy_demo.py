@@ -1,0 +1,45 @@
+"""Tenancy demo wrapper (slow — outside tier-1 by design).
+
+The full recorded two-job soak — job B holding EXACT accuracy parity
+with a solo control while job A takes a push storm, a NaN poison, and a
+SIGKILLed worker next door; the worker autoscaler growing job B's
+supervisor slots under admission-queue pressure and shrinking them back;
+and the per-job checkpoint lineages byte-verified for zero cross-job
+token leakage — lives in ``experiments/run_tenancy_demo.py``; this runs
+it end-to-end (``--quick``) into a temp dir and asserts the recorded
+verdicts. Fast, in-process coverage of the same machinery is in
+``tests/test_tenancy.py`` (tier-1).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.slow
+def test_tenancy_demo_quick(tmp_path):
+    proc = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "experiments", "run_tenancy_demo.py"),
+         "--quick", "--out-dir", str(tmp_path)],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        cwd=REPO, capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, proc.stdout[-4000:] + proc.stderr[-4000:]
+    with open(tmp_path / "tenancy_demo.json") as f:
+        summary = json.load(f)
+    assert summary["ok"], summary["checks"]
+    checks = {c["name"]: c["ok"] for c in summary["checks"]}
+    # the headline properties, named explicitly
+    assert checks["B.accuracy_parity_exact"]
+    assert checks["A.nan_poison_landed_in_joba"]
+    assert checks["B.params_finite_after_neighbor_nan"]
+    assert checks["A.killed_worker_expired"]
+    assert checks["autoscale.grew"]
+    assert checks["autoscale.shrank"]
+    assert checks["autoscale.grown_workers_in_cluster_view"]
+    assert checks["leakage.zero_cross_job_bytes"]

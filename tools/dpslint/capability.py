@@ -122,6 +122,19 @@ META_KEY_CATALOG: dict[str, tuple[str, ...]] = {
     "quality": ("canary",),
     "arm": ("canary",),
     "serving_step": ("canary",),
+    # -- multi-job tenancy (docs/TENANCY.md) ----------------------------
+    # A request's job id is only routed when the server actually runs a
+    # JobManager; a job-less server treats every envelope as the default
+    # job, so reads must sit behind the jobs handle.
+    "job": ("jobs",),
+    # SubmitJob admin op payload / drain marker: same gate — only a
+    # tenancy-enabled primary serves the job admin plane.
+    "job_spec": ("jobs",),
+    "drain_job": ("jobs",),
+    # Register-reply echo: the server advertises tenancy support (and
+    # the adopted job name) so legacy clients keep ignoring it — an
+    # ungated core field like the other negotiation echoes.
+    "jobs": (),
 }
 
 #: Variable names treated as envelope-meta receivers in comms/.

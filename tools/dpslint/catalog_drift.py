@@ -311,6 +311,12 @@ def check_op_classes(ctx: DriftContext) -> list[Finding]:
                         "#### Op classes", "profiler op class")
 
 
+def check_job_spec_fields(ctx: DriftContext) -> list[Finding]:
+    return _table_check(ctx, "job-spec-field", f"{_PKG}/ps/tenancy.py",
+                        "JOB_SPEC_FIELDS", "docs/TENANCY.md",
+                        "### Job spec fields", "job spec field")
+
+
 def check_meta_keys(ctx: DriftContext) -> list[Finding]:
     """META_KEY_CATALOG pinned to docs/WIRE_PROTOCOL.md's envelope-meta
     table — a wire field cannot be cataloged without being documented,
@@ -340,6 +346,7 @@ CHECKS = {
     "sharding-metric-families": check_sharding_metric_families,
     "lint-rules": check_lint_rules,
     "op-classes": check_op_classes,
+    "job-spec-fields": check_job_spec_fields,
     "meta-keys": check_meta_keys,
 }
 
