@@ -217,6 +217,54 @@ def fetch_qps_probe(duration_s: float = 1.0, concurrency: int = 2):
         return None
 
 
+def fleet_probe(ticks: int = 3) -> dict:
+    """Fleet-observatory companion fields (ISSUE 16): what one collector
+    tick costs against an in-process target — ``fleet_targets_scraped``
+    (fresh targets in the last tick), ``fleet_scrape_ms`` (last tick's
+    wall), ``fleet_series_count`` (ring series held after the ticks).
+    A tiny self-scrape, not a fleet: the real multi-process numbers live
+    in experiments/results/fleet/. Failure-hardened nulls like the
+    fetch/lint probes — never a cost to the throughput record."""
+    out = {"fleet_targets_scraped": None, "fleet_scrape_ms": None,
+           "fleet_series_count": None}
+    try:
+        from distributed_parameter_server_for_ml_training_tpu.telemetry \
+            .fleet import FleetCollector
+        from distributed_parameter_server_for_ml_training_tpu.telemetry \
+            .prometheus import start_metrics_server
+        from distributed_parameter_server_for_ml_training_tpu.telemetry \
+            .registry import LATENCY_BUCKETS, MetricsRegistry
+
+        target_reg = MetricsRegistry()
+        target_reg.counter("bench_fleet_probe_total").inc(7)
+        h = target_reg.histogram("bench_fleet_probe_seconds",
+                                 buckets=LATENCY_BUCKETS)
+        for v in (0.001, 0.004, 0.02):
+            h.observe(v)
+        server, port = start_metrics_server(target_reg, port=0,
+                                            addr="localhost")
+        try:
+            collector = FleetCollector([f"localhost:{port}"],
+                                       interval_s=0.05, timeout_s=2.0,
+                                       registry=MetricsRegistry())
+            last = {}
+            for _ in range(ticks):
+                last = collector.tick()
+            view = collector.view()
+            out = {
+                "fleet_targets_scraped":
+                    view["scrape"]["targets_scraped"],
+                "fleet_scrape_ms": last.get("scrape_ms"),
+                "fleet_series_count": view["series_count"],
+            }
+        finally:
+            server.shutdown()
+    except Exception as e:  # noqa: BLE001 — probe is best-effort
+        print(f"fleet probe failed (recording nulls): {e}",
+              file=sys.stderr)
+    return out
+
+
 def lint_probe() -> dict:
     """Static-analysis companion fields: ``lint_clean`` (did the tree
     pass dpslint — live findings or a stale baseline mean False) and
@@ -466,6 +514,16 @@ def run_bench(args) -> dict:
         if not getattr(args, "no_codec_probe", False):
             codec_fields = codec_probe(devices)
 
+        # Fleet-observatory attribution (ISSUE 16): what one collector
+        # scrape tick costs against an in-process target, so BENCH_r*
+        # rounds can watch the observer's own overhead.
+        stage = "fleet_probe"
+        fleet_fields = {"fleet_targets_scraped": None,
+                        "fleet_scrape_ms": None,
+                        "fleet_series_count": None}
+        if not getattr(args, "no_fleet_probe", False):
+            fleet_fields = fleet_probe()
+
         result = {
             "metric": "cifar100_resnet18_train_images_per_sec_per_chip",
             "value": round(per_chip, 1),
@@ -514,6 +572,8 @@ def run_bench(args) -> dict:
             "profile_attribution_basis": attribution_basis,
             # Device-codec attribution (ISSUE 14): see codec_probe.
             **codec_fields,
+            # Fleet-observatory attribution (ISSUE 16): see fleet_probe.
+            **fleet_fields,
         }
         # Static-analysis attribution (ISSUE 10 satellite): whether the
         # tree this number was measured from passed dpslint, and what the
@@ -558,6 +618,9 @@ def main() -> int:
                              "recorded as null)")
     parser.add_argument("--no-codec-probe", action="store_true",
                         help="skip the device-codec probe (codec_* "
+                             "fields recorded as null)")
+    parser.add_argument("--no-fleet-probe", action="store_true",
+                        help="skip the fleet-collector probe (fleet_* "
                              "fields recorded as null)")
     parser.add_argument("--profile-dir", default=None,
                         help="capture a jax.profiler trace of the timed "

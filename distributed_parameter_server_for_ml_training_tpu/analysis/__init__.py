@@ -19,6 +19,7 @@ from .device_profile import (
     load_chrome_trace,
     render_profile_table,
 )
+from .fleet_series import extract_exemplars, resolve_exemplars
 from .runner import run_cell, run_matrix
 from .traces import (
     PHASES,
@@ -37,7 +38,9 @@ __all__ = ["OP_CLASSES", "PHASES",
            "build_telemetry_timeseries", "classify_op",
            "cluster_worker_series",
            "critical_path_report", "device_time_tables",
+           "extract_exemplars",
            "find_trace_dumps", "load_chrome_trace", "load_trace_dumps",
+           "resolve_exemplars",
            "parse_cluster_series",
            "parse_experiment", "parse_snapshot_series",
            "render_profile_table",

@@ -587,6 +587,12 @@ def build_parser() -> argparse.ArgumentParser:
     r.add_argument("--advertise", default=None,
                    help="address to announce to the primary (defaults to "
                         "localhost:<bound port>)")
+    r.add_argument("--metrics-advertise", default=None,
+                   help="metrics endpoint address to announce alongside "
+                        "it (defaults to localhost:<bound --metrics-port> "
+                        "when one is serving) — published in the "
+                        "primary's /cluster view so `cli observe` "
+                        "discovers this replica as a scrape target")
     r.add_argument("--poll-interval", type=float,
                    default=_env("DPS_REPLICA_POLL", 0.05, float),
                    help="seconds between delta-fetch refreshes against "
@@ -728,6 +734,64 @@ def build_parser() -> argparse.ArgumentParser:
                          "(0 = one shot)")
     st.add_argument("--json", action="store_true",
                     help="print the raw /cluster JSON instead of the table")
+    st.add_argument("--via-fleet", default=None, metavar="URL",
+                    help="render the dashboard from a fleet collector's "
+                         "GET /fleet snapshot (cli observe) instead of "
+                         "one primary's /cluster — the first primary's "
+                         "cluster blocks plus fleet-scope SLO/alerts; "
+                         "blocks the fleet view lacks degrade exactly "
+                         "like a server without them")
+
+    ob = sub.add_parser(
+        "observe",
+        help="fleet observatory collector (docs/OBSERVABILITY.md "
+             "\"Fleet observatory\"): scrape every fleet process's "
+             "/metrics + /cluster on an interval into a bounded ring "
+             "TSDB, roll them up (bucket-exact histogram merges), and "
+             "serve GET /fleet — a standalone process, off every hot "
+             "path, that survives primary restarts")
+    ob.add_argument("--targets", required=True,
+                    help="comma list of metrics endpoints (host:port) to "
+                         "seed the scrape set; replicas announcing a "
+                         "metrics address via /cluster are discovered "
+                         "automatically")
+    ob.add_argument("--port", type=int, default=_env("DPS_FLEET_PORT", 0,
+                                                     int),
+                    help="port to serve GET /fleet on (0 = pick free)")
+    ob.add_argument("--interval", type=float, default=2.0,
+                    help="seconds between scrape ticks")
+    ob.add_argument("--timeout", type=float, default=1.5,
+                    help="per-target per-request scrape timeout; a dead "
+                         "target marks its series stale, never blocks "
+                         "the tick")
+    ob.add_argument("--ring-depth", type=int, default=120,
+                    help="samples kept per series ring (bounded memory)")
+    ob.add_argument("--slo-fetch-p99-ms", type=float, default=100.0,
+                    help="fleet fetch-latency objective threshold")
+    ob.add_argument("--slo-availability", type=float, default=0.99,
+                    help="fleet availability objective target")
+    ob.add_argument("--slo-fast-window", type=float, default=60.0,
+                    help="fast burn window (s) for the fleet-scope SLO "
+                         "evaluation over MERGED series")
+    ob.add_argument("--slo-slow-window", type=float, default=300.0,
+                    help="slow burn window (s)")
+
+    tp = sub.add_parser(
+        "top",
+        help="live fleet dashboard over a collector's GET /fleet "
+             "(per-tier rows, fleet QPS, replica lag, merged-series SLO "
+             "burn, alert feed, sparklines); exit codes match `cli "
+             "status`: 0 healthy, 1 unreachable, 2 critical, 3 "
+             "critical-but-healing")
+    tp.add_argument("--url", default=_env("DPS_FLEET_URL", None),
+                    help="base URL of the fleet collector, e.g. "
+                         "http://host:9500 (env DPS_FLEET_URL)")
+    tp.add_argument("--watch", type=float, default=0.0, metavar="SECONDS",
+                    help="redraw every N seconds until interrupted "
+                         "(0 = one shot)")
+    tp.add_argument("--json", action="store_true",
+                    help="print the raw /fleet JSON instead of the "
+                         "dashboard")
 
     pf = sub.add_parser(
         "perf",
@@ -816,6 +880,10 @@ def _telemetry_session(args, role: str):
         from .telemetry import register_build_info, start_metrics_server
         register_build_info()  # fleet-wide scrape correlation gauge
         http_server, bound = start_metrics_server(port=port)
+        # Stash the bound port so the command body can announce its
+        # metrics endpoint (replicas publish it through the primary's
+        # /cluster view for fleet discovery, telemetry/fleet.py).
+        args._metrics_bound = bound
         print(f"telemetry: serving /metrics on :{bound}", file=sys.stderr,
               flush=True)
     if getattr(args, "telemetry", False):
@@ -1715,6 +1783,41 @@ def _render_status(view: dict) -> str:
     return "\n".join(lines)
 
 
+def _cluster_view_from_fleet(fleet: dict) -> dict:
+    """Synthesize a ``/cluster``-shaped view from a ``/fleet`` snapshot
+    so ``cli status --via-fleet`` renders the EXISTING dashboard from
+    merged fleet data: worker rows and jobs come from the inventory
+    tiers, the alert feed is the fleet-wide one (each alert tagged with
+    its source target), the slo block is the fleet-scope evaluation
+    over MERGED series, and mode/global_step come from the first
+    primary. Blocks the fleet view lacks (round, sharding, remediation)
+    are simply absent — ``_render_status`` degrades over them exactly
+    as it does for an older server, which is the pinned behavior."""
+    tiers = fleet.get("tiers") or {}
+    primaries = tiers.get("primaries") or []
+    first = primaries[0] if primaries else {}
+    alerts = fleet.get("alerts") or []
+    totals = {"critical": 0, "warning": 0, "info": 0}
+    for a in alerts:
+        sev = a.get("severity")
+        if sev in totals:
+            totals[sev] += 1
+    view = {
+        "ts": fleet.get("ts"),
+        "role": "fleet",
+        "mode": first.get("mode"),
+        "global_step": first.get("global_step"),
+        "workers": tiers.get("workers") or [],
+        "alerts": alerts,
+        "alerts_total": totals,
+    }
+    if fleet.get("slo"):
+        view["slo"] = fleet["slo"]
+    if tiers.get("jobs"):
+        view["jobs"] = tiers["jobs"]
+    return view
+
+
 def cmd_status(args) -> int:
     """One-shot (or ``--watch``) render of a serve process's ``/cluster``
     view. Exit codes: 0 healthy, 2 when a CRITICAL alert is active (so a
@@ -1726,23 +1829,33 @@ def cmd_status(args) -> int:
     is a critical alert (exit 2/3), slo_burn_slow a warning (exit 0) —
     paging on fast burn only is the multi-window point. A server without
     an "slo" block (older build, --no-slo) renders everything else
-    unchanged."""
+    unchanged. ``--via-fleet URL`` renders the same dashboard from a
+    fleet collector's merged ``/fleet`` snapshot instead — same exit
+    codes, evaluated over the whole fleet."""
     import json as _json
     import time as _time
     from urllib.error import HTTPError, URLError
     from urllib.request import urlopen
 
-    base = args.url
-    if not base:
-        if args.metrics_port is None:
-            print("status: need --url or --metrics-port", file=sys.stderr)
-            return 1
-        base = f"http://{args.host}:{args.metrics_port}"
-    url = base.rstrip("/") + "/cluster"
+    via_fleet = getattr(args, "via_fleet", None)
+    if via_fleet:
+        base = via_fleet
+        if not base.startswith(("http://", "https://")):
+            base = "http://" + base
+        url = base.rstrip("/") + "/fleet"
+    else:
+        base = args.url
+        if not base:
+            if args.metrics_port is None:
+                print("status: need --url or --metrics-port",
+                      file=sys.stderr)
+                return 1
+            base = f"http://{args.host}:{args.metrics_port}"
+        url = base.rstrip("/") + "/cluster"
 
     def poll() -> tuple[int, dict | None]:
         try:
-            view = _json.loads(urlopen(url, timeout=5).read())
+            raw = _json.loads(urlopen(url, timeout=5).read())
         except HTTPError as e:
             print(f"status: {url} -> HTTP {e.code} "
                   f"({e.read().decode(errors='replace')[:200]})",
@@ -1751,11 +1864,19 @@ def cmd_status(args) -> int:
         except (URLError, OSError, ValueError) as e:
             print(f"status: cannot reach {url}: {e}", file=sys.stderr)
             return 1, None
+        view = _cluster_view_from_fleet(raw) if via_fleet else raw
         if args.json:
-            print(_json.dumps(view, indent=2))
+            print(_json.dumps(raw, indent=2))
         else:
             print(_render_status(view))
         critical = view.get("alerts_total", {}).get("critical", 0)
+        if via_fleet and not critical:
+            # On a primary, slo_burn_fast raises a critical alert via
+            # the monitor, so alerts_total already covers it; fleet-
+            # scope breaches live only in the slo block.
+            critical = any(b.get("severity") == "critical"
+                           for b in (raw.get("slo") or {})
+                           .get("breaches", []))
         if not critical:
             return 0, view
         # Degraded-but-healing: critical alerts with a live remediation
@@ -1764,8 +1885,11 @@ def cmd_status(args) -> int:
         # dry-run engine records decisions but executes NOTHING, so it
         # must not claim healing (a policy holding off would wait
         # forever).
-        rem = view.get("remediation", {})
-        healing = bool(rem.get("active")) and not rem.get("dry_run")
+        if via_fleet:
+            healing = bool(raw.get("remediation_active"))
+        else:
+            rem = view.get("remediation", {})
+            healing = bool(rem.get("active")) and not rem.get("dry_run")
         return (3 if healing else 2), view
 
     if args.watch <= 0:
@@ -1778,6 +1902,233 @@ def cmd_status(args) -> int:
             rc, _ = poll()
             print(f"\n(watching {url} every {args.watch:g}s — Ctrl-C to "
                   f"stop)")
+            _time.sleep(args.watch)
+    except KeyboardInterrupt:
+        pass
+    return rc
+
+
+def cmd_observe(args) -> int:
+    """The fleet observatory collector process (standalone: off every
+    serve hot path, survives primary restarts). Scrapes, rolls up, and
+    serves ``GET /fleet`` until interrupted."""
+    import threading as _threading
+
+    from .telemetry.fleet import FleetCollector, start_fleet_server
+    from .telemetry.registry import MetricsRegistry
+    from .telemetry.slo import default_objectives
+
+    targets = [t.strip() for t in args.targets.split(",") if t.strip()]
+    if not targets:
+        print("observe: --targets needs at least one endpoint",
+              file=sys.stderr)
+        return 1
+    collector = FleetCollector(
+        targets, interval_s=args.interval, timeout_s=args.timeout,
+        ring_depth=args.ring_depth,
+        registry=MetricsRegistry(),
+        objectives=default_objectives(
+            fetch_p99_ms=args.slo_fetch_p99_ms,
+            availability=args.slo_availability),
+        fast_window_s=args.slo_fast_window,
+        slow_window_s=args.slo_slow_window)
+    server, port = start_fleet_server(collector, port=args.port)
+    print(f"observe up on :{port} ({len(targets)} seed target(s), "
+          f"interval={args.interval:g}s, timeout={args.timeout:g}s)",
+          file=sys.stderr, flush=True)
+    stop = _threading.Event()
+    try:
+        collector.run_forever(stop)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        stop.set()
+        server.shutdown()
+    return 0
+
+
+_SPARK_CHARS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values, width: int = 40) -> str:
+    """Ring history -> a fixed-width unicode sparkline (None samples —
+    e.g. p99 before any fetch — are skipped)."""
+    vals = [float(v) for v in values if v is not None][-width:]
+    if not vals:
+        return ""
+    lo, hi = min(vals), max(vals)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK_CHARS[0] * len(vals)
+    return "".join(_SPARK_CHARS[min(7, int((v - lo) / span * 8))]
+                   for v in vals)
+
+
+def _top_exit_code(view: dict) -> int:
+    """``cli status``-consistent: 0 healthy, 2 critical (a critical
+    alert anywhere in the fleet, or a fleet-scope fast-burn breach),
+    3 critical-but-healing (some primary's remediation engine is live
+    and not dry-run)."""
+    critical = any(a.get("severity") == "critical"
+                   for a in view.get("alerts", []))
+    critical = critical or any(
+        b.get("severity") == "critical"
+        for b in (view.get("slo") or {}).get("breaches", []))
+    if not critical:
+        return 0
+    return 3 if view.get("remediation_active") else 2
+
+
+def _render_top(view: dict) -> str:
+    """The ``cli top`` fleet dashboard: header + sparklines + per-tier
+    rows + fleet SLO burn + alert feed. Pure text in, text out (tested
+    directly, like ``_render_status``)."""
+    sev_mark = {"critical": "CRIT", "warning": "WARN", "info": "INFO"}
+    targets = view.get("targets", [])
+    n_ok = sum(1 for t in targets if t.get("ok"))
+    scrape = view.get("scrape", {})
+    hist = view.get("history", {})
+    p99s = [v for v in hist.get("p99_ms", []) if v is not None]
+    p99 = p99s[-1] if p99s else None
+    header = (f"fleet: targets {n_ok}/{len(targets)} up "
+              f"qps={view.get('fleet_qps', 0):g} "
+              f"p99={'-' if p99 is None else f'{p99:g}ms'} "
+              f"series={view.get('series_count', 0)} "
+              f"tick#{view.get('ticks', 0)} "
+              f"(scrape {scrape.get('last_ms', 0):g}ms)")
+    lines = [header, "-" * len(header)]
+    for name, label in (("fleet_qps", "qps"), ("p99_ms", "p99ms"),
+                        ("scrape_ms", "scrape")):
+        ring = hist.get(name, [])
+        cur = [v for v in ring if v is not None]
+        lines.append(f"  {label:>7} {_sparkline(ring):<40} "
+                     f"{cur[-1] if cur else '-'}")
+    prim = (view.get("tiers") or {}).get("primaries") or []
+    if prim:
+        lines.append("")
+        lines.append("primaries:")
+        for row in prim:
+            shard = ("" if row.get("shard_id") is None
+                     else f" shard={row['shard_id']}"
+                          f" map_v{row.get('map_version', '?')}")
+            lines.append(
+                f"  {row.get('target')}: "
+                f"{'up' if row.get('ok') else 'STALE'} "
+                f"mode={row.get('mode')} step={row.get('global_step')}"
+                f"{shard} alerts={row.get('alerts', 0)}")
+    reps = (view.get("tiers") or {}).get("replicas") or []
+    if reps:
+        lines.append("")
+        lines.append("replicas:")
+        for rep in reps:
+            lines.append(
+                f"  {rep.get('address')}: step={rep.get('step')} "
+                f"lag={rep.get('lag_steps')} step(s) "
+                f"(via {rep.get('via')})")
+    workers = (view.get("tiers") or {}).get("workers") or []
+    if workers:
+        lines.append("")
+        lines.append(f"workers ({len(workers)}):")
+        for w in workers:
+            job = f" job={w['job']}" if w.get("job") else ""
+            rep = w.get("report") or {}
+            step = rep.get("step", w.get("step"))
+            lines.append(
+                f"  worker {w.get('worker')}: "
+                f"{'alive' if w.get('alive') else 'DOWN'}"
+                f"{job} step={step} (via {w.get('via')})")
+    jobs = (view.get("tiers") or {}).get("jobs") or {}
+    if jobs:
+        lines.append("")
+        lines.append("jobs:")
+        for name in sorted(jobs):
+            row = jobs[name]
+            lines.append(
+                f"  {name}: mode={row.get('mode')} "
+                f"step={row.get('global_step')} "
+                f"workers={len(row.get('workers') or [])} "
+                f"(via {row.get('via')})")
+    stale = [t for t in targets if not t.get("ok")]
+    if stale:
+        lines.append("")
+        lines.append("stale targets:")
+        for t in stale:
+            lines.append(f"  {t.get('target')}: "
+                         f"{t.get('consecutive_failures')} consecutive "
+                         f"failure(s) — {t.get('last_error')}")
+    slo = view.get("slo") or {}
+    if slo.get("objectives"):
+        lines.append("")
+        lines.append("fleet slo (merged series):")
+        for obj in slo["objectives"]:
+            wins = obj.get("windows", {})
+            burns = []
+            for rule in sorted(wins):
+                w = wins[rule]
+                mark = " BREACH" if w.get("breaching") else ""
+                burns.append(f"{w.get('window_s', 0):g}s burn "
+                             f"{w.get('burn', 0):g}x{mark}")
+            p99o = obj.get("p99_ms")
+            lines.append(
+                f"  {obj.get('name')}: target={obj.get('target')} "
+                f"p99={'-' if p99o is None else f'{p99o:g}ms'} "
+                f"n={obj.get('total', 0)} "
+                f"({'; '.join(burns) if burns else 'no windows'})")
+    alerts = view.get("alerts", [])
+    if alerts:
+        lines.append("")
+        lines.append("active alerts:")
+        for a in alerts:
+            who = "cluster" if a.get("worker") is None \
+                else f"worker {a['worker']}"
+            lines.append(
+                f"  [{sev_mark.get(a.get('severity'), '????')}] "
+                f"{a.get('rule')} ({who} @ {a.get('target')}): "
+                f"{a.get('message')}")
+    else:
+        lines.append("")
+        lines.append("no active alerts")
+    return "\n".join(lines)
+
+
+def cmd_top(args) -> int:
+    """Live fleet dashboard over a collector's ``GET /fleet``. Exit
+    codes match ``cli status`` (see ``_top_exit_code``); 1 when the
+    collector is unreachable."""
+    import json as _json
+    import time as _time
+    from urllib.error import HTTPError, URLError
+    from urllib.request import urlopen
+
+    base = args.url
+    if not base:
+        print("top: need --url (or DPS_FLEET_URL)", file=sys.stderr)
+        return 1
+    if not base.startswith(("http://", "https://")):
+        base = "http://" + base
+    url = base.rstrip("/") + "/fleet"
+
+    def poll() -> int:
+        try:
+            view = _json.loads(urlopen(url, timeout=5).read())
+        except (HTTPError, URLError, OSError, ValueError) as e:
+            print(f"top: cannot reach {url}: {e}", file=sys.stderr)
+            return 1
+        if args.json:
+            print(_json.dumps(view, indent=2))
+        else:
+            print(_render_top(view))
+        return _top_exit_code(view)
+
+    if args.watch <= 0:
+        return poll()
+    rc = 0
+    try:
+        while True:
+            print("\x1b[2J\x1b[H", end="")  # clear screen, home cursor
+            rc = poll()
+            print(f"\n(watching {url} every {args.watch:g}s — Ctrl-C "
+                  f"to stop)")
             _time.sleep(args.watch)
     except KeyboardInterrupt:
         pass
@@ -1827,9 +2178,13 @@ def _cmd_replica(args) -> int:
 
     from .comms.replica import ReplicaServer
 
+    metrics_adv = getattr(args, "metrics_advertise", None)
+    if metrics_adv is None and getattr(args, "_metrics_bound", None):
+        metrics_adv = f"localhost:{args._metrics_bound}"
     rep = ReplicaServer(args.primary, port=args.port,
                         shard_id=args.shard_id,
                         advertise=args.advertise,
+                        metrics_advertise=metrics_adv,
                         poll_interval=args.poll_interval,
                         staleness_bound_s=args.staleness_bound,
                         canary=bool(getattr(args, "canary", False)),
@@ -2317,6 +2672,7 @@ def main(argv=None) -> int:
     return {"train": cmd_train, "serve": cmd_serve, "worker": cmd_worker,
             "experiments": cmd_experiments, "supervise": cmd_supervise,
             "status": cmd_status, "replica": cmd_replica,
+            "observe": cmd_observe, "top": cmd_top,
             "loadgen": cmd_loadgen, "reshard": cmd_reshard,
             "infer": cmd_infer, "lint": cmd_lint,
             "perf": cmd_perf}[args.command](args)

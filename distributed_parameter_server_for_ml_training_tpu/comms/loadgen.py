@@ -33,10 +33,74 @@ import time
 
 import grpc
 
+from ..telemetry.registry import LATENCY_BUCKETS, Histogram
+from ..telemetry.stats import histogram_quantile, merge_histograms
 from ..telemetry.stats import latency_summary as _latency_summary
 from .service import GRPC_OPTIONS, SERVICE_NAME, pack_msg, unpack_msg
 
-__all__ = ["run_loadgen"]
+__all__ = ["merge_loadgen_reports", "run_loadgen"]
+
+
+def _latency_hist(lat_s: list) -> dict:
+    """Client-observed latencies on the pinned SLO bucket scheme — the
+    LOADGEN_JSON field that makes reports MERGEABLE: percentiles of
+    percentiles are not percentiles, but pinned-scheme histograms merge
+    exactly (telemetry/stats.merge_histograms)."""
+    h = Histogram("loadgen_latency", buckets=LATENCY_BUCKETS)
+    for v in lat_s:
+        h.observe(v)
+    return h.snapshot()
+
+
+def merge_loadgen_reports(reports: list) -> dict:
+    """Merge LOADGEN_JSON reports into one honest aggregate report.
+
+    The building block for distributed load generation (N generator
+    processes hammering one fleet): counts/bytes sum, QPS sums (the
+    generators ran concurrently), duration takes the max, targets union
+    — and the latency percentiles come from merging each report's
+    ``latency_hist`` on the pinned bucket scheme, so the merged
+    p50/p95/p99 are the union percentiles, not an average of
+    per-report percentiles. Raises on reports without ``latency_hist``
+    (pre-merge-era records cannot be merged honestly).
+    """
+    if not reports:
+        raise ValueError("merge_loadgen_reports needs at least one report")
+    for i, r in enumerate(reports):
+        if "latency_hist" not in r:
+            raise ValueError(
+                f"report {i} has no latency_hist — re-run the generator "
+                f"(pre-fleet reports cannot be merged honestly)")
+    merged_hist = merge_histograms([r["latency_hist"] for r in reports])
+    targets: list = []
+    for r in reports:
+        for t in r.get("targets", []):
+            if t not in targets:
+                targets.append(t)
+    latency_ms = {"samples": int(merged_hist["count"])}
+    for pct, key in ((50, "p50"), (95, "p95"), (99, "p99")):
+        q = histogram_quantile(merged_hist["le"], merged_hist["counts"],
+                               pct)
+        latency_ms[key] = None if q is None else round(q * 1e3, 3)
+    total_bytes = sum(r.get("bytes_in", 0) for r in reports)
+    return {
+        "targets": targets,
+        "reports": len(reports),
+        "modes": sorted({r.get("mode", "?") for r in reports}),
+        "concurrency": sum(int(r.get("concurrency", 0)) for r in reports),
+        "duration_s": round(max(float(r.get("duration_s", 0.0))
+                                for r in reports), 3),
+        "fetches_ok": sum(int(r.get("fetches_ok", 0)) for r in reports),
+        "fetches_err": sum(int(r.get("fetches_err", 0)) for r in reports),
+        "not_modified": sum(int(r.get("not_modified", 0))
+                            for r in reports),
+        "bytes_in": total_bytes,
+        "qps": round(sum(float(r.get("qps", 0.0)) for r in reports), 1),
+        "mb_per_s": round(sum(float(r.get("mb_per_s", 0.0))
+                              for r in reports), 2),
+        "latency_ms": latency_ms,
+        "latency_hist": merged_hist,
+    }
 
 
 def _fetch_stub(channel):
@@ -204,6 +268,7 @@ def run_loadgen(targets, duration_s: float = 5.0, concurrency: int = 4,
         "mb_per_s": round(total_bytes / elapsed / 1e6, 2)
         if elapsed > 0 else 0.0,
         "latency_ms": _latency_summary(latencies),
+        "latency_hist": _latency_hist(latencies),
         "errors_by_target": {t: r["err"] for t, r in per_target.items()},
         "per_target": per_target,
     }

@@ -161,6 +161,7 @@ class ReplicaServer:
 
     def __init__(self, primary: str, port: int = 0, shard_id: int = 0,
                  advertise: str | None = None,
+                 metrics_advertise: str | None = None,
                  poll_interval: float = 0.05,
                  staleness_bound_s: float = 5.0,
                  rpc_timeout: float = 10.0,
@@ -178,6 +179,11 @@ class ReplicaServer:
         #: publishes to clients); filled from the bound port at start()
         #: when not given.
         self.advertise = advertise
+        #: The metrics-endpoint address announced alongside it (host:port
+        #: of this process's /metrics server, when one is running) — how
+        #: the fleet collector (telemetry/fleet.py) discovers replicas as
+        #: scrape targets from the primary's /cluster view.
+        self.metrics_advertise = metrics_advertise
         self.poll_interval = float(poll_interval)
         self.staleness_bound_s = float(staleness_bound_s)
         self.rpc_timeout = float(rpc_timeout)
@@ -229,6 +235,14 @@ class ReplicaServer:
             "dps_replica_refresh_seconds", buckets=LATENCY_BUCKETS)
         self._tm_refresh_errors = reg.counter(
             "dps_replica_refresh_errors_total")
+        # Serve-path latency (this replica answering client fetches,
+        # incl. infer) on the SLO-grade scheme, with head-sampled trace
+        # exemplars — the replica-tier half of the fleet observatory's
+        # p99 -> trace join (docs/OBSERVABILITY.md "Fleet observatory").
+        self._tm_serve_hist = reg.histogram(
+            "dps_replica_serve_seconds", buckets=LATENCY_BUCKETS)
+        from ..telemetry import ExemplarSampler
+        self._exemplars = ExemplarSampler(rate=0.1, seed=os.getpid())
         self._tm_stale = reg.counter("dps_replica_stale_rejects_total")
         self._tm_redirects = reg.counter("dps_replica_redirects_total")
         self._tm_step = reg.gauge("dps_replica_step")
@@ -250,8 +264,12 @@ class ReplicaServer:
         t0 = time.perf_counter()
         with self._lock:
             have = self._step
-        meta: dict = {"replica": {"shard_id": self.shard_id,
-                                  "address": self.advertise}}
+        announce = {"shard_id": self.shard_id, "address": self.advertise}
+        if self.metrics_advertise:
+            # Adopted by the fleet collector's discovery pass via the
+            # primary's sharding view (docs/OBSERVABILITY.md).
+            announce["metrics"] = self.metrics_advertise
+        meta: dict = {"replica": announce}
         if have is not None:
             meta["have_step"] = int(have)
         raw = self._fetch_stub(pack_msg(meta), timeout=self.rpc_timeout)
@@ -398,6 +416,36 @@ class ReplicaServer:
             self._tm_infer[arm].inc()
             return reply if reply is not None else self._reply
 
+    def _timed_serve(self, fn):
+        """Wrap the (possibly fault-wrapped) serve handler with the
+        serve-latency histogram + head-sampled trace exemplars. Installed
+        OUTSIDE the fault injector so injected serve-path latency lands
+        in the histogram the fleet rollups merge — the observability
+        plane must see the faults it exists to surface. Tracing off:
+        one perf_counter pair + an observe."""
+        from ..telemetry import trace_enabled, trace_span
+
+        def wrapped(request: bytes, ctx) -> bytes:
+            t0 = time.perf_counter()
+            if not trace_enabled():
+                try:
+                    return fn(request, ctx)
+                finally:
+                    self._tm_serve_hist.observe(time.perf_counter() - t0)
+            sp = None
+            try:
+                with trace_span("rpc.replica_serve",
+                                shard=self.shard_id) as sp:
+                    return fn(request, ctx)
+            finally:
+                dur = time.perf_counter() - t0
+                tid = getattr(getattr(sp, "ctx", None), "trace_id", None)
+                if tid is not None and self._exemplars.sample():
+                    self._tm_serve_hist.observe(dur, exemplar=tid)
+                else:
+                    self._tm_serve_hist.observe(dur)
+        return wrapped
+
     def _redirect(self, request: bytes, ctx) -> bytes:
         self._tm_redirects.inc()
         return pack_msg({"accepted": False, "received": False,
@@ -418,6 +466,7 @@ class ReplicaServer:
             from .faults import SUBSCRIBE_OP
             fetch_handler = self.faults.wrap_handler(SUBSCRIBE_OP,
                                                      fetch_handler)
+        fetch_handler = self._timed_serve(fetch_handler)
         handlers = grpc.method_handlers_generic_handler(SERVICE_NAME, {
             name: grpc.unary_unary_rpc_method_handler(
                 fn, request_deserializer=ident, response_serializer=ident)

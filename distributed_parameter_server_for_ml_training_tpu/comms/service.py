@@ -404,6 +404,15 @@ class ParameterService:
                          "FetchParameters", "JobFinished", "Reshard",
                          "SubmitJob"]
         }
+        # Trace exemplars (docs/OBSERVABILITY.md "Fleet observatory"):
+        # head-sampled trace ids attached to the SLO latency histogram so
+        # a fleet p99 spike resolves to flight-recorder traces. One
+        # counter-based sampler across all methods — no RNG on the hot
+        # path, pid-seeded phase so co-started shards don't sample the
+        # same beat.
+        from ..telemetry import ExemplarSampler
+        import os
+        self._tm_exemplars = ExemplarSampler(rate=0.1, seed=os.getpid())
         # Per-job QoS (docs/TENANCY.md): constructed with the job table
         # so drain can tear down scheduler state alongside the job.
         self.qos = None
@@ -661,7 +670,8 @@ class ParameterService:
         try:
             self.sharding.note_replica(rep.get("address"),
                                        meta.get("have_step", 0),
-                                       self.store.global_step)
+                                       self.store.global_step,
+                                       metrics=rep.get("metrics"))
         except Exception:  # noqa: BLE001
             pass
 
@@ -1498,9 +1508,10 @@ class ParameterService:
                         (peek_trace(payload) if len(payload) else None)
                 except Exception:  # noqa: BLE001
                     wire_ctx = None  # malformed request fails in fn, not here
+            sp = None
             try:
                 with use_wire_context(wire_ctx), \
-                        trace_span("rpc.server", rpc=name):
+                        trace_span("rpc.server", rpc=name) as sp:
                     reply = fn(request, ctx)
             except Exception:  # noqa: BLE001 — counted, then re-raised
                 # Aborts (incl. injected unavailable/deadline faults)
@@ -1512,7 +1523,14 @@ class ParameterService:
             finally:
                 dur = now() - t0
                 hist.observe(dur)
-                slo_hist.observe(dur)
+                # Exemplar: the span's trace id, head-sampled. _NullSpan
+                # (tracing off) has ctx None, so this stays a cheap
+                # getattr when disabled.
+                tid = getattr(getattr(sp, "ctx", None), "trace_id", None)
+                if tid is not None and self._tm_exemplars.sample():
+                    slo_hist.observe(dur, exemplar=tid)
+                else:
+                    slo_hist.observe(dur)
             b_out.inc(len(reply))
             return reply
 

@@ -295,10 +295,14 @@ class ShardInfo:
             self._tm_map_version.set(self._version)
             return self._version
 
-    def note_replica(self, address: str, step, global_step: int) -> None:
+    def note_replica(self, address: str, step, global_step: int,
+                     metrics: str | None = None) -> None:
         """Ingest one replica announce (rides the replica's refresh fetch
         meta). A NEW address bumps the map version so subscribed clients
-        refresh; a known one just updates lag. Never raises — a garbled
+        refresh; a known one just updates lag. ``metrics`` is the
+        replica's /metrics endpoint when it announces one — published in
+        :meth:`view` so the fleet collector (telemetry/fleet.py) can
+        adopt the replica as a scrape target. Never raises — a garbled
         announce must not fail the fetch that carried it."""
         try:
             addr = str(address)
@@ -309,8 +313,10 @@ class ShardInfo:
         lag = max(0, int(global_step) - have)
         with self._lock:
             fresh = addr not in self._replicas
-            self._replicas[addr] = {"step": have, "ts": now,
-                                    "lag_steps": lag}
+            row = {"step": have, "ts": now, "lag_steps": lag}
+            if metrics:
+                row["metrics"] = str(metrics)
+            self._replicas[addr] = row
             if fresh:
                 self._version += 1
                 self._tm_map_version.set(self._version)
@@ -364,12 +370,15 @@ class ShardInfo:
         now = self.clock()
         with self._lock:
             self._expire_locked(now)
-            replicas = [
-                {"address": a, "step": r["step"],
-                 "lag_steps": r["lag_steps"],
-                 "announce_age_s": round(max(0.0, now - r["ts"]), 3)}
-                for a, r in sorted(self._replicas.items())
-            ]
+            replicas = []
+            for a, r in sorted(self._replicas.items()):
+                row = {"address": a, "step": r["step"],
+                       "lag_steps": r["lag_steps"],
+                       "announce_age_s": round(max(0.0, now - r["ts"]),
+                                               3)}
+                if "metrics" in r:
+                    row["metrics"] = r["metrics"]
+                replicas.append(row)
             out = {"shard_id": self.shard_id,
                    "shard_count": self.shard_count,
                    "map_version": self._version,

@@ -26,6 +26,7 @@ documented in docs/OBSERVABILITY.md.
 from .registry import (
     BYTES_BUCKETS,
     Counter,
+    ExemplarSampler,
     Gauge,
     Histogram,
     LATENCY_BUCKETS,
@@ -37,6 +38,13 @@ from .registry import (
     register_build_info,
 )
 from .autoscale import AutoscalePolicy, ReplicaAutoscaler
+from .fleet import (
+    FLEET_ROLLUP_FIELDS,
+    FleetCollector,
+    parse_prometheus_text,
+    start_fleet_server,
+)
+from .stats import histogram_quantile, merge_histograms
 from .cluster import (
     ClusterMonitor,
     get_cluster_monitor,
@@ -85,6 +93,9 @@ __all__ = [
     "ClusterMonitor",
     "ClusterState",
     "Counter",
+    "ExemplarSampler",
+    "FLEET_ROLLUP_FIELDS",
+    "FleetCollector",
     "FlightRecorder",
     "Gauge",
     "HealthRuleEngine",
@@ -114,14 +125,18 @@ __all__ = [
     "get_cluster_monitor",
     "get_recorder",
     "get_registry",
+    "histogram_quantile",
     "install_shutdown_hooks",
+    "merge_histograms",
     "note_action",
     "now",
+    "parse_prometheus_text",
     "register_build_info",
     "remove_shutdown_flush",
     "render_prometheus",
     "set_cluster_monitor",
     "span",
+    "start_fleet_server",
     "start_metrics_server",
     "trace_enabled",
     "trace_span",

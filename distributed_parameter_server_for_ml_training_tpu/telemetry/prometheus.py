@@ -89,6 +89,14 @@ class _MetricsHandler(BaseHTTPRequestHandler):
         if path == "/metrics":
             body = render_prometheus(self.registry).encode()
             ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif path == "/metrics.json":
+            # The registry snapshot verbatim (docs/OBSERVABILITY.md):
+            # NON-cumulative bucket counts + exemplars, i.e. the exact
+            # shape merge_histograms consumes. The fleet collector
+            # prefers this over re-deriving it from the lossier
+            # cumulative text rendering.
+            body = json.dumps(self.registry.snapshot()).encode()
+            ctype = "application/json"
         elif path == "/healthz":
             # Readiness semantics (docs/OBSERVABILITY.md): with a cluster
             # monitor attached, an active CRITICAL alert flips the probe

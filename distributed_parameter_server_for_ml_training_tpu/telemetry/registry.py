@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import sys
 import threading
+import time
 from bisect import bisect_left
 
 #: Wall-time buckets (seconds): 100 us .. 60 s, roughly 1-2.5-5 per decade.
@@ -155,10 +156,17 @@ class Histogram:
     count. ``le`` edges are upper bounds; observations above the last edge
     land in the implicit overflow bucket (rendered ``+Inf`` on the
     Prometheus surface, stored as the final count here).
+
+    An observation may carry an **exemplar** — a trace id sampled by the
+    caller (:class:`ExemplarSampler` head sampling) — and the histogram
+    keeps the LAST exemplar per bucket: one bounded dict regardless of
+    traffic, so a fleet p99 spike in a high bucket always points at a
+    recent trace that actually landed there (docs/OBSERVABILITY.md,
+    "Fleet observatory").
     """
 
     __slots__ = ("name", "labels", "buckets", "_counts", "_sum", "_count",
-                 "_lock")
+                 "_exemplars", "_lock")
     kind = "histogram"
 
     def __init__(self, name: str, buckets=LATENCY_BUCKETS_S,
@@ -173,15 +181,24 @@ class Histogram:
         self._counts = [0] * (len(self.buckets) + 1)  # +1 = overflow
         self._sum = 0.0  # guarded by: self._lock
         self._count = 0  # guarded by: self._lock
+        self._exemplars: dict[int, dict] = {}  # guarded by: self._lock
         self._lock = threading.Lock()
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, exemplar: str | None = None) -> None:
         v = float(v)
         i = bisect_left(self.buckets, v)
+        if exemplar is None:
+            with self._lock:
+                self._counts[i] += 1
+                self._sum += v
+                self._count += 1
+            return
+        ex = {"trace_id": exemplar, "value": v, "ts": time.time()}
         with self._lock:
             self._counts[i] += 1
             self._sum += v
             self._count += 1
+            self._exemplars[i] = ex
 
     @property
     def count(self) -> int:
@@ -194,12 +211,51 @@ class Histogram:
             return self._sum
 
     def snapshot(self) -> dict:
-        """JSON-ready view: edges + per-bucket (non-cumulative) counts."""
+        """JSON-ready view: edges + per-bucket (non-cumulative) counts.
+        ``exemplars`` (bucket index, as a string for JSON round-trips ->
+        ``{trace_id, value, ts}``) appears only when at least one
+        observation carried one — exemplar-free histograms keep the
+        exact pre-exemplar snapshot shape."""
         with self._lock:
-            return {"le": list(self.buckets),
-                    "counts": list(self._counts),
-                    "sum": self._sum,
-                    "count": self._count}
+            out = {"le": list(self.buckets),
+                   "counts": list(self._counts),
+                   "sum": self._sum,
+                   "count": self._count}
+            if self._exemplars:
+                out["exemplars"] = {str(i): dict(ex)
+                                    for i, ex in self._exemplars.items()}
+            return out
+
+
+class ExemplarSampler:
+    """Deterministic head sampler for exemplar attachment.
+
+    Counter-based, same discipline as the serving canary split
+    (comms/replica.py CanaryController): a rate of ``r`` becomes "every
+    round(1/r)-th call samples", with a seed-derived phase so co-started
+    processes don't all sample the same beat. No RNG on the hot path —
+    one lock'd increment + modulo — which keeps the cost inside the
+    <2% overhead guard (tests/test_telemetry.py) and makes sampling
+    decisions reproducible under a fixed seed (property-tested in
+    tests/test_fleet.py).
+    """
+
+    __slots__ = ("period", "_n", "_phase", "_lock")
+
+    def __init__(self, rate: float = 0.1, seed: int = 0):
+        if not 0.0 < rate <= 1.0:
+            raise ValueError(f"exemplar rate must be in (0, 1], got {rate}")
+        self.period = max(1, round(1.0 / rate))
+        self._phase = seed % self.period
+        self._n = 0  # guarded by: self._lock
+        self._lock = threading.Lock()
+
+    def sample(self) -> bool:
+        """True when this call should attach an exemplar."""
+        with self._lock:
+            n = self._n
+            self._n += 1
+        return n % self.period == self._phase
 
 
 class MetricsRegistry:

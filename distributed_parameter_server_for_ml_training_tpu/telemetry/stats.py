@@ -11,7 +11,8 @@ snapshots exist, not raw samples — one implementation, both surfaces.
 
 from __future__ import annotations
 
-__all__ = ["histogram_quantile", "latency_summary", "percentile"]
+__all__ = ["histogram_quantile", "latency_summary", "merge_histograms",
+           "percentile"]
 
 
 def percentile(sorted_vals: list[float], p: float) -> float:
@@ -46,6 +47,53 @@ def histogram_quantile(edges: list[float], counts: list[int],
                 return None  # overflow bucket: unbounded above
             return float(edges[i])
     return None
+
+
+def merge_histograms(snaps: list[dict]) -> dict:
+    """Merge registry histogram snapshots into one EXACT union histogram.
+
+    Exactness is the whole point (and what the fleet rollups advertise):
+    because bucket schemes are pinned in ``registry.py``, every process
+    in the fleet records the same series into identical edges, so the
+    merged per-bucket counts equal the counts a single histogram would
+    have accumulated over the union of all observations — fleet
+    p50/p95/p99 from :func:`histogram_quantile` over the merge are the
+    true union quantiles, not an estimate-of-estimates. Mismatched
+    ``le`` schemes raise (merging them could only be approximate, which
+    would silently break that contract).
+
+    The operation is associative and commutative with ``{le, counts:
+    zeros, sum: 0, count: 0}`` as identity — property-tested in
+    tests/test_fleet.py. Per-bucket ``exemplars`` (when present) merge
+    by newest timestamp: the surviving exemplar per bucket is the most
+    recently sampled one across the fleet.
+    """
+    if not snaps:
+        raise ValueError("merge_histograms needs at least one snapshot")
+    le = list(snaps[0]["le"])
+    n_counts = len(snaps[0]["counts"])
+    merged_counts = [0] * n_counts
+    merged_sum = 0.0
+    merged_count = 0
+    merged_ex: dict[str, dict] = {}
+    for snap in snaps:
+        if list(snap["le"]) != le or len(snap["counts"]) != n_counts:
+            raise ValueError(
+                f"cannot merge histograms with different bucket schemes: "
+                f"{le!r} vs {snap['le']!r}")
+        for i, c in enumerate(snap["counts"]):
+            merged_counts[i] += c
+        merged_sum += snap["sum"]
+        merged_count += snap["count"]
+        for idx, ex in (snap.get("exemplars") or {}).items():
+            cur = merged_ex.get(idx)
+            if cur is None or ex.get("ts", 0) >= cur.get("ts", 0):
+                merged_ex[idx] = dict(ex)
+    out = {"le": le, "counts": merged_counts, "sum": merged_sum,
+           "count": merged_count}
+    if merged_ex:
+        out["exemplars"] = merged_ex
+    return out
 
 
 def latency_summary(lat_s: list[float]) -> dict:

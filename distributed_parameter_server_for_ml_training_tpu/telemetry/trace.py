@@ -93,6 +93,9 @@ SPAN_CATALOG = {
                   "recorded with error attr)",
     "rpc.server": "server-side handler span (attr rpc=<name>), parented "
                   "on the wire-propagated worker context",
+    "rpc.replica_serve": "replica serving one client fetch/infer from "
+                         "cached bytes (local root; attr shard) — the "
+                         "serve-tier exemplar source",
     "store.push": "store push incl. codec decode (attrs backend, "
                   "accepted)",
     "store.fetch": "store fetch incl. codec encode (attrs backend, "

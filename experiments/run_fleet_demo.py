@@ -1,0 +1,543 @@
+"""Recorded fleet-observatory demo (ISSUE 16 acceptance evidence).
+
+One live cluster — two shard primaries, two ``cli replica`` processes,
+one supervised training worker — under ``cli loadgen``, watched by a
+standalone ``cli observe`` aggregation process. Every check is
+exit-code-verified (the PR 4-15 recorded-demo format); all long-lived
+processes are real ``cli`` subprocesses and the driver talks to them
+only over HTTP/gRPC.
+
+**Phase A — honest rollups.** A clean loadgen window against both
+primaries, then quiesce: the ``/fleet`` merged
+``dps_rpc_server_latency_seconds{method=FetchParameters}`` histogram
+must equal the element-wise union of the per-target ``/metrics.json``
+snapshots BUCKET-EXACTLY, and the fleet p50/p95/p99 must equal the
+percentiles computed from that union — no averaged percentiles.
+
+**Phase B — discovery tiers.** Replicas announce their metrics ports
+through the primaries' sharding views; the collector must adopt them
+as non-explicit targets (``discovered_from`` set), the replica tier
+must render, and the supervised worker must appear in the worker tier
+via its primary's ``/cluster``.
+
+**Phase C — partial-fleet tolerance.** One replica is SIGKILLed: the
+next tick must stay uninterrupted (other targets fresh), mark the dead
+target stale, and mint ``dps_fleet_scrape_errors_total{target=...}``
+while ``/fleet`` keeps serving.
+
+**Phase D — exemplar-linked fault.** Primary 0 is restarted with
+``fetch.delay=0.12@p=0.8`` injected: the fleet p99 spikes over the
+100 ms objective, the fleet-scope ``slo_burn_fast`` breach fires, and
+``cli top`` exits 2. The spiked buckets carry sampled trace exemplars
+that must resolve (``analysis.fleet_series.resolve_exemplars``) to at
+least one assembled trace in the primaries' flight-recorder dumps.
+
+**Phase E — recovery.** Primary 0 restarts clean; once the fast burn
+window drains, ``cli top`` exits 0 again. ``cli status --via-fleet``
+output is recorded alongside.
+
+**Phase F — overhead.** The serving primary's CPU cost per scrape is
+measured from ``/proc/<pid>/stat`` across an idle window with a 10 Hz
+probe collector vs. without: at the default 2 s cadence the scrape
+overhead must stay under 2% of one core.
+
+Artifacts: ``fleet_demo.json`` (summary + PASS/FAIL checks), clean and
+fault ``/fleet`` snapshots, flight-recorder dumps, ``cli top`` /
+``cli status --via-fleet`` captures, and process logs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT_DIR = os.path.join(REPO, "experiments", "results", "fleet")
+PKG = "distributed_parameter_server_for_ml_training_tpu"
+sys.path.insert(0, REPO)
+
+MODEL = "vit_tiny"
+FAULT_SPEC = "fetch.delay=0.12@p=0.8"
+
+
+def _free_port() -> int:
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _env(**extra) -> dict:
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONUNBUFFERED"] = "1"
+    env.update({k: str(v) for k, v in extra.items()})
+    return env
+
+
+def _http(url: str, timeout: float = 5.0) -> str | None:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.read().decode()
+    except Exception:
+        return None
+
+
+def _get_json(url: str, timeout: float = 5.0) -> dict | None:
+    raw = _http(url, timeout)
+    if raw is None:
+        return None
+    try:
+        return json.loads(raw)
+    except ValueError:
+        return None
+
+
+def _spawn(argv: list, log_path: str, **env_extra):
+    log = open(log_path, "a")
+    proc = subprocess.Popen(argv, stdout=log, stderr=subprocess.STDOUT,
+                            env=_env(**env_extra), cwd=REPO)
+    return proc, log
+
+
+def _stop(proc, log, grace: float = 15.0) -> int | None:
+    if proc is not None and proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=grace)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait(timeout=grace)
+    if log is not None:
+        log.close()
+    return None if proc is None else proc.returncode
+
+
+def _serve_argv(*, index: int, port: int, metrics_port: int,
+                peers: str, faults: str | None = None) -> list:
+    argv = [sys.executable, "-m", f"{PKG}.cli", "serve",
+            "--mode", "async", "--workers", "1",
+            "--port", str(port), "--model", MODEL,
+            "--num-classes", "100", "--image-size", "32",
+            "--platform", "cpu", "--metrics-port", str(metrics_port),
+            "--health-interval", "0.5", "--elastic",
+            "--worker-timeout", "5",
+            "--shard-index", str(index), "--shard-count", "2",
+            "--shard-peers", peers,
+            "--trace", "--trace-buffer", "8192"]
+    if faults:
+        argv += ["--faults", faults]
+    return argv
+
+
+def _wait(pred, what: str, timeout: float = 120.0, poll: float = 0.25):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(poll)
+    raise RuntimeError(f"timed out waiting for {what}")
+
+
+def _proc_cpu_s(pid: int) -> float:
+    with open(f"/proc/{pid}/stat") as f:
+        parts = f.read().rsplit(")", 1)[1].split()
+    # fields 14/15 (utime/stime) are parts[11]/parts[12] after comm
+    ticks = int(parts[11]) + int(parts[12])
+    return ticks / os.sysconf("SC_CLK_TCK")
+
+
+def _loadgen(targets: list[str], duration: float,
+             concurrency: int = 2) -> dict | None:
+    cp = subprocess.run(
+        [sys.executable, "-m", f"{PKG}.cli", "loadgen",
+         "--targets", ",".join(targets), "--duration", str(duration),
+         "--concurrency", str(concurrency), "--fetch-mode", "full"],
+        capture_output=True, text=True, env=_env(), cwd=REPO,
+        timeout=duration + 120)
+    for line in cp.stdout.splitlines():
+        if line.startswith("LOADGEN_JSON "):
+            return json.loads(line[len("LOADGEN_JSON "):])
+    return None
+
+
+def _top(fleet_port: int, json_out: bool = False):
+    argv = [sys.executable, "-m", f"{PKG}.cli", "top",
+            "--url", f"http://127.0.0.1:{fleet_port}"]
+    if json_out:
+        argv.append("--json")
+    cp = subprocess.run(argv, capture_output=True, text=True,
+                        env=_env(), cwd=REPO, timeout=60)
+    return cp.returncode, cp.stdout
+
+
+def main(argv=None) -> int:
+    import argparse
+    global OUT_DIR
+
+    from distributed_parameter_server_for_ml_training_tpu.analysis. \
+        fleet_series import resolve_exemplars
+    from distributed_parameter_server_for_ml_training_tpu.telemetry. \
+        fleet import FleetCollector
+    from distributed_parameter_server_for_ml_training_tpu.telemetry. \
+        registry import MetricsRegistry
+    from distributed_parameter_server_for_ml_training_tpu.telemetry. \
+        stats import histogram_quantile, merge_histograms
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--out-dir", default=OUT_DIR)
+    args = ap.parse_args(argv)
+    OUT_DIR = args.out_dir
+    os.makedirs(OUT_DIR, exist_ok=True)
+    quick = args.quick
+    lg_a_s = 5.0 if quick else 10.0
+    lg_b_s = 6.0 if quick else 12.0
+    fast_window = 10.0 if quick else 20.0
+    idle_w = 5.0 if quick else 8.0
+
+    t0 = time.time()
+    checks: list[tuple[str, bool, str]] = []
+    procs: list[tuple] = []
+    sup = sup_log = None
+    fetch_key = "dps_rpc_server_latency_seconds{method=FetchParameters}"
+
+    try:
+        # -- boot: 2 shard primaries + the observe process ------------------
+        ports = [_free_port(), _free_port()]
+        mports = [_free_port(), _free_port()]
+        peers = ",".join(f"localhost:{p}" for p in ports)
+        plogs = [os.path.join(OUT_DIR, f"primary{i}.log")
+                 for i in range(2)]
+        primaries: list = [None, None]
+        for i in range(2):
+            p, lg = _spawn(_serve_argv(index=i, port=ports[i],
+                                       metrics_port=mports[i],
+                                       peers=peers), plogs[i])
+            primaries[i] = (p, lg)
+            procs.append((p, lg))
+        for i in range(2):
+            _wait(lambda i=i: _get_json(
+                f"http://127.0.0.1:{mports[i]}/cluster"),
+                f"primary {i} admin plane")
+
+        fleet_port = _free_port()
+        obs, obs_log = _spawn(
+            [sys.executable, "-m", f"{PKG}.cli", "observe",
+             "--targets", ",".join(f"127.0.0.1:{m}" for m in mports),
+             "--port", str(fleet_port),
+             "--interval", "0.4", "--timeout", "1.0",
+             "--slo-fast-window", str(fast_window),
+             "--slo-slow-window", str(fast_window * 3)],
+            os.path.join(OUT_DIR, "observe.log"))
+        procs.append((obs, obs_log))
+        fleet_url = f"http://127.0.0.1:{fleet_port}/fleet"
+        _wait(lambda: _get_json(fleet_url), "the /fleet endpoint")
+
+        def fleet_view() -> dict:
+            return _get_json(fleet_url) or {}
+
+        def wait_ticks(n: int, timeout: float = 30.0) -> dict:
+            start = int(fleet_view().get("ticks") or 0)
+            _wait(lambda: int(fleet_view().get("ticks") or 0)
+                  >= start + n, f"{n} collector ticks", timeout)
+            return fleet_view()
+
+        # -- phase A: clean load, then bucket-exact rollup parity -----------
+        lg_a = _loadgen([f"localhost:{p}" for p in ports], lg_a_s)
+        wait_ticks(3)            # quiesced: nothing touches the serve path
+        snaps = [_get_json(f"http://127.0.0.1:{m}/metrics.json")
+                 for m in mports]
+        clean = fleet_view()
+        with open(os.path.join(OUT_DIR, "fleet_snapshot_clean.json"),
+                  "w") as f:
+            json.dump(clean, f, indent=2)
+        union = merge_histograms(
+            [s["histograms"][fetch_key] for s in snaps])
+        merged = clean["rollups"]["histograms"].get(fetch_key) or {}
+        pcts_ok = True
+        for pct, pkey in ((50, "p50_ms"), (95, "p95_ms"), (99, "p99_ms")):
+            q = histogram_quantile(union["le"], union["counts"], pct)
+            want = None if q is None else round(q * 1e3, 3)
+            pcts_ok &= merged.get(pkey) == want
+        checks += [
+            ("A_loadgen_clean",
+             lg_a is not None and lg_a["fetches_ok"] > 0
+             and lg_a["fetches_err"] == 0,
+             f"{(lg_a or {}).get('fetches_ok')} fetches"),
+            ("A_merged_histogram_bucket_exact",
+             merged.get("counts") == union["counts"]
+             and merged.get("count") == union["count"]
+             and merged.get("targets") == 2,
+             f"union count={union['count']} over 2 primaries"),
+            ("A_fleet_percentiles_equal_union_percentiles", bool(pcts_ok),
+             f"p99={merged.get('p99_ms')}ms"),
+        ]
+        print(f"phase A: merged count={merged.get('count')} "
+              f"p99={merged.get('p99_ms')}ms bucket-exact="
+              f"{merged.get('counts') == union['counts']}", flush=True)
+
+        # -- phase B: replicas + supervised worker are discovered ------------
+        rep_mports = [_free_port(), _free_port()]
+        rep_procs = []
+        for i in range(2):
+            rp, rl = _spawn(
+                [sys.executable, "-m", f"{PKG}.cli", "replica",
+                 "--primary", f"localhost:{ports[i]}",
+                 "--port", str(_free_port()), "--shard-id", str(i),
+                 "--metrics-port", str(rep_mports[i]),
+                 "--metrics-advertise", f"127.0.0.1:{rep_mports[i]}",
+                 "--poll-interval", "0.2"],
+                os.path.join(OUT_DIR, f"replica{i}.log"))
+            procs.append((rp, rl))
+            rep_procs.append(rp)
+        sup, sup_log = _spawn(
+            [sys.executable, "-m", f"{PKG}.cli", "supervise",
+             "--workers", "1", "--healthy-after", "2",
+             "--platform", "cpu", "--",
+             "--server", f"localhost:{ports[1]}",
+             "--model", MODEL, "--synthetic", "--num-train", "1500",
+             "--num-test", "96", "--epochs", "3", "--batch-size", "32",
+             "--dtype", "float32", "--no-augment",
+             "--heartbeat", "0.5", "--reconnect-timeout", "30"],
+            os.path.join(OUT_DIR, "supervise.log"))
+
+        def discovered() -> list:
+            return [t for t in fleet_view().get("targets", [])
+                    if not t.get("explicit")]
+
+        _wait(lambda: len(discovered()) >= 2,
+              "both replicas discovered", 60)
+        _wait(lambda: (fleet_view().get("tiers") or {}).get("workers"),
+              "the supervised worker tier", 120)
+        view_b = fleet_view()
+        reps = [t for t in view_b["targets"] if not t["explicit"]]
+        serve_roll = view_b["rollups"]["histograms"].get(
+            "dps_replica_serve_seconds") or {}
+        checks += [
+            ("B_replicas_adopted_from_sharding_views",
+             len(reps) == 2 and all(t["discovered_from"] for t in reps)
+             and all(t["ok"] for t in reps),
+             f"{[t['target'] for t in reps]}"),
+            ("B_tiers_render_all_three",
+             len(view_b["tiers"]["primaries"]) == 2
+             and len(view_b["tiers"]["replicas"]) == 2
+             and len(view_b["tiers"]["workers"]) >= 1,
+             f"workers={len(view_b['tiers']['workers'])}"),
+            ("B_replica_serve_series_rolled_up",
+             serve_roll.get("targets") == 2,
+             f"replica serve targets={serve_roll.get('targets')}"),
+        ]
+        print(f"phase B: {len(reps)} replicas discovered, "
+              f"{len(view_b['tiers']['workers'])} supervised worker(s)",
+              flush=True)
+
+        # -- phase C: SIGKILL one replica — stale series, tick uninterrupted -
+        victim = f"http://127.0.0.1:{rep_mports[1]}"
+        os.kill(rep_procs[1].pid, signal.SIGKILL)
+        rep_procs[1].wait(timeout=30)
+
+        def victim_stale():
+            v = fleet_view()
+            rows = {t["target"]: t for t in v.get("targets", [])}
+            row = rows.get(victim)
+            return v if row is not None and row.get("stale") else None
+
+        view_c = _wait(victim_stale, "the killed replica to go stale", 30)
+        rows = {t["target"]: t for t in view_c["targets"]}
+        others_fresh = all(not t["stale"] for t in view_c["targets"]
+                           if t["target"] != victim)
+        err_metrics = _http(
+            f"http://127.0.0.1:{fleet_port}/metrics") or ""
+        err_line = (f'dps_fleet_scrape_errors_total{{target="{victim}"}}')
+        ticks_before = int(view_c["ticks"])
+        time.sleep(1.5)
+        ticks_after = int(fleet_view().get("ticks") or 0)
+        checks += [
+            ("C_dead_target_marked_stale",
+             rows[victim]["stale"]
+             and rows[victim]["consecutive_failures"] >= 1,
+             f"failures={rows[victim]['consecutive_failures']}"),
+            ("C_tick_uninterrupted_others_fresh",
+             others_fresh and ticks_after > ticks_before,
+             f"ticks {ticks_before}->{ticks_after}"),
+            ("C_scrape_error_series_minted", err_line in err_metrics,
+             err_line),
+        ]
+        print(f"phase C: victim stale, ticks {ticks_before}->"
+              f"{ticks_after} with {len(view_c['targets'])} targets",
+              flush=True)
+
+        # -- phase D: latency fault on primary 0 -> spike, breach, exemplar --
+        p0, p0log = primaries[0]
+        _stop(p0, None)          # keep the log handle for the restart
+        p0, _ = _spawn(_serve_argv(index=0, port=ports[0],
+                                   metrics_port=mports[0], peers=peers,
+                                   faults=FAULT_SPEC), plogs[0])
+        primaries[0] = (p0, p0log)
+        procs.append((p0, None))
+        _wait(lambda: _get_json(f"http://127.0.0.1:{mports[0]}/cluster"),
+              "primary 0 back with the fault injected")
+        lg_b = _loadgen([f"localhost:{p}" for p in ports], lg_b_s)
+        wait_ticks(2)
+        fault = fleet_view()
+        with open(os.path.join(OUT_DIR, "fleet_snapshot_fault.json"),
+                  "w") as f:
+            json.dump(fault, f, indent=2)
+        dump_paths = []
+        for i in range(2):
+            dump = _get_json(
+                f"http://127.0.0.1:{mports[i]}/debug/trace?n=8000")
+            if dump:
+                path = os.path.join(OUT_DIR, f"trace-primary{i}.json")
+                with open(path, "w") as f:
+                    json.dump(dump, f)
+                dump_paths.append(path)
+        fp99 = (fault["rollups"]["histograms"].get(fetch_key)
+                or {}).get("p99_ms")
+        breaches = {(b["rule"], b.get("scope"))
+                    for b in fault.get("slo", {}).get("breaches", [])}
+        resolved = resolve_exemplars(fault, dump_paths=dump_paths,
+                                     min_value_s=0.1)
+        with open(os.path.join(OUT_DIR, "exemplar_resolution.json"),
+                  "w") as f:
+            json.dump({k: resolved[k] for k in
+                       ("exemplars", "resolved", "unresolved")},
+                      f, indent=2)
+        top_rc_fault, top_text = _top(fleet_port)
+        with open(os.path.join(OUT_DIR, "top_fault.txt"), "w") as f:
+            f.write(top_text)
+        checks += [
+            ("D_fleet_p99_spikes_over_objective",
+             fp99 is not None and fp99 > 100.0, f"fleet p99={fp99}ms"),
+            ("D_fleet_scope_burn_breach_fires",
+             ("slo_burn_fast", "fleet") in breaches, f"{breaches}"),
+            ("D_exemplar_resolves_to_flight_recorder_trace",
+             resolved["resolved"] >= 1,
+             f"{resolved['resolved']} resolved / "
+             f"{resolved['unresolved']} unresolved"),
+            ("D_cli_top_exits_2_during_fault", top_rc_fault == 2,
+             f"rc={top_rc_fault}"),
+            ("D_loadgen_survives_fault",
+             lg_b is not None and lg_b["fetches_ok"] > 0,
+             f"{(lg_b or {}).get('fetches_ok')} fetches"),
+        ]
+        print(f"phase D: p99={fp99}ms, breaches={breaches}, "
+              f"{resolved['resolved']} exemplar trace(s) resolved, "
+              f"top rc={top_rc_fault}", flush=True)
+
+        # -- phase E: clean restart -> burn window drains -> top exits 0 -----
+        p0, _ = primaries[0]
+        _stop(p0, None)
+        p0, _ = _spawn(_serve_argv(index=0, port=ports[0],
+                                   metrics_port=mports[0], peers=peers),
+                       plogs[0])
+        primaries[0] = (p0, p0log)
+        procs.append((p0, None))
+        _wait(lambda: _get_json(f"http://127.0.0.1:{mports[0]}/cluster"),
+              "primary 0 back clean")
+        _loadgen([f"localhost:{ports[0]}"], 2.0, concurrency=1)
+
+        def top_clear():
+            rc, text = _top(fleet_port)
+            return (rc, text) if rc == 0 else None
+
+        rc_text = _wait(top_clear, "cli top to exit 0 again",
+                        fast_window * 3 + 60, poll=1.0)
+        with open(os.path.join(OUT_DIR, "top_recovered.txt"), "w") as f:
+            f.write(rc_text[1])
+        st = subprocess.run(
+            [sys.executable, "-m", f"{PKG}.cli", "status",
+             "--via-fleet", f"http://127.0.0.1:{fleet_port}"],
+            capture_output=True, text=True, env=_env(), cwd=REPO,
+            timeout=60)
+        with open(os.path.join(OUT_DIR, "status_via_fleet.txt"),
+                  "w") as f:
+            f.write(st.stdout)
+        checks += [
+            ("E_cli_top_exits_0_after_recovery", rc_text[0] == 0,
+             f"cleared {round(time.time() - t0, 1)}s into the demo"),
+            ("E_status_via_fleet_renders",
+             st.returncode == 0 and "cluster:" in st.stdout
+             and "workers=" in st.stdout,
+             f"rc={st.returncode}"),
+        ]
+        print(f"phase E: top rc=0, status --via-fleet rc="
+              f"{st.returncode}", flush=True)
+
+        # -- phase F: scrape overhead on the serving primary -----------------
+        pid1 = primaries[1][0].pid
+        cpu_a0 = _proc_cpu_s(pid1)
+        time.sleep(idle_w)
+        base_cpu = _proc_cpu_s(pid1) - cpu_a0
+        probe = FleetCollector([f"127.0.0.1:{mports[1]}"],
+                               interval_s=0.1, timeout_s=2.0,
+                               registry=MetricsRegistry())
+        cpu_b0 = _proc_cpu_s(pid1)
+        t_probe = time.time()
+        n_scrapes = 0
+        while time.time() - t_probe < idle_w:
+            probe.tick()
+            n_scrapes += 1
+            time.sleep(0.1)
+        probe_cpu = _proc_cpu_s(pid1) - cpu_b0
+        per_scrape_s = max(0.0, probe_cpu - base_cpu) / max(1, n_scrapes)
+        overhead_frac = per_scrape_s / 2.0   # default observe cadence
+        checks += [
+            ("F_scrape_overhead_under_2pct", overhead_frac < 0.02,
+             f"{round(overhead_frac * 100, 3)}% of one core at 2s "
+             f"cadence ({n_scrapes} probe scrapes, "
+             f"per-scrape {round(per_scrape_s * 1e3, 2)}ms cpu)"),
+        ]
+        print(f"phase F: per-scrape {round(per_scrape_s * 1e3, 2)}ms "
+              f"primary cpu -> {round(overhead_frac * 100, 3)}% of one "
+              f"core at the default cadence", flush=True)
+
+        final_view = fleet_view()
+        summary = {
+            "demo": "fleet observatory: merged rollups, discovery, "
+                    "exemplar-linked faults, live top (ISSUE 16)",
+            "quick": quick,
+            "elapsed_seconds": round(time.time() - t0, 1),
+            "environment": {"cpus": os.cpu_count()},
+            "loadgen_clean": {k: (lg_a or {}).get(k)
+                              for k in ("fetches_ok", "fetches_err",
+                                        "qps")},
+            "clean_p99_ms": merged.get("p99_ms"),
+            "fault_p99_ms": fp99,
+            "exemplars_resolved": resolved["resolved"],
+            "scrape_overhead_pct": round(overhead_frac * 100, 4),
+            "overhead_windows": {
+                "window_s": idle_w, "probe_scrapes": n_scrapes,
+                "idle_cpu_s": round(base_cpu, 4),
+                "probed_cpu_s": round(probe_cpu, 4),
+                "per_scrape_cpu_ms": round(per_scrape_s * 1e3, 4)},
+            "final_ticks": final_view.get("ticks"),
+            "final_series_count": final_view.get("series_count"),
+        }
+    finally:
+        _stop(sup, sup_log, grace=20.0)
+        for proc, log in reversed(procs):
+            _stop(proc, log)
+
+    summary["checks"] = [{"name": n, "ok": bool(ok), "detail": d}
+                         for n, ok, d in checks]
+    summary["ok"] = all(ok for _, ok, _ in checks)
+    with open(os.path.join(OUT_DIR, "fleet_demo.json"), "w") as f:
+        json.dump(summary, f, indent=2)
+    n_pass = sum(1 for _, ok, _ in checks if ok)
+    print(f"fleet demo: {n_pass}/{len(checks)} checks PASS "
+          f"({summary['elapsed_seconds']}s)")
+    for name, ok, detail in checks:
+        print(f"  [{'PASS' if ok else 'FAIL'}] {name} — {detail}")
+    return 0 if summary["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,578 @@
+"""Fleet observatory (ISSUE 16): bucket-exact histogram merging, trace
+exemplars, the cross-process collector, and the cli surfaces over it.
+
+Everything here is tier-1: in-process HTTP servers on loopback, fake
+clocks, no accelerator, no subprocesses, no gRPC. The live multi-process
+demo assertions live in the slow recorded-demo wrapper next door.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from distributed_parameter_server_for_ml_training_tpu.analysis import (
+    extract_exemplars,
+    resolve_exemplars,
+)
+from distributed_parameter_server_for_ml_training_tpu.cli import (
+    _cluster_view_from_fleet,
+    _render_status,
+    _render_top,
+    _sparkline,
+    _top_exit_code,
+)
+from distributed_parameter_server_for_ml_training_tpu.comms.loadgen import (
+    merge_loadgen_reports,
+)
+from distributed_parameter_server_for_ml_training_tpu.telemetry import (
+    ExemplarSampler,
+    FLEET_ROLLUP_FIELDS,
+    FleetCollector,
+    LATENCY_BUCKETS,
+    MetricsRegistry,
+    histogram_quantile,
+    merge_histograms,
+    parse_prometheus_text,
+    start_fleet_server,
+)
+from distributed_parameter_server_for_ml_training_tpu.telemetry. \
+    prometheus import render_prometheus
+from distributed_parameter_server_for_ml_training_tpu.telemetry. \
+    registry import LATENCY_BUCKETS_S, Histogram
+from distributed_parameter_server_for_ml_training_tpu.telemetry.slo import (
+    default_objectives,
+)
+
+
+# -- merge_histograms: the honest-rollup property ----------------------------
+
+def _hist_of(values, buckets=LATENCY_BUCKETS):
+    h = Histogram("t", buckets=buckets)
+    for v in values:
+        h.observe(v)
+    return h.snapshot()
+
+
+def _rand_values(rng, n):
+    return [rng.choice([rng.uniform(0, 0.002), rng.uniform(0.002, 0.2),
+                        rng.uniform(0.2, 40.0), rng.uniform(40.0, 100.0)])
+            for _ in range(n)]
+
+
+def test_merge_of_shards_equals_histogram_of_union():
+    """The tentpole property: merging per-shard histograms on a pinned
+    scheme is EXACTLY the histogram of the unioned observations —
+    bucket counts, sum, count, and therefore every derivable quantile."""
+    rng = random.Random(7)
+    for buckets in (LATENCY_BUCKETS, LATENCY_BUCKETS_S):
+        shards = [_rand_values(rng, rng.randint(0, 60)) for _ in range(5)]
+        merged = merge_histograms([_hist_of(s, buckets) for s in shards])
+        union = _hist_of([v for s in shards for v in s], buckets)
+        assert merged["le"] == union["le"]
+        assert merged["counts"] == union["counts"]
+        assert merged["count"] == union["count"]
+        assert merged["sum"] == pytest.approx(union["sum"])
+        for pct in (50, 95, 99):
+            assert histogram_quantile(merged["le"], merged["counts"], pct) \
+                == histogram_quantile(union["le"], union["counts"], pct)
+
+
+def test_merge_is_associative_and_commutative():
+    rng = random.Random(11)
+    a, b, c = (_hist_of(_rand_values(rng, 40)) for _ in range(3))
+
+    def key(snap):
+        return (snap["counts"], round(snap["sum"], 9), snap["count"])
+
+    assert key(merge_histograms([merge_histograms([a, b]), c])) \
+        == key(merge_histograms([a, merge_histograms([b, c])]))
+    assert key(merge_histograms([a, b, c])) \
+        == key(merge_histograms([c, a, b]))
+
+
+def test_merge_identity_and_errors():
+    rng = random.Random(13)
+    a = _hist_of(_rand_values(rng, 25))
+    empty = _hist_of([])
+    merged = merge_histograms([a, empty])
+    assert merged["counts"] == a["counts"]
+    assert merged["count"] == a["count"]
+    with pytest.raises(ValueError):
+        merge_histograms([])
+    with pytest.raises(ValueError):  # mismatched schemes never merge
+        merge_histograms([_hist_of([0.1], LATENCY_BUCKETS),
+                          _hist_of([0.1], LATENCY_BUCKETS_S)])
+
+
+def test_merge_keeps_newest_exemplar_per_bucket():
+    a = _hist_of([0.05])
+    b = _hist_of([0.05])
+    i = next(k for k, c in enumerate(a["counts"]) if c)
+    a["exemplars"] = {str(i): {"trace_id": "old", "value": 0.05, "ts": 1.0}}
+    b["exemplars"] = {str(i): {"trace_id": "new", "value": 0.05, "ts": 2.0}}
+    merged = merge_histograms([a, b])
+    assert merged["exemplars"][str(i)]["trace_id"] == "new"
+    # order-independent: newest wins regardless of merge order
+    merged = merge_histograms([b, a])
+    assert merged["exemplars"][str(i)]["trace_id"] == "new"
+
+
+# -- exemplars at the instrument -----------------------------------------------
+
+def test_histogram_exemplar_snapshot_shape():
+    h = Histogram("t", buckets=LATENCY_BUCKETS)
+    h.observe(0.01)
+    assert "exemplars" not in h.snapshot()  # pre-exemplar shape unchanged
+    h.observe(0.01, exemplar="abc123")
+    snap = h.snapshot()
+    (idx, ex), = snap["exemplars"].items()
+    assert ex["trace_id"] == "abc123"
+    assert ex["value"] == pytest.approx(0.01)
+    assert ex["ts"] > 0
+    assert snap["counts"][int(idx)] == 2
+    h.observe(0.01, exemplar="def456")  # newest observation wins
+    assert h.snapshot()["exemplars"][idx]["trace_id"] == "def456"
+
+
+def test_exemplar_sampler_determinism():
+    sa, sb = ExemplarSampler(rate=0.25, seed=9), \
+        ExemplarSampler(rate=0.25, seed=9)
+    a = [sa.sample() for _ in range(40)]
+    b = [sb.sample() for _ in range(40)]
+    # same seed -> identical decisions; exactly 1-in-4 fire
+    assert a == b
+    assert sum(a) == 10
+    sc = ExemplarSampler(rate=0.25, seed=10)
+    c = [sc.sample() for _ in range(40)]
+    assert sum(c) == 10
+    assert a != c  # seed moves the phase
+    with pytest.raises(ValueError):
+        ExemplarSampler(rate=0.0)
+    with pytest.raises(ValueError):
+        ExemplarSampler(rate=1.5)
+
+
+# -- prometheus text round-trip ------------------------------------------------
+
+def test_parse_prometheus_text_round_trip():
+    reg = MetricsRegistry()
+    reg.counter("dps_fleet_ticks_total").inc(5)
+    reg.gauge("dps_fleet_targets").set(3.5)
+    reg.counter("dps_rpc_server_errors_total", method="Push").inc(2)
+    h = reg.histogram("dps_rpc_server_latency_seconds",
+                      buckets=LATENCY_BUCKETS, method="Fetch")
+    for v in (0.001, 0.02, 0.02, 45.0):
+        h.observe(v)
+    parsed = parse_prometheus_text(render_prometheus(reg))
+    snap = reg.snapshot()
+    assert parsed["counters"] == pytest.approx(snap["counters"])
+    assert parsed["gauges"] == pytest.approx(snap["gauges"])
+    (key, want), = snap["histograms"].items()
+    got = parsed["histograms"][key]
+    assert got["le"] == want["le"]
+    assert got["counts"] == want["counts"]  # incl. the 45.0 overflow
+    assert got["count"] == want["count"]
+    assert got["sum"] == pytest.approx(want["sum"])
+
+
+# -- the collector -------------------------------------------------------------
+
+class _FakeProc:
+    """A fake fleet process: /metrics.json + /metrics from a real
+    registry, /cluster from a settable payload (None -> 404, the
+    replica case)."""
+
+    def __init__(self, cluster=None, json_snapshot=True):
+        self.registry = MetricsRegistry()
+        self.cluster = cluster
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                path = self.path.partition("?")[0]
+                if path == "/metrics.json" and json_snapshot:
+                    body = json.dumps(outer.registry.snapshot()).encode()
+                elif path == "/metrics":
+                    body = render_prometheus(outer.registry).encode()
+                elif path == "/cluster" and outer.cluster is not None:
+                    body = json.dumps(outer.cluster).encode()
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self.server = ThreadingHTTPServer(("localhost", 0), H)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    @property
+    def target(self):
+        return f"localhost:{self.port}"
+
+    def stop(self):
+        self.server.shutdown()
+
+
+def _collector(targets, clock=None, **kw):
+    kw.setdefault("interval_s", 0.05)
+    kw.setdefault("timeout_s", 2.0)
+    kw.setdefault("registry", MetricsRegistry())
+    if clock is not None:
+        kw["clock"] = clock
+    return FleetCollector(targets, **kw)
+
+
+def test_collector_rollups_are_honest():
+    procs = [_FakeProc() for _ in range(3)]
+    try:
+        all_lat = []
+        for i, p in enumerate(procs):
+            p.registry.counter("dps_store_fetches_total",
+                               backend="python").inc(10 * (i + 1))
+            p.registry.gauge("dps_replica_step").set(float(i))
+            h = p.registry.histogram("dps_rpc_server_latency_seconds",
+                                     buckets=LATENCY_BUCKETS,
+                                     method="FetchParameters")
+            lat = [0.001 * (i + 1), 0.05 * (i + 1)]
+            for v in lat:
+                h.observe(v)
+            all_lat.extend(lat)
+        col = _collector([p.target for p in procs])
+        res = col.tick()
+        assert res["ok"] == 3 and res["failed"] == 0
+        view = col.view()
+        counters = view["rollups"]["counters"]
+        row = counters["dps_store_fetches_total{backend=python}"]
+        assert row["sum"] == 60.0 and row["targets"] == 3
+        grow = view["rollups"]["gauges"]["dps_replica_step"]
+        assert (grow["min"], grow["max"], grow["sum"]) == (0.0, 2.0, 3.0)
+        assert grow["mean"] == pytest.approx(1.0)
+        key = "dps_rpc_server_latency_seconds{method=FetchParameters}"
+        merged = view["rollups"]["histograms"][key]
+        union = _hist_of(all_lat)
+        assert merged["counts"] == union["counts"]  # bucket-exact
+        assert merged["targets"] == 3
+        for pct, pkey in ((50, "p50_ms"), (95, "p95_ms"), (99, "p99_ms")):
+            q = histogram_quantile(union["le"], union["counts"], pct)
+            assert merged[pkey] == pytest.approx(round(q * 1e3, 3))
+        # every rollup field is a documented one (the drift-pinned set)
+        for kind in ("counters", "gauges", "histograms"):
+            for r in view["rollups"][kind].values():
+                assert set(r) <= set(FLEET_ROLLUP_FIELDS)
+    finally:
+        for p in procs:
+            p.stop()
+
+
+def test_collector_counter_rates_from_rings():
+    proc = _FakeProc()
+    try:
+        c = proc.registry.counter("dps_rpc_server_calls_total", rpc="F")
+        now = [1000.0]
+        col = _collector([proc.target], clock=lambda: now[0])
+        c.inc(100)
+        col.tick()
+        now[0] += 10.0
+        c.inc(50)  # 50 events over 10s -> 5/s
+        col.tick()
+        view = col.view()
+        key = "dps_rpc_server_calls_total{rpc=F}"
+        assert view["rollups"]["counters"][key]["rate_per_s"] \
+            == pytest.approx(5.0)
+        assert view["fleet_qps"] == pytest.approx(5.0)  # QPS family
+    finally:
+        proc.stop()
+
+
+def test_collector_tolerates_dead_target_and_recovers():
+    alive, dead = _FakeProc(), _FakeProc()
+    alive.registry.counter("dps_fleet_ticks_total").inc(1)
+    dead_target = dead.target
+    dead.stop()
+    col = _collector([alive.target, dead_target], timeout_s=0.5)
+    try:
+        res = col.tick()
+        assert res["ok"] == 1 and res["failed"] == 1  # tick not blocked
+        view = col.view()
+        by_target = {t["target"]: t for t in view["targets"]}
+        assert by_target[f"http://{dead_target}"]["stale"]
+        assert not by_target[f"http://{alive.target}"]["stale"]
+        # stale target excluded from rollups; error series minted
+        assert view["rollups"]["counters"][
+            "dps_fleet_ticks_total"]["targets"] == 1
+        errs = col.registry.snapshot()["counters"]
+        key = ("dps_fleet_scrape_errors_total"
+               f"{{target=http://{dead_target}}}")
+        assert errs[key] == 1.0
+    finally:
+        alive.stop()
+
+
+def test_collector_discovery_adopts_and_drains_replicas():
+    replica = _FakeProc()  # no /cluster: a real replica has no monitor
+    replica.registry.counter("dps_replica_fetches_total").inc(4)
+    primary = _FakeProc(cluster={
+        "role": "server", "pid": 1, "mode": "async", "global_step": 7,
+        "workers": [], "alerts": [], "alerts_total": {},
+        "sharding": {"shard_id": 0, "shard_count": 1, "map_version": 1,
+                     "replicas": [{"address": "localhost:9", "step": 7,
+                                   "lag_steps": 0,
+                                   "metrics": replica.target}]}})
+    col = _collector([primary.target])
+    try:
+        col.tick()  # scrape primary -> adopt the announced replica
+        col.tick()  # scrape the replica itself
+        view = col.view()
+        by_target = {t["target"]: t for t in view["targets"]}
+        rep_row = by_target[f"http://{replica.target}"]
+        assert not rep_row["explicit"]
+        assert rep_row["discovered_from"] == f"http://{primary.target}"
+        assert rep_row["ok"]
+        assert view["rollups"]["counters"][
+            "dps_replica_fetches_total"]["sum"] == 4.0
+        assert view["tiers"]["replicas"][0]["via"] \
+            == f"http://{primary.target}"
+        # drain: kill the replica (mints the error series), then stop
+        # announcing it -> state dropped AND the error series removed
+        replica.stop()
+        col.tick()
+        key = ("dps_fleet_scrape_errors_total"
+               f"{{target=http://{replica.target}}}")
+        assert key in col.registry.snapshot()["counters"]
+        primary.cluster["sharding"]["replicas"] = []
+        col.tick()
+        view = col.view()
+        assert f"http://{replica.target}" not in \
+            {t["target"] for t in view["targets"]}
+        assert key not in col.registry.snapshot()["counters"]
+    finally:
+        primary.stop()
+
+
+def test_collector_text_fallback_for_older_builds():
+    proc = _FakeProc(json_snapshot=False)  # 404s /metrics.json
+    proc.registry.counter("dps_store_fetches_total", backend="p").inc(3)
+    col = _collector([proc.target])
+    try:
+        assert col.tick()["ok"] == 1
+        assert col.view()["rollups"]["counters"][
+            "dps_store_fetches_total{backend=p}"]["sum"] == 3.0
+    finally:
+        proc.stop()
+
+
+def test_collector_fleet_slo_breach_over_merged_series():
+    """The union-only breach: each shard individually under the
+    min-events radar would still merge into a breaching fleet series;
+    here both shards serve pure-bad latency and the fleet-scope
+    slo_burn_fast fires after one tick (no baseline -> cumulative
+    counts ARE the window delta, the slo.py discipline)."""
+    procs = [_FakeProc() for _ in range(2)]
+    try:
+        for p in procs:
+            h = p.registry.histogram("dps_rpc_server_latency_seconds",
+                                     buckets=LATENCY_BUCKETS,
+                                     method="FetchParameters")
+            for _ in range(10):
+                h.observe(0.5)  # way over the 100 ms objective
+        col = _collector([p.target for p in procs],
+                         objectives=default_objectives())
+        col.tick()
+        slo = col.view()["slo"]
+        assert slo["scope"] == "fleet"
+        breaches = {(b["rule"], b["objective"]) for b in slo["breaches"]}
+        assert ("slo_burn_fast", "fetch_latency") in breaches
+        fl = next(o for o in slo["objectives"]
+                  if o["name"] == "fetch_latency")
+        assert fl["total"] == 20  # merged across both shards
+        assert fl["windows"]["slo_burn_fast"]["breaching"]
+    finally:
+        for p in procs:
+            p.stop()
+
+
+def test_fleet_http_surface():
+    proc = _FakeProc()
+    proc.registry.counter("dps_store_fetches_total", backend="p").inc(1)
+    col = _collector([proc.target])
+    server, port = start_fleet_server(col, port=0, addr="localhost")
+    try:
+        col.tick()
+        view = json.loads(urllib.request.urlopen(
+            f"http://localhost:{port}/fleet", timeout=5).read())
+        assert view["ticks"] == 1
+        assert view["scrape"]["targets_scraped"] == 1
+        assert view["series_count"] >= 1
+        # the collector's own instruments are scrapeable
+        text = urllib.request.urlopen(
+            f"http://localhost:{port}/metrics", timeout=5).read().decode()
+        assert "dps_fleet_ticks_total 1" in text
+    finally:
+        server.shutdown()
+        proc.stop()
+
+
+# -- loadgen report merging ----------------------------------------------------
+
+def _report(lat, qps):
+    return {"targets": ["a"], "mode": "full", "concurrency": 2,
+            "duration_s": 1.5, "fetches_ok": len(lat), "fetches_err": 1,
+            "not_modified": 0, "bytes_in": 100, "qps": qps,
+            "mb_per_s": 1.0, "latency_hist": _hist_of(lat)}
+
+
+def test_merge_loadgen_reports_union_percentiles():
+    rng = random.Random(17)
+    # keep every observation under the top bucket edge so p99 has a
+    # finite bound (overflow coverage lives in the property test above)
+    shards = [[rng.uniform(0.001, 5.0) for _ in range(50)]
+              for _ in range(3)]
+    merged = merge_loadgen_reports(
+        [_report(s, 10.0 * (i + 1)) for i, s in enumerate(shards)])
+    assert merged["reports"] == 3
+    assert merged["qps"] == pytest.approx(60.0)
+    assert merged["fetches_ok"] == sum(len(s) for s in shards)
+    assert merged["fetches_err"] == 3
+    assert merged["duration_s"] == 1.5
+    union = _hist_of([v for s in shards for v in s])
+    assert merged["latency_hist"]["counts"] == union["counts"]
+    q99 = histogram_quantile(union["le"], union["counts"], 99)
+    assert merged["latency_ms"]["p99"] == pytest.approx(
+        round(q99 * 1e3, 3))
+    with pytest.raises(ValueError):
+        merge_loadgen_reports([])
+    legacy = _report([0.01], 1.0)
+    del legacy["latency_hist"]
+    with pytest.raises(ValueError):
+        merge_loadgen_reports([legacy])
+
+
+# -- exemplar -> flight-recorder join ------------------------------------------
+
+def _fleet_view_with_exemplar(trace_id, value=0.25):
+    snap = _hist_of([value])
+    idx = next(i for i, c in enumerate(snap["counts"]) if c)
+    snap["exemplars"] = {str(idx): {"trace_id": trace_id,
+                                    "value": value, "ts": 5.0}}
+    return {"rollups": {"histograms": {
+        "dps_rpc_server_latency_seconds{method=FetchParameters}": snap}}}
+
+
+def test_extract_exemplars_sorted_and_filtered():
+    view = _fleet_view_with_exemplar("t1")
+    rows = extract_exemplars(view)
+    assert len(rows) == 1
+    assert rows[0]["trace_id"] == "t1"
+    assert rows[0]["value"] == pytest.approx(0.25)
+    assert rows[0]["le"] >= 0.25
+    assert extract_exemplars(view, min_value_s=0.5) == []
+    assert extract_exemplars(view, series_prefix="dps_replica") == []
+
+
+def test_resolve_exemplars_against_trace_dumps(tmp_path):
+    dump = {"spans": [
+        {"name": "rpc.server", "trace_id": "t1", "span_id": "s1",
+         "parent_id": None, "ts": 4.9, "dur": 0.25},
+        {"name": "store.fetch", "trace_id": "t1", "span_id": "s2",
+         "parent_id": "s1", "ts": 4.95, "dur": 0.1},
+    ]}
+    (tmp_path / "trace-server-1-sigterm.json").write_text(
+        json.dumps(dump))
+    out = resolve_exemplars(_fleet_view_with_exemplar("t1"),
+                            dump_dir=str(tmp_path))
+    assert out["resolved"] == 1 and out["unresolved"] == 0
+    assert out["exemplars"][0]["span_count"] == 2
+    assert out["traces"]["t1"]["span_count"] == 2
+    miss = resolve_exemplars(_fleet_view_with_exemplar("unknown"),
+                             dump_dir=str(tmp_path))
+    assert miss["resolved"] == 0 and miss["unresolved"] == 1
+
+
+# -- cli surfaces --------------------------------------------------------------
+
+def _top_view(**over):
+    view = {
+        "ts": 1.0, "ticks": 3, "fleet_qps": 10.0, "series_count": 5,
+        "scrape": {"last_ms": 2.0, "targets_scraped": 1},
+        "history": {"fleet_qps": [1, 2], "p99_ms": [None, 3.0],
+                    "scrape_ms": [2.0, 2.0]},
+        "targets": [{"target": "http://a", "ok": True}],
+        "tiers": {"primaries": [{"target": "http://a", "ok": True,
+                                 "mode": "async", "global_step": 4,
+                                 "alerts": 0}],
+                  "replicas": [], "workers": [], "jobs": {}},
+        "slo": {"objectives": [], "breaches": [], "scope": "fleet"},
+        "alerts": [], "remediation_active": False,
+    }
+    view.update(over)
+    return view
+
+
+def test_render_top_and_exit_codes():
+    healthy = _top_view()
+    text = _render_top(healthy)
+    assert "fleet: targets 1/1 up" in text
+    assert "no active alerts" in text
+    assert _top_exit_code(healthy) == 0
+    crit = _top_view(alerts=[{"rule": "r", "severity": "critical",
+                              "message": "m", "target": "http://a"}])
+    assert _top_exit_code(crit) == 2
+    assert "[CRIT]" in _render_top(crit)
+    healing = _top_view(alerts=crit["alerts"], remediation_active=True)
+    assert _top_exit_code(healing) == 3
+    burn = _top_view(slo={"objectives": [], "scope": "fleet",
+                          "breaches": [{"rule": "slo_burn_fast",
+                                        "severity": "critical"}]})
+    assert _top_exit_code(burn) == 2
+    stale = _top_view(targets=[{"target": "http://a", "ok": False,
+                                "consecutive_failures": 2,
+                                "last_error": "refused"}])
+    assert "stale targets:" in _render_top(stale)
+
+
+def test_sparkline():
+    assert _sparkline([]) == ""
+    assert _sparkline([5, 5, 5]) == "▁▁▁"
+    line = _sparkline([0, 1, 2, None, 3])
+    assert len(line) == 4  # None samples skipped
+    assert line[0] == "▁" and line[-1] == "█"
+
+
+def test_cluster_view_from_fleet_degradation_pinned():
+    """The --via-fleet synthesis renders through the UNCHANGED
+    _render_status: a minimal fleet view (no slo, no jobs, no workers)
+    must degrade exactly like an older /cluster payload."""
+    fleet = _top_view(
+        alerts=[{"rule": "r", "severity": "warning", "message": "m",
+                 "worker": None, "target": "http://a"}],
+        tiers={"primaries": [{"target": "http://a", "ok": True,
+                              "mode": "async", "global_step": 4,
+                              "alerts": 1}],
+               "replicas": [],
+               "workers": [{"worker": 0, "alive": True, "step": 2,
+                            "via": "http://a"}],
+               "jobs": {}})
+    del fleet["slo"]
+    view = _cluster_view_from_fleet(fleet)
+    assert view["mode"] == "async" and view["global_step"] == 4
+    assert view["alerts_total"] == {"critical": 0, "warning": 1,
+                                    "info": 0}
+    assert "slo" not in view and "jobs" not in view
+    text = _render_status(view)  # renders without any fleet-only block
+    assert "workers=1" in text
+    assert "[WARN]" in text
+    empty = _cluster_view_from_fleet({})
+    assert _render_status(empty)  # fully-degraded payload still renders

@@ -1,0 +1,51 @@
+"""Slow wrapper: the recorded fleet-observatory demo must pass live.
+
+Runs ``experiments/run_fleet_demo.py --quick`` as a subprocess — a real
+2-primary + 2-replica + supervised-worker cluster under loadgen with a
+standalone ``cli observe`` process — and asserts every recorded check:
+bucket-exact merged rollups, replica discovery, stale-target tolerance,
+the exemplar-linked fault spike, ``cli top`` exit codes, and the scrape
+overhead bound (ISSUE 16 acceptance).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_fleet_demo_quick(tmp_path):
+    script = os.path.join(REPO, "experiments", "run_fleet_demo.py")
+    cp = subprocess.run(
+        [sys.executable, script, "--quick", "--out-dir", str(tmp_path)],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=REPO,
+        capture_output=True, text=True, timeout=900)
+    assert cp.returncode == 0, \
+        f"demo failed\nstdout:\n{cp.stdout}\nstderr:\n{cp.stderr}"
+    with open(tmp_path / "fleet_demo.json") as f:
+        summary = json.load(f)
+    assert summary["ok"], summary["checks"]
+    by_name = {c["name"]: c["ok"] for c in summary["checks"]}
+    assert by_name["A_merged_histogram_bucket_exact"]
+    assert by_name["A_fleet_percentiles_equal_union_percentiles"]
+    assert by_name["B_replicas_adopted_from_sharding_views"]
+    assert by_name["C_dead_target_marked_stale"]
+    assert by_name["C_tick_uninterrupted_others_fresh"]
+    assert by_name["D_fleet_scope_burn_breach_fires"]
+    assert by_name["D_exemplar_resolves_to_flight_recorder_trace"]
+    assert by_name["D_cli_top_exits_2_during_fault"]
+    assert by_name["E_cli_top_exits_0_after_recovery"]
+    assert by_name["F_scrape_overhead_under_2pct"]
+    # the acceptance artifacts were all recorded
+    for name in ("fleet_snapshot_clean.json", "fleet_snapshot_fault.json",
+                 "exemplar_resolution.json", "top_fault.txt",
+                 "top_recovered.txt", "status_via_fleet.txt"):
+        assert (tmp_path / name).exists(), name

@@ -317,6 +317,16 @@ def check_job_spec_fields(ctx: DriftContext) -> list[Finding]:
                         "### Job spec fields", "job spec field")
 
 
+def check_fleet_rollup_fields(ctx: DriftContext) -> list[Finding]:
+    """FLEET_ROLLUP_FIELDS pinned to docs/OBSERVABILITY.md's rollup-
+    semantics table — a ``/fleet`` rollup field cannot appear without
+    documented merge semantics, or stay documented after removal."""
+    return _table_check(ctx, "fleet-rollup-field",
+                        f"{_PKG}/telemetry/fleet.py",
+                        "FLEET_ROLLUP_FIELDS", "docs/OBSERVABILITY.md",
+                        "### Rollup semantics", "fleet rollup field")
+
+
 def check_meta_keys(ctx: DriftContext) -> list[Finding]:
     """META_KEY_CATALOG pinned to docs/WIRE_PROTOCOL.md's envelope-meta
     table — a wire field cannot be cataloged without being documented,
@@ -348,6 +358,7 @@ CHECKS = {
     "op-classes": check_op_classes,
     "job-spec-fields": check_job_spec_fields,
     "meta-keys": check_meta_keys,
+    "fleet-rollup-fields": check_fleet_rollup_fields,
 }
 
 
