@@ -265,6 +265,66 @@ def fleet_probe(ticks: int = 3) -> dict:
     return out
 
 
+def fanout_probe(duration_s: float = 0.75, concurrency: int = 4) -> dict:
+    """Fan-out-tree companion fields (ISSUE 17): a two-tier in-process
+    chain (primary -> interior replica -> edge replica) under a short
+    delta-poll storm — ``tree_depth`` (edge tier reached), ``fanout_qps``
+    (edge-served delta QPS), ``coalesce_ratio`` (edge coalesced/polls).
+    A miniature, not the drill: the depth-3 multi-process numbers live in
+    experiments/results/fanout/. Failure-hardened nulls like the other
+    probes — never a cost to the throughput record."""
+    import numpy as np
+
+    from distributed_parameter_server_for_ml_training_tpu.comms.loadgen \
+        import run_loadgen
+    from distributed_parameter_server_for_ml_training_tpu.comms.replica \
+        import ReplicaServer
+    from distributed_parameter_server_for_ml_training_tpu.comms.service \
+        import ParameterService, serve
+    from distributed_parameter_server_for_ml_training_tpu.ps.store import (
+        ParameterStore, StoreConfig)
+
+    out = {"tree_depth": None, "coalesce_ratio": None, "fanout_qps": None}
+    server = interior = edge = None
+    try:
+        params = {f"layer{i}/kernel": np.zeros((256, 64), np.float32)
+                  for i in range(8)}
+        store = ParameterStore(
+            params, StoreConfig(mode="async", total_workers=1))
+        server, port = serve(store, port=0,
+                             service=ParameterService(store))
+        interior = ReplicaServer(f"localhost:{port}", port=0,
+                                 poll_interval=0.05)
+        iport = interior.start()
+        edge = ReplicaServer(f"localhost:{port}", port=0,
+                             poll_interval=0.05,
+                             parent=f"localhost:{iport}")
+        eport = edge.start()
+        deadline = time.time() + 5.0
+        while time.time() < deadline and not edge.view()["synced"]:
+            time.sleep(0.02)
+        res = run_loadgen([f"localhost:{eport}"], duration_s=duration_s,
+                          concurrency=concurrency, mode="delta")
+        view = edge.view()
+        out = {"tree_depth": int(view.get("tier") or 1),
+               "coalesce_ratio": round(
+                   view["coalesced"] / max(1, view["polls"]), 3),
+               "fanout_qps": res["qps"]}
+    except Exception as e:  # noqa: BLE001 — probe is best-effort
+        print(f"fanout probe failed (recording nulls): {e}",
+              file=sys.stderr)
+    finally:
+        for rep in (edge, interior):
+            if rep is not None:
+                try:
+                    rep.stop()
+                except Exception:  # noqa: BLE001 — teardown best-effort
+                    pass
+        if server is not None:
+            server.stop(grace=0.2)
+    return out
+
+
 def lint_probe() -> dict:
     """Static-analysis companion fields: ``lint_clean`` (did the tree
     pass dpslint — live findings or a stale baseline mean False) and
@@ -524,6 +584,15 @@ def run_bench(args) -> dict:
         if not getattr(args, "no_fleet_probe", False):
             fleet_fields = fleet_probe()
 
+        # Fan-out-tree attribution (ISSUE 17): what a two-tier replica
+        # chain serves and coalesces in-process, so BENCH_r* rounds can
+        # attribute tree-serve wins separately from the flat serve path.
+        stage = "fanout_probe"
+        fanout_fields = {"tree_depth": None, "coalesce_ratio": None,
+                         "fanout_qps": None}
+        if not getattr(args, "no_fanout_probe", False):
+            fanout_fields = fanout_probe()
+
         result = {
             "metric": "cifar100_resnet18_train_images_per_sec_per_chip",
             "value": round(per_chip, 1),
@@ -574,6 +643,8 @@ def run_bench(args) -> dict:
             **codec_fields,
             # Fleet-observatory attribution (ISSUE 16): see fleet_probe.
             **fleet_fields,
+            # Fan-out-tree attribution (ISSUE 17): see fanout_probe.
+            **fanout_fields,
         }
         # Static-analysis attribution (ISSUE 10 satellite): whether the
         # tree this number was measured from passed dpslint, and what the
@@ -619,6 +690,10 @@ def main() -> int:
     parser.add_argument("--no-codec-probe", action="store_true",
                         help="skip the device-codec probe (codec_* "
                              "fields recorded as null)")
+    parser.add_argument("--no-fanout-probe", action="store_true",
+                        help="skip the two-tier replica fan-out probe "
+                             "(tree_depth/coalesce_ratio/fanout_qps "
+                             "record nulls)")
     parser.add_argument("--no-fleet-probe", action="store_true",
                         help="skip the fleet-collector probe (fleet_* "
                              "fields recorded as null)")

@@ -369,6 +369,16 @@ def build_parser() -> argparse.ArgumentParser:
                         "(hysteresis band with --autoscale-qps-high)")
     s.add_argument("--autoscale-cooldown", type=float, default=10.0,
                    help="minimum seconds between scaling actions")
+    s.add_argument("--autoscale-max-tier", type=int, default=1,
+                   help="deepest tier a grown replica may land at "
+                        "(docs/SHARDING.md \"Fan-out trees\"): 1 = flat "
+                        "star (every replica under the primary); >1 "
+                        "spawns under the hottest eligible interior "
+                        "node")
+    s.add_argument("--autoscale-fanout", type=int, default=2,
+                   help="per-node child budget when growing a tree — a "
+                        "node already feeding this many children stops "
+                        "being an eligible parent")
     s.add_argument("--autoscale-dry-run", action="store_true",
                    help="decide and record scaling actions without "
                         "spawning or retiring anything")
@@ -578,7 +588,13 @@ def build_parser() -> argparse.ArgumentParser:
              "writes to the primary")
     r.add_argument("--primary", required=True,
                    help="address (host:port) of the shard primary this "
-                        "replica mirrors")
+                        "replica mirrors (writes always redirect here)")
+    r.add_argument("--parent", default=None,
+                   help="subscribe source when different from the "
+                        "primary — point it at ANOTHER replica to form "
+                        "a fan-out tree (docs/SHARDING.md \"Fan-out "
+                        "trees\"); the tier is learned from the "
+                        "parent's replies")
     r.add_argument("--port", type=int, default=_env("DPS_PORT", 0, int),
                    help="replica serve port (0 = pick a free port)")
     r.add_argument("--shard-id", type=int, default=0,
@@ -598,10 +614,21 @@ def build_parser() -> argparse.ArgumentParser:
                    help="seconds between delta-fetch refreshes against "
                         "the primary (NOT_MODIFIED when idle)")
     r.add_argument("--staleness-bound", type=float,
-                   default=_env("DPS_REPLICA_STALENESS", 5.0, float),
+                   default=_env("DPS_REPLICA_STALENESS", None, float),
                    help="max seconds since the last successful refresh "
                         "before fetches are refused with a redirect to "
-                        "the primary")
+                        "the primary (default: derived from the tier — "
+                        "5s x tier, so edge tiers tolerate "
+                        "proportionally more lag)")
+    r.add_argument("--reparent-after", type=int, default=3,
+                   help="consecutive refresh failures before this "
+                        "replica re-parents via the cached topology "
+                        "(prefer the dead parent's tier, fall back to "
+                        "the primary)")
+    r.add_argument("--reparent-cooldown", type=float, default=5.0,
+                   help="hysteresis: minimum seconds between re-parent "
+                        "moves, so a flapping parent cannot make "
+                        "children ricochet around the tree")
     r.add_argument("--canary", action="store_true",
                    help="serve the canary-gated inference workload "
                         "(docs/SHARDING.md \"Serve tier\"): keep a step "
@@ -650,6 +677,13 @@ def build_parser() -> argparse.ArgumentParser:
                          "steady state); infer = the inference-serving "
                          "workload against a canary replica, with "
                          "per-arm counts/latency/quality in the result")
+    lg.add_argument("--scale-out", type=int, default=0,
+                    help="distributed generation: launch N coordinated "
+                         "generator PROCESSES (each running this exact "
+                         "workload) and print ONE merged LOADGEN_JSON — "
+                         "percentiles come from the bucket-exact "
+                         "histogram union, never averaged (0 = run "
+                         "in-process, the default)")
 
     rs = sub.add_parser(
         "reshard",
@@ -1327,8 +1361,8 @@ def _cmd_serve(args) -> int:
         primary_addr = f"localhost:{port}"
         replica_args = ["--shard-id", str(shard_index)]
         pool = ReplicaPool(
-            lambda idx: build_replica_argv(primary_addr, replica_args,
-                                           idx))
+            lambda idx, parent=None: build_replica_argv(
+                primary_addr, replica_args, idx, parent=parent))
         monitor.autoscaler = ReplicaAutoscaler(
             pool,
             AutoscalePolicy(
@@ -1337,11 +1371,14 @@ def _cmd_serve(args) -> int:
                 min_replicas=getattr(args, "autoscale_min", 0),
                 max_replicas=getattr(args, "autoscale_max", 4),
                 cooldown_s=getattr(args, "autoscale_cooldown", 10.0),
+                max_tier=getattr(args, "autoscale_max_tier", 1),
+                fanout=getattr(args, "autoscale_fanout", 2),
                 dry_run=bool(getattr(args, "autoscale_dry_run", False))),
             sharding=sharding)
         print(f"autoscale: on (replicas "
               f"{monitor.autoscaler.policy.min_replicas}.."
               f"{monitor.autoscaler.policy.max_replicas}, "
+              f"max_tier={monitor.autoscaler.policy.max_tier}, "
               f"dry_run={monitor.autoscaler.policy.dry_run})",
               file=sys.stderr, flush=True)
     print(f"parameter server up on :{port} "
@@ -1580,6 +1617,75 @@ def _cmd_supervise(args) -> int:
             scaler_thread.join(timeout=5.0)
 
 
+def _replica_tree_lines(sh: dict, indent: str = "  ") -> list[str]:
+    """Render a sharding block's replica rows as the fan-out tree
+    (docs/SHARDING.md "Fan-out trees"): children indent under their
+    parent with tier + lag, depth-first in address order. Rows whose
+    parent is neither a live replica nor a primary render under an
+    explicit ``orphaned`` header naming the gone parent — a killed or
+    stale interior node shows its stranded children instead of
+    flattening them away. Pre-tree rows (no ``parent``/``tier``) all
+    root at the primary, reproducing the old flat listing."""
+    rows = sh.get("replicas", []) or []
+    primaries = set(sh.get("primaries", []) or [])
+    by_addr = {r.get("address"): r for r in rows if r.get("address")}
+    children: dict[str, list] = {}
+    roots, orphans = [], {}
+    for r in rows:
+        parent = r.get("parent")
+        if parent is None or parent in primaries:
+            roots.append(r)
+        elif parent in by_addr:
+            children.setdefault(parent, []).append(r)
+        else:
+            orphans.setdefault(parent, []).append(r)
+
+    def row_line(r: dict, depth: int) -> str:
+        qps = r.get("fetch_qps")
+        return (f"{indent}{'  ' * depth}replica {r.get('address')}"
+                + (f" [tier {r['tier']}]" if "tier" in r else "")
+                + f": step={r.get('step')} "
+                f"lag={r.get('lag_steps')} step(s), "
+                f"announced {r.get('announce_age_s', 0):.1f}s ago"
+                + (f", {qps:g} fetch/s" if qps else "")
+                + (f" (via {r['via']})" if "via" in r else ""))
+
+    lines: list[str] = []
+
+    def walk(r: dict, depth: int, seen: set) -> None:
+        addr = r.get("address")
+        if addr in seen:  # defensive: a cyclic view must not hang
+            return
+        seen.add(addr)
+        lines.append(row_line(r, depth))
+        for c in sorted(children.get(addr, []),
+                        key=lambda x: str(x.get("address"))):
+            walk(c, depth + 1, seen)
+
+    seen: set = set()
+    for r in sorted(roots, key=lambda x: str(x.get("address"))):
+        walk(r, 0, seen)
+    # Subtrees hanging off a live interior node already walked above;
+    # whatever never got visited hangs off a DEAD parent — show it.
+    for parent in sorted(orphans):
+        stranded = [r for r in orphans[parent]
+                    if r.get("address") not in seen]
+        if not stranded:
+            continue
+        lines.append(f"{indent}orphaned (parent {parent} gone):")
+        for r in sorted(stranded, key=lambda x: str(x.get("address"))):
+            walk(r, 1, seen)
+    tiers = sh.get("tiers") or {}
+    if any("tier" in r for r in rows) and tiers:
+        roll = "; ".join(
+            f"tier {t}: {v.get('replicas', 0)} replica(s), "
+            f"max_lag={v.get('max_lag_steps', 0)}, "
+            f"{v.get('fetch_qps', 0):g} fetch/s"
+            for t, v in sorted(tiers.items(), key=lambda kv: kv[0]))
+        lines.append(f"{indent}tiers: {roll}")
+    return lines
+
+
 def _render_status(view: dict) -> str:
     """The ``cli status`` terminal dashboard: cluster header, per-worker
     table, active alerts. Pure text in, text out (tested directly)."""
@@ -1694,12 +1800,7 @@ def _render_status(view: dict) -> str:
                      f"/{sh.get('shard_count', '?')} "
                      f"map_version={sh.get('map_version', '?')} "
                      f"replicas={len(sh.get('replicas', []))}")
-        for rep in sh.get("replicas", []):
-            lines.append(
-                f"  replica {rep.get('address')}: "
-                f"step={rep.get('step')} "
-                f"lag={rep.get('lag_steps')} step(s), "
-                f"announced {rep.get('announce_age_s', 0):.1f}s ago")
+        lines.extend(_replica_tree_lines(sh))
         mig = sh.get("migration")
         if mig:
             # In-flight migration ledger (docs/ROBUSTNESS.md "Migration
@@ -2016,15 +2117,19 @@ def _render_top(view: dict) -> str:
                 f"{'up' if row.get('ok') else 'STALE'} "
                 f"mode={row.get('mode')} step={row.get('global_step')}"
                 f"{shard} alerts={row.get('alerts', 0)}")
-    reps = (view.get("tiers") or {}).get("replicas") or []
+    tier_view = view.get("tiers") or {}
+    reps = tier_view.get("replicas") or []
     if reps:
         lines.append("")
         lines.append("replicas:")
-        for rep in reps:
-            lines.append(
-                f"  {rep.get('address')}: step={rep.get('step')} "
-                f"lag={rep.get('lag_steps')} step(s) "
-                f"(via {rep.get('via')})")
+        # Reuse the fan-out-tree renderer on the fleet rows: primaries
+        # here must be gRPC addresses (the rows' ``parent`` namespace),
+        # not the scrape targets the fleet polls.
+        lines.extend(_replica_tree_lines({
+            "replicas": reps,
+            "primaries": tier_view.get("primary_addresses") or [],
+            "tiers": tier_view.get("replica_tiers") or {},
+        }))
     workers = (view.get("tiers") or {}).get("workers") or []
     if workers:
         lines.append("")
@@ -2194,11 +2299,17 @@ def _cmd_replica(args) -> int:
                             args, "canary_min_samples", 20),
                         canary_tolerance=getattr(args, "canary_tolerance",
                                                  0.0),
-                        faults=getattr(args, "faults", None))
+                        faults=getattr(args, "faults", None),
+                        parent=getattr(args, "parent", None),
+                        reparent_after=getattr(args, "reparent_after", 3),
+                        reparent_cooldown_s=getattr(
+                            args, "reparent_cooldown", 5.0))
     port = rep.start()
     print(f"replica up on :{port} (primary={args.primary}, "
+          f"parent={rep.parent}, tier={rep.tier}, "
           f"shard={args.shard_id}, "
-          f"staleness_bound={args.staleness_bound:g}s"
+          f"staleness_bound={rep.staleness_bound_s:g}s"
+          + ("" if args.staleness_bound is not None else " (tier-derived)")
           + (f", canary=1/{rep.canary.period}" if rep.canary is not None
              else "")
           + ")", file=sys.stderr, flush=True)
@@ -2215,19 +2326,30 @@ def _cmd_replica(args) -> int:
 def cmd_loadgen(args) -> int:
     import json as _json
 
-    from .comms.loadgen import run_loadgen
+    from .comms.loadgen import run_loadgen, run_loadgen_scaled
 
-    result = run_loadgen(args.targets, duration_s=args.duration,
-                         concurrency=args.concurrency,
-                         mode=args.fetch_mode,
-                         job=getattr(args, "job", None))
+    scale_out = int(getattr(args, "scale_out", 0) or 0)
+    if scale_out > 0:
+        result = run_loadgen_scaled(args.targets,
+                                    duration_s=args.duration,
+                                    concurrency=args.concurrency,
+                                    mode=args.fetch_mode,
+                                    job=getattr(args, "job", None),
+                                    scale_out=scale_out)
+    else:
+        result = run_loadgen(args.targets, duration_s=args.duration,
+                             concurrency=args.concurrency,
+                             mode=args.fetch_mode,
+                             job=getattr(args, "job", None))
     print("LOADGEN_JSON " + _json.dumps(result), flush=True)
     lat = result["latency_ms"]
     print(f"{result['qps']:.1f} fetch/s aggregate over "
           f"{len(result['targets'])} target(s) "
           f"({result['fetches_err']} errors, "
           f"{result['mb_per_s']:.2f} MB/s in, latency p50/p95/p99 "
-          f"{lat['p50']:g}/{lat['p95']:g}/{lat['p99']:g} ms)",
+          f"{lat['p50']:g}/{lat['p95']:g}/{lat['p99']:g} ms)"
+          + (f" [merged from {result.get('reports')} generator "
+             f"processes]" if scale_out > 0 else ""),
           file=sys.stderr)
     for arm, row in (result.get("arms") or {}).items():
         print(f"  arm={arm}: {row['ok']} served, "

@@ -28,6 +28,9 @@ Modes:
 
 from __future__ import annotations
 
+import json
+import subprocess
+import sys
 import threading
 import time
 
@@ -38,7 +41,12 @@ from ..telemetry.stats import histogram_quantile, merge_histograms
 from ..telemetry.stats import latency_summary as _latency_summary
 from .service import GRPC_OPTIONS, SERVICE_NAME, pack_msg, unpack_msg
 
-__all__ = ["merge_loadgen_reports", "run_loadgen"]
+__all__ = ["loadgen_child_argv", "merge_loadgen_reports",
+           "parse_loadgen_json", "run_loadgen", "run_loadgen_scaled"]
+
+#: The machine-readable line ``cli loadgen`` prints (and the scale-out
+#: parent greps from each child's stdout).
+LOADGEN_JSON_PREFIX = "LOADGEN_JSON "
 
 
 def _latency_hist(lat_s: list) -> dict:
@@ -288,3 +296,94 @@ def run_loadgen(targets, duration_s: float = 5.0, concurrency: int = 4,
                 "serving_steps": sorted(r["steps"])}
             for a, r in arms.items()}
     return result
+
+
+def loadgen_child_argv(targets, duration_s: float, concurrency: int,
+                       mode: str, job=None,
+                       python: str | None = None) -> list[str]:
+    """One scale-out child's command line: a plain ``cli loadgen``
+    invocation (no ``--scale-out`` — children never recurse). Pure, so
+    tests pin the fan-out contract without spawning anything."""
+    if isinstance(targets, str):
+        targets = [t for t in targets.split(",") if t]
+    pkg = __name__.rsplit(".", 2)[0]
+    argv = [python or sys.executable, "-m", f"{pkg}.cli", "loadgen",
+            "--targets", ",".join(targets),
+            "--duration", str(float(duration_s)),
+            "--concurrency", str(int(concurrency)),
+            "--fetch-mode", str(mode)]
+    if job:
+        argv += ["--job", str(job)]
+    return argv
+
+
+def parse_loadgen_json(text: str) -> dict | None:
+    """Extract the LOADGEN_JSON report from one generator's stdout
+    (last match wins — logs may precede it). None when absent or
+    garbled: the scale-out parent drops that child from the merge and
+    says so, instead of averaging in junk."""
+    found = None
+    for line in str(text).splitlines():
+        if line.startswith(LOADGEN_JSON_PREFIX):
+            try:
+                found = json.loads(line[len(LOADGEN_JSON_PREFIX):])
+            except ValueError:
+                continue
+        # tolerate prefixed wrapping (e.g. a supervisor log line)
+        elif LOADGEN_JSON_PREFIX in line:
+            try:
+                found = json.loads(
+                    line.split(LOADGEN_JSON_PREFIX, 1)[1])
+            except ValueError:
+                continue
+    return found if isinstance(found, dict) else None
+
+
+def run_loadgen_scaled(targets, duration_s: float = 5.0,
+                       concurrency: int = 4, mode: str = "full",
+                       job=None, scale_out: int = 2,
+                       rpc_timeout: float = 10.0,
+                       python: str | None = None, spawn=None) -> dict:
+    """Distributed load generation (docs/SHARDING.md "Fan-out trees"):
+    launch ``scale_out`` coordinated generator PROCESSES (each a plain
+    ``cli loadgen`` with ``concurrency`` threads), then merge their
+    LOADGEN_JSON reports through :func:`merge_loadgen_reports` — the
+    merged percentiles come from the bucket-exact histogram union, never
+    from averaging per-process percentiles. One process behaves exactly
+    like :func:`run_loadgen` plus the subprocess overhead; the fan-out
+    exists so a single GIL-bound generator stops being the thing the
+    measurement saturates. ``spawn(argv) -> Popen-like`` is injectable
+    for tests. Raises ``RuntimeError`` when no child produced a report.
+    """
+    n = max(1, int(scale_out))
+    argv = loadgen_child_argv(targets, duration_s, concurrency, mode,
+                              job=job, python=python)
+    if spawn is None:
+        def spawn(a):  # pragma: no cover — exercised by the slow drill
+            return subprocess.Popen(a, stdout=subprocess.PIPE,
+                                    stderr=subprocess.STDOUT, text=True)
+    procs = [spawn(list(argv)) for _ in range(n)]
+    reports, failed = [], 0
+    deadline = time.monotonic() + float(duration_s) + 8 * rpc_timeout
+    for p in procs:
+        try:
+            out, _ = p.communicate(
+                timeout=max(1.0, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+        report = parse_loadgen_json(out or "")
+        if report is None:
+            failed += 1
+        else:
+            reports.append(report)
+    if not reports:
+        raise RuntimeError(
+            f"scale-out loadgen: none of the {n} generator processes "
+            f"produced a LOADGEN_JSON report")
+    merged = merge_loadgen_reports(reports)
+    merged["scale_out"] = n
+    merged["generators_failed"] = failed
+    merged["per_process_qps"] = [round(float(r.get("qps", 0.0)), 1)
+                                 for r in reports]
+    return merged

@@ -463,6 +463,13 @@ class ParameterService:
         # membership view moves.
         self._nm_cache: tuple | None = None  # guarded by: self._nm_lock
         self._nm_lock = threading.Lock()
+        #: Single-flight guard over the NM-reply build: the key being
+        #: encoded right now, or None. Identical delta polls racing a
+        #: step transition park on the condition and serve the one
+        #: freshly built reply instead of each paying the pack
+        #: (docs/SHARDING.md "Fan-out trees" — coalescing semantics).
+        self._nm_building = None  # guarded by: self._nm_lock
+        self._nm_cond = threading.Condition(self._nm_lock)
         self._tm_nm_cache_hits = reg.counter(
             "dps_fetch_nm_cache_hits_total")
 
@@ -671,9 +678,43 @@ class ParameterService:
             self.sharding.note_replica(rep.get("address"),
                                        meta.get("have_step", 0),
                                        self.store.global_step,
-                                       metrics=rep.get("metrics"))
+                                       metrics=rep.get("metrics"),
+                                       parent=rep.get("parent"),
+                                       tier=rep.get("tier"),
+                                       fetches=rep.get("fetches"))
+            # An interior node forwards its cached subtree as
+            # ``descendants`` rows — each one a full announce, so the
+            # shard view covers every tier of the fan-out tree, not
+            # just the primary's direct children. Bounded: a garbled
+            # or hostile subtree cannot balloon the ingest.
+            for d in (rep.get("descendants") or [])[:64]:
+                if isinstance(d, dict):
+                    self.sharding.note_replica(
+                        d.get("address"), d.get("step", 0),
+                        self.store.global_step,
+                        metrics=d.get("metrics"),
+                        parent=d.get("parent"), tier=d.get("tier"),
+                        fetches=d.get("fetches"))
         except Exception:  # noqa: BLE001
             pass
+
+    def _topology_fields(self, have_version=None) -> dict:
+        """Fan-out-tree topology fields for a reply (docs/SHARDING.md
+        "Fan-out trees"): attached only for replica polls that sent
+        ``have_topology`` with a version older than the live one — the
+        same delta idiom as the shard map, so steady-state NM replies
+        stay attachment-free and cacheable."""
+        if self.sharding is None \
+                or not callable(getattr(self.sharding, "topology", None)):
+            return {}
+        try:
+            have = None if have_version is None else int(have_version)
+        except (TypeError, ValueError):
+            have = None  # garbled version: resend the view, never fail
+        topo = self.sharding.topology()
+        if have is not None and have >= topo["version"]:
+            return {}
+        return {"topology": topo}
 
     def _disowned_keys(self, names) -> list[str]:
         """Pushed keys whose slot this primary does not currently own
@@ -1401,6 +1442,8 @@ class ParameterService:
         dfields = self._directive_fields(wid, meta)
         sfields = self._shard_fields(meta["have_shard_map"]) \
             if "have_shard_map" in meta else {}
+        tfields = self._topology_fields(meta["have_topology"]) \
+            if "have_topology" in meta else {}
         if have is not None \
                 and getattr(store, "supports_delta_fetch", False):
             params, step = store.fetch(lwid, have_step=int(have))
@@ -1410,10 +1453,11 @@ class ParameterService:
                 # header instead of the full model (the straggler-wait /
                 # polling fetch win; docs/WIRE_PROTOCOL.md).
                 mfields = self._membership_fields(store)
-                if qfields or dfields or sfields:
+                if qfields or dfields or sfields or tfields:
                     return pack_msg({"global_step": step,
                                      "not_modified": True, **qfields,
-                                     **dfields, **sfields, **mfields})
+                                     **dfields, **sfields, **tfields,
+                                     **mfields})
                 # Attachment-free NM reply: serve the cached encode. The
                 # key folds in the membership view so an elastic join/
                 # leave at an unchanged step still invalidates — and the
@@ -1425,15 +1469,35 @@ class ParameterService:
                             and self._nm_cache[0] == key:
                         self._tm_nm_cache_hits.inc()
                         return self._nm_cache[1]
+                    if self._nm_building == key:
+                        # Single-flight: someone else is encoding this
+                        # exact reply right now — park briefly and serve
+                        # their bytes (counted as a cache hit: identical
+                        # polls coalesced onto one encode).
+                        self._nm_cond.wait_for(
+                            lambda: self._nm_building != key
+                            or (self._nm_cache is not None
+                                and self._nm_cache[0] == key),
+                            timeout=0.25)
+                        if self._nm_cache is not None \
+                                and self._nm_cache[0] == key:
+                            self._tm_nm_cache_hits.inc()
+                            return self._nm_cache[1]
+                    else:
+                        self._nm_building = key
                 reply = pack_msg({"global_step": step,
                                   "not_modified": True, **mfields})
                 with self._nm_lock:
                     self._nm_cache = (key, reply)
+                    if self._nm_building == key:
+                        self._nm_building = None
+                    self._nm_cond.notify_all()
                 return reply
         else:
             params, step = store.fetch(lwid)
         return pack_msg({"global_step": step, **qfields, **dfields,
-                         **sfields, **self._membership_fields(store)},
+                         **sfields, **tfields,
+                         **self._membership_fields(store)},
                         encode_tensor_dict(params))
 
     def job_finished(self, request: bytes, ctx) -> bytes:

@@ -254,6 +254,9 @@ class ShardInfo:
         self._tm_map_version.set(self._version)
         self._reg = reg
         self._tm_lag: dict[str, tuple] = {}
+        #: parent address -> child-count gauge (guarded by: self._lock;
+        #: removed via registry.remove when a node loses its last child).
+        self._tm_children: dict[str, object] = {}
         #: Optional zero-arg callable returning the in-flight migration
         #: block for ``view()`` (or None when idle). The owning service
         #: installs its ``migration_view`` here so ``GET /cluster``
@@ -296,14 +299,23 @@ class ShardInfo:
             return self._version
 
     def note_replica(self, address: str, step, global_step: int,
-                     metrics: str | None = None) -> None:
+                     metrics: str | None = None,
+                     parent: str | None = None,
+                     tier=None, fetches=None) -> None:
         """Ingest one replica announce (rides the replica's refresh fetch
         meta). A NEW address bumps the map version so subscribed clients
-        refresh; a known one just updates lag. ``metrics`` is the
-        replica's /metrics endpoint when it announces one — published in
-        :meth:`view` so the fleet collector (telemetry/fleet.py) can
-        adopt the replica as a scrape target. Never raises — a garbled
-        announce must not fail the fetch that carried it."""
+        refresh; a known one just updates lag — EXCEPT when its
+        ``parent`` changed (a re-parent), which is a topology edit and
+        bumps the version too, REPLACING the row in place (announce
+        dedup: rows are keyed by address, so a re-parented replica never
+        duplicates itself). ``metrics`` is the replica's /metrics
+        endpoint when it announces one — published in :meth:`view` so
+        the fleet collector (telemetry/fleet.py) can adopt the replica
+        as a scrape target. ``tier``/``fetches`` feed the fan-out-tree
+        rollups: consecutive announces of the cumulative serve count
+        become the per-node ``fetch_qps`` the tree-aware autoscaler
+        ranks parents by. Never raises — a garbled announce must not
+        fail the fetch that carried it."""
         try:
             addr = str(address)
             have = int(step)
@@ -312,22 +324,58 @@ class ShardInfo:
         now = self.clock()
         lag = max(0, int(global_step) - have)
         with self._lock:
-            fresh = addr not in self._replicas
-            row = {"step": have, "ts": now, "lag_steps": lag}
+            prev = self._replicas.get(addr)
+            fresh = prev is None
+            row = {"step": have, "ts": now, "lag_steps": lag,
+                   "tier": max(1, int(tier or 1))}
             if metrics:
                 row["metrics"] = str(metrics)
+            if parent:
+                row["parent"] = str(parent)
+            if fetches is not None:
+                try:
+                    row["fetches"] = int(fetches)
+                    if prev is not None and "fetches" in prev \
+                            and now > prev["ts"]:
+                        row["fetch_qps"] = round(
+                            max(0, row["fetches"] - prev["fetches"])
+                            / (now - prev["ts"]), 2)
+                except (TypeError, ValueError):
+                    pass
+            moved = prev is not None \
+                and prev.get("parent") != row.get("parent")
             self._replicas[addr] = row
-            if fresh:
+            if fresh or moved:
                 self._version += 1
                 self._tm_map_version.set(self._version)
             self._expire_locked(now)
             self._tm_replicas.set(len(self._replicas))
+            self._sync_children_locked()
         if addr not in self._tm_lag:
             self._tm_lag[addr] = (
                 self._reg.gauge("dps_replica_lag_steps", replica=addr),
                 self._reg.gauge("dps_replica_lag_seconds", replica=addr))
         self._tm_lag[addr][0].set(lag)
         self._tm_lag[addr][1].set(0.0)  # fresh announce = just synced
+
+    def _sync_children_locked(self) -> None:
+        """Recompute the per-node child-count gauges from the live rows.
+        A node that LOST all its children (re-parent, expiry) gets its
+        ``dps_replica_children`` series removed outright — a frozen
+        child count on a dead interior node reads as a live subtree."""
+        my_primary = self.primaries[self.shard_id]
+        counts: dict[str, int] = {}
+        for r in self._replicas.values():
+            p = r.get("parent") or my_primary
+            counts[p] = counts.get(p, 0) + 1
+        for node in set(self._tm_children) - set(counts):
+            self._tm_children.pop(node, None)
+            self._reg.remove("dps_replica_children", node=node)
+        for node, n in counts.items():
+            if node not in self._tm_children:
+                self._tm_children[node] = self._reg.gauge(
+                    "dps_replica_children", node=node)
+            self._tm_children[node].set(n)
 
     def _expire_locked(self, now: float) -> None:
         dead = [a for a, r in self._replicas.items()
@@ -343,6 +391,7 @@ class ShardInfo:
         if dead:
             self._version += 1
             self._tm_map_version.set(self._version)
+            self._sync_children_locked()
 
     def shard_map(self) -> dict:
         """The current wire shard map (docs/SHARDING.md schema). Only
@@ -364,6 +413,23 @@ class ShardInfo:
             return {"version": self._version, "slots": SHARD_SLOTS,
                     "shard_count": self.shard_count, "shards": shards}
 
+    def topology(self) -> dict:
+        """The fan-out-tree view shipped DOWN the tree as the delta-gated
+        ``topology`` fetch attachment (docs/SHARDING.md "Fan-out trees"):
+        version + primary + one row per live replica with its parent
+        edge. This is what a child re-parents from when its own parent
+        dies — deliberately small and flat."""
+        now = self.clock()
+        with self._lock:
+            self._expire_locked(now)
+            nodes = [{"address": a, "tier": r.get("tier", 1),
+                      "parent": r.get("parent"),
+                      "step": r["step"], "lag_steps": r["lag_steps"]}
+                     for a, r in sorted(self._replicas.items())]
+            return {"version": self._version,
+                    "primary": self.primaries[self.shard_id],
+                    "nodes": nodes}
+
     def view(self) -> dict:
         """The ``GET /cluster`` sharding block (rendered by
         ``cli status``): identity, map version, and per-replica lag."""
@@ -371,20 +437,32 @@ class ShardInfo:
         with self._lock:
             self._expire_locked(now)
             replicas = []
+            tiers: dict[int, dict] = {}
             for a, r in sorted(self._replicas.items()):
                 row = {"address": a, "step": r["step"],
                        "lag_steps": r["lag_steps"],
                        "announce_age_s": round(max(0.0, now - r["ts"]),
                                                3)}
-                if "metrics" in r:
-                    row["metrics"] = r["metrics"]
+                for k in ("metrics", "parent", "tier", "fetch_qps"):
+                    if k in r:
+                        row[k] = r[k]
                 replicas.append(row)
+                t = tiers.setdefault(int(r.get("tier", 1)),
+                                     {"replicas": 0, "max_lag_steps": 0,
+                                      "fetch_qps": 0.0})
+                t["replicas"] += 1
+                t["max_lag_steps"] = max(t["max_lag_steps"],
+                                         r["lag_steps"])
+                t["fetch_qps"] = round(t["fetch_qps"]
+                                       + r.get("fetch_qps", 0.0), 2)
             out = {"shard_id": self.shard_id,
                    "shard_count": self.shard_count,
                    "map_version": self._version,
                    "slot_range": list(self._ranges[self.shard_id]),
                    "primaries": list(self.primaries),
-                   "replicas": replicas}
+                   "replicas": replicas,
+                   "tiers": {str(t): v
+                             for t, v in sorted(tiers.items())}}
         if self.migration_provider is not None:
             try:
                 mig = self.migration_provider()

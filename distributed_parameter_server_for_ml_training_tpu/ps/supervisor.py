@@ -397,16 +397,21 @@ def build_worker_argv(base_args: list[str], slot: int,
 
 def build_replica_argv(primary: str, base_args: list[str] | None = None,
                        index: int = 0,
-                       python: str | None = None) -> tuple[list, None]:
+                       python: str | None = None,
+                       parent: str | None = None) -> tuple[list, None]:
     """One ``cli replica`` command line for a pool slot — the autoscaler's
     spawn template (telemetry/autoscale.py). ``base_args`` pass through
     verbatim (``--shard-id``, ``--poll-interval``, ...); the bound port is
     always ephemeral — a grown replica announces itself to the primary,
     clients learn it from the published shard map, so no port coordination
-    is needed."""
+    is needed. ``parent`` points the new replica's SUBSCRIPTION at an
+    interior node of the fan-out tree (tree-aware grow placement);
+    ``--primary`` stays the authority writes redirect to either way."""
     pkg = __name__.rsplit(".", 2)[0]
     argv = [python or sys.executable, "-m", f"{pkg}.cli", "replica",
             "--primary", primary, "--port", "0"]
+    if parent:
+        argv += ["--parent", str(parent)]
     argv += list(base_args or [])
     return argv, None
 
@@ -450,15 +455,21 @@ class ReplicaPool:
         self._tm_live.set(n)
         return n
 
-    def grow(self) -> int:
-        """Spawn one replica; returns its pool index."""
+    def grow(self, parent: str | None = None) -> int:
+        """Spawn one replica; returns its pool index. ``parent`` routes
+        tree-aware placement through to the argv builder (a two-arg
+        ``argv_for``); the plain call keeps 1-arg builders (and every
+        pre-tree caller) working unchanged."""
         with self._lock:
             idx = self._next_index
             self._next_index += 1
-            argv, env = WorkerSupervisor._normalize(self.argv_for(idx))
+            built = self.argv_for(idx) if parent is None \
+                else self.argv_for(idx, parent)
+            argv, env = WorkerSupervisor._normalize(built)
             self._procs[idx] = self._spawn_fn(argv, env)
             n = len(self._procs)
-        self.log(f"REPLICA_POOL_GROW index={idx} live={n}", flush=True)
+        self.log(f"REPLICA_POOL_GROW index={idx} live={n}"
+                 + (f" parent={parent}" if parent else ""), flush=True)
         self._tm_live.set(n)
         return idx
 

@@ -66,6 +66,15 @@ class AutoscalePolicy:
     cooldown_s: float = 10.0
     #: Compute and record every decision; touch the pool never.
     dry_run: bool = False
+    #: Deepest tier a new replica may land at. 1 = flat star (every
+    #: replica a direct child of the primary — the pre-tree behavior);
+    #: >1 lets a grow spawn under the hottest eligible interior node
+    #: (docs/SHARDING.md "Fan-out trees").
+    max_tier: int = 1
+    #: Per-node child budget: a node already feeding this many children
+    #: is not an eligible parent — growth spreads across the tree
+    #: instead of piling onto one hot interior node.
+    fanout: int = 2
 
     def __post_init__(self):
         if self.qps_low >= self.qps_high:
@@ -74,6 +83,9 @@ class AutoscalePolicy:
         if not 0 <= self.min_replicas <= self.max_replicas:
             raise ValueError(f"need 0 <= min ({self.min_replicas}) <= "
                              f"max ({self.max_replicas})")
+        if self.max_tier < 1 or self.fanout < 1:
+            raise ValueError(f"need max_tier >= 1 (got {self.max_tier}) "
+                             f"and fanout >= 1 (got {self.fanout})")
 
 
 class ReplicaAutoscaler:
@@ -127,6 +139,54 @@ class ReplicaAutoscaler:
         except Exception:  # noqa: BLE001 — lag is advisory, never fatal
             return 0.0
 
+    def _tier_rollup(self) -> dict:
+        """Per-tier {replicas, max_lag_steps, fetch_qps} from the shard
+        view — recorded on every decision so the event stream shows the
+        tree shape the policy acted on."""
+        if self.sharding is None:
+            return {}
+        try:
+            return dict(self.sharding.view().get("tiers") or {})
+        except Exception:  # noqa: BLE001 — advisory, never fatal
+            return {}
+
+    def _pick_parent(self, qps: float) -> str | None:
+        """Tree-aware grow placement (docs/SHARDING.md "Fan-out trees"):
+        rank every node that may still take children — the primary
+        (tier 0, by its windowed QPS) and each replica at a tier below
+        ``max_tier`` with fewer than ``fanout`` children (by its
+        announced per-node ``fetch_qps``) — and spawn under the HOTTEST
+        one; the new child drains polls from exactly where the serve
+        load concentrates. Returns an address, or None for the primary
+        (the flat-star behavior, and the whole story when
+        ``max_tier == 1``)."""
+        p = self.policy
+        if p.max_tier <= 1 or self.sharding is None:
+            return None
+        try:
+            view = self.sharding.view()
+            rows = view.get("replicas") or []
+        except Exception:  # noqa: BLE001 — placement is advisory
+            return None
+        primaries = view.get("primaries") or []
+        children: dict[str, int] = {}
+        for r in rows:
+            parent = r.get("parent") or "<primary>"
+            if parent in primaries:
+                parent = "<primary>"
+            children[parent] = children.get(parent, 0) + 1
+        best_addr, best_qps = None, float(qps) \
+            if children.get("<primary>", 0) < p.fanout else None
+        for r in rows:
+            addr = r.get("address")
+            if not addr or int(r.get("tier") or 1) >= p.max_tier \
+                    or children.get(addr, 0) >= p.fanout:
+                continue
+            node_qps = float(r.get("fetch_qps") or 0.0)
+            if best_qps is None or node_qps > best_qps:
+                best_addr, best_qps = str(addr), node_qps
+        return best_addr
+
     # -- control --------------------------------------------------------------
 
     def tick(self) -> dict | None:
@@ -167,10 +227,17 @@ class ReplicaAutoscaler:
             else:
                 self._last_action_ts = now
                 outcome = "ok"
+        parent = None
         if outcome == "ok":
             try:
                 if action == "replica_grow":
-                    self.pool.grow()
+                    parent = self._pick_parent(qps)
+                    # Positional-free call keeps 1-arg pools (tests,
+                    # legacy fakes) working when placement is flat.
+                    if parent is None:
+                        self.pool.grow()
+                    else:
+                        self.pool.grow(parent=parent)
                     live += 1
                 elif self.pool.shrink() is not None:
                     live -= 1
@@ -183,6 +250,11 @@ class ReplicaAutoscaler:
         event = {"ts": round(now, 3), "action": action,
                  "outcome": outcome, "qps": round(qps, 1),
                  "max_lag_steps": lag, "live": live}
+        if parent is not None:
+            event["parent"] = parent
+        tiers = self._tier_rollup()
+        if tiers:
+            event["tiers"] = tiers
         with self._lock:
             self._events.append(event)
         return event
@@ -198,5 +270,7 @@ class ReplicaAutoscaler:
                 "qps_high": self.policy.qps_high,
                 "qps_low": self.policy.qps_low,
                 "dry_run": self.policy.dry_run,
+                "max_tier": self.policy.max_tier,
+                "fanout": self.policy.fanout,
                 "actions": dict(self.actions),
                 "events": events[-16:]}

@@ -589,6 +589,7 @@ class FleetCollector:
         primaries, replicas, workers = [], [], []
         jobs: dict[str, dict] = {}
         seen_reps: set[str] = set()
+        prim_addrs: set[str] = set()
         for st in self._states.values():
             view = st.cluster
             if view is None:
@@ -602,6 +603,8 @@ class FleetCollector:
             if sharding:
                 row["shard_id"] = sharding.get("shard_id")
                 row["map_version"] = sharding.get("map_version")
+                prim_addrs.update(a for a in (sharding.get("primaries")
+                                              or []) if a)
             primaries.append(row)
             for rep in sharding.get("replicas", []):
                 addr = rep.get("address")
@@ -613,8 +616,28 @@ class FleetCollector:
                 workers.append({**w, "via": st.target})
             for name, jrow in (view.get("jobs") or {}).items():
                 jobs.setdefault(name, {**jrow, "via": st.target})
-        return {"primaries": primaries, "replicas": replicas,
-                "workers": workers, "jobs": jobs}
+        # Fan-out-tree rollup (docs/SHARDING.md "Fan-out trees"): the
+        # per-tier shape of the serve tree, merged across every shard.
+        tiers: dict[str, dict] = {}
+        for rep in replicas:
+            key = str(max(1, int(rep.get("tier") or 1)))
+            roll = tiers.setdefault(
+                key, {"replicas": 0, "max_lag_steps": 0.0, "fetch_qps": 0.0})
+            roll["replicas"] += 1
+            roll["max_lag_steps"] = max(roll["max_lag_steps"],
+                                        float(rep.get("lag_steps") or 0.0))
+            roll["fetch_qps"] = round(
+                roll["fetch_qps"] + float(rep.get("fetch_qps") or 0.0), 2)
+        out = {"primaries": primaries, "replicas": replicas,
+               "workers": workers, "jobs": jobs}
+        if prim_addrs:
+            # gRPC addresses of the shard primaries (scrape targets above
+            # are metrics endpoints) — the tree renderer roots replica
+            # rows whose ``parent`` is one of these.
+            out["primary_addresses"] = sorted(prim_addrs)
+        if tiers:
+            out["replica_tiers"] = tiers
+        return out
 
     def _slo_view_locked(self, now: float) -> dict:
         samples = list(self._slo_samples)

@@ -1,0 +1,729 @@
+"""Serve-fabric fan-out trees (ISSUE 17).
+
+Pins the four tentpole behaviors plus the satellites:
+
+- tier learning + per-tier staleness derivation
+  (``tier_staleness_bound``; explicit bounds stay pinned overrides);
+- topology propagation down a real primary -> interior -> edge chain
+  (announce ``descendants`` up, delta-gated ``topology`` attachment
+  down), and the service-side ``have_topology`` gating;
+- delta-poll coalescing: identical polls arriving mid-refresh park on
+  the single-flight latch and are answered from the SAME pre-encoded
+  bytes object (zero extra encodes);
+- deterministic re-parenting under injected faults
+  (``subscribe.partition`` against the interior, ``refresh.unavailable``
+  at the child) and on parent death, with the cooldown hysteresis guard;
+- announce dedup: a re-parented replica REPLACES its row, the old
+  parent's ``dps_replica_children`` series is removed (regression for
+  the series-lifecycle contract);
+- distributed loadgen plumbing: child argv / report parsing / merged
+  union percentiles pinned against single-process ground truth;
+- tree-aware autoscaler placement and ``ReplicaPool.grow(parent=...)``;
+- ``cli status`` tree rendering incl. the orphaned-children header.
+"""
+
+import threading
+import time
+
+import grpc
+import numpy as np
+import pytest
+
+from distributed_parameter_server_for_ml_training_tpu.cli import (
+    _replica_tree_lines)
+from distributed_parameter_server_for_ml_training_tpu.comms.loadgen import (
+    LOADGEN_JSON_PREFIX, loadgen_child_argv, merge_loadgen_reports,
+    parse_loadgen_json)
+from distributed_parameter_server_for_ml_training_tpu.comms.replica import (
+    DEFAULT_STALENESS_BOUND_S, ReplicaServer, tier_staleness_bound)
+from distributed_parameter_server_for_ml_training_tpu.comms.service import (
+    GRPC_OPTIONS, SERVICE_NAME, ParameterService, pack_msg, serve,
+    unpack_msg)
+from distributed_parameter_server_for_ml_training_tpu.ps.sharding import (
+    ShardInfo)
+from distributed_parameter_server_for_ml_training_tpu.ps.store import (
+    ParameterStore, StoreConfig)
+from distributed_parameter_server_for_ml_training_tpu.ps.supervisor import (
+    ReplicaPool, build_replica_argv)
+from distributed_parameter_server_for_ml_training_tpu.telemetry import (
+    get_registry)
+from distributed_parameter_server_for_ml_training_tpu.telemetry.autoscale \
+    import AutoscalePolicy, ReplicaAutoscaler
+from distributed_parameter_server_for_ml_training_tpu.telemetry.registry \
+    import LATENCY_BUCKETS, Histogram, MetricsRegistry
+
+
+def _wait(pred, timeout=8.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+def _primary(mode="async"):
+    """One in-process sharded primary; the ShardInfo's primary address
+    is patched to the real bound port so topology fallback works."""
+    store = ParameterStore(
+        {"w": np.zeros(8, np.float32)},
+        StoreConfig(mode=mode, total_workers=1, push_codec="none"))
+    sharding = ShardInfo(0, 1, ["pending"])
+    svc = ParameterService(store, sharding=sharding)
+    server, port = serve(store, port=0, service=svc)
+    sharding.primaries[0] = f"localhost:{port}"
+    return store, svc, server, f"localhost:{port}"
+
+
+def _fetch_stub(addr):
+    ident = lambda b: b  # noqa: E731
+    channel = grpc.insecure_channel(addr, options=GRPC_OPTIONS)
+    stub = channel.unary_unary(f"/{SERVICE_NAME}/FetchParameters",
+                               request_serializer=ident,
+                               response_deserializer=ident)
+    return channel, stub
+
+
+class _Ctx:
+    """Fake gRPC context for direct handler calls (never aborted in
+    these tests — freshness is established first)."""
+
+    def abort(self, code, detail):  # pragma: no cover - fresh by setup
+        raise AssertionError(f"unexpected abort: {code} {detail}")
+
+
+class TestTierStaleness:
+    def test_bound_is_linear_in_tier(self):
+        assert tier_staleness_bound(1) == DEFAULT_STALENESS_BOUND_S
+        assert tier_staleness_bound(3) == 3 * DEFAULT_STALENESS_BOUND_S
+        assert tier_staleness_bound(0) == DEFAULT_STALENESS_BOUND_S
+        assert tier_staleness_bound(2, base=2.0) == 4.0
+
+    def test_default_construction_is_tier1(self):
+        rep = ReplicaServer("localhost:1")
+        assert rep.tier == 1 and rep.parent == "localhost:1"
+        assert rep.staleness_bound_s == DEFAULT_STALENESS_BOUND_S
+
+    def test_parent_implies_tier2_and_derived_bound(self):
+        rep = ReplicaServer("localhost:1", parent="localhost:2")
+        assert rep.tier == 2
+        assert rep.staleness_bound_s == tier_staleness_bound(2)
+
+    def test_tier_update_rederives_unless_overridden(self):
+        rep = ReplicaServer("localhost:1")
+        rep._set_tier(3)
+        assert rep.tier == 3
+        assert rep.staleness_bound_s == tier_staleness_bound(3)
+        pinned = ReplicaServer("localhost:1", staleness_bound_s=2.5)
+        pinned._set_tier(4)
+        assert pinned.staleness_bound_s == 2.5  # explicit = pinned
+
+
+class TestTopologyPropagation:
+    def test_three_node_chain_announces_and_adopts(self):
+        store, svc, server, paddr = _primary()
+        interior = edge = None
+        try:
+            interior = ReplicaServer(paddr, poll_interval=0.02,
+                                     staleness_bound_s=30.0)
+            iaddr = f"localhost:{interior.start()}"
+            edge = ReplicaServer(paddr, poll_interval=0.02,
+                                 staleness_bound_s=30.0, parent=iaddr)
+            edge.start()
+            assert _wait(lambda: edge.view()["synced"])
+            # Tier learned from the parent's reply head.
+            assert _wait(lambda: edge.view()["tier"] == 2)
+            assert interior.view()["tier"] == 1
+            assert _wait(lambda: interior.view()["children"] == 1)
+            # The edge row reaches the PRIMARY via the interior's
+            # descendants forwarding, parent edge intact.
+            def edge_row():
+                rows = svc.sharding.view()["replicas"]
+                return next((r for r in rows
+                             if r.get("parent") == iaddr), None)
+            assert _wait(lambda: edge_row() is not None)
+            row = edge_row()
+            assert row["tier"] == 2
+            # Topology flows DOWN the tree: the edge adopts a version
+            # naming every node.
+            def edge_topo_complete():
+                with edge._lock:
+                    topo = edge._topology
+                if not topo:
+                    return False
+                addrs = {n["address"] for n in topo["nodes"]}
+                return iaddr in addrs and topo["primary"] == paddr
+            assert _wait(edge_topo_complete)
+            # Per-tier rollup on the shard view.
+            tiers = svc.sharding.view()["tiers"]
+            assert tiers["1"]["replicas"] == 1
+            assert tiers["2"]["replicas"] == 1
+        finally:
+            for rep in (edge, interior):
+                if rep is not None:
+                    rep.stop()
+            server.stop(grace=None)
+
+    def test_topology_fields_delta_gated(self):
+        store, svc, server, paddr = _primary()
+        try:
+            svc.sharding.note_replica("t1:1", 0, 0, parent=paddr, tier=1)
+            fields = svc._topology_fields()
+            assert "topology" in fields
+            ver = fields["topology"]["version"]
+            assert svc._topology_fields(have_version=ver) == {}
+            assert "topology" in svc._topology_fields(have_version=ver - 1)
+            assert "topology" in svc._topology_fields(have_version="junk")
+        finally:
+            server.stop(grace=None)
+
+    def test_unsharded_service_attaches_nothing(self):
+        store = ParameterStore(
+            {"w": np.zeros(4, np.float32)},
+            StoreConfig(mode="async", total_workers=1, push_codec="none"))
+        svc = ParameterService(store)
+        assert svc._topology_fields() == {}
+        assert svc._topology_fields(have_version=0) == {}
+
+    def test_wire_round_trip_gating(self):
+        store, svc, server, paddr = _primary()
+        channel = None
+        try:
+            svc.sharding.note_replica("t2:1", 0, 0, parent=paddr, tier=1)
+            channel, stub = _fetch_stub(paddr)
+            rmeta, _ = unpack_msg(
+                stub(pack_msg({"have_topology": 0}), timeout=10.0))
+            assert "topology" in rmeta
+            ver = rmeta["topology"]["version"]
+            rmeta2, _ = unpack_msg(
+                stub(pack_msg({"have_topology": ver}), timeout=10.0))
+            assert "topology" not in rmeta2
+        finally:
+            if channel is not None:
+                channel.close()
+            server.stop(grace=None)
+
+
+class TestCoalescing:
+    def _pair(self, **kw):
+        store, svc, server, paddr = _primary()
+        rep = ReplicaServer(paddr, poll_interval=5.0,
+                            staleness_bound_s=60.0, **kw)
+        rep.start()
+        assert _wait(lambda: rep.view()["synced"])
+        return store, server, rep
+
+    def test_parked_polls_share_one_payload_object(self):
+        store, server, rep = self._pair(coalesce_wait_s=5.0)
+        try:
+            req = pack_msg({"have_step": 0})
+            # Raise the latch as the poll thread would mid-refresh.
+            with rep._lock:
+                rep._refresh_inflight = True
+            results = []
+
+            def poll():
+                results.append(rep._fetch_parameters(req, _Ctx()))
+
+            threads = [threading.Thread(target=poll) for _ in range(3)]
+            for t in threads:
+                t.start()
+            time.sleep(0.2)        # all three park on the latch
+            with rep._lock:
+                rep._refresh_done_locked()
+            for t in threads:
+                t.join(timeout=5.0)
+            assert len(results) == 3
+            # Identity, not equality: every waiter got the SAME
+            # pre-encoded bytes object — zero per-request encodes.
+            assert all(r is rep._nm_reply for r in results)
+            v = rep.view()
+            assert v["coalesced"] >= 3
+            assert v["polls"] >= 1
+            gauges = get_registry().snapshot()["gauges"]
+            assert gauges.get("dps_coalesce_ratio", 0) > 0
+        finally:
+            rep.stop()
+            server.stop(grace=None)
+
+    def test_no_coalesce_answers_immediately(self):
+        store, server, rep = self._pair(coalesce=False,
+                                        coalesce_wait_s=5.0)
+        try:
+            with rep._lock:
+                rep._refresh_inflight = True
+            t0 = time.monotonic()
+            out = rep._fetch_parameters(pack_msg({"have_step": 0}),
+                                        _Ctx())
+            assert time.monotonic() - t0 < 1.0   # did not park
+            assert out is rep._nm_reply
+            assert rep.view()["coalesced"] == 0
+            with rep._lock:
+                rep._refresh_done_locked()
+        finally:
+            rep.stop()
+            server.stop(grace=None)
+
+    def test_full_fetch_never_parks(self):
+        store, server, rep = self._pair(coalesce_wait_s=5.0)
+        try:
+            with rep._lock:
+                rep._refresh_inflight = True
+            t0 = time.monotonic()
+            out = rep._fetch_parameters(pack_msg({}), _Ctx())
+            assert time.monotonic() - t0 < 1.0
+            assert out is rep._reply             # content, not NM
+            with rep._lock:
+                rep._refresh_done_locked()
+        finally:
+            rep.stop()
+            server.stop(grace=None)
+
+
+class TestReparent:
+    def test_cooldown_hysteresis_blocks_immediate_move(self):
+        rep = ReplicaServer("localhost:1", parent="localhost:2",
+                            reparent_cooldown_s=999.0)
+        rep._last_reparent = time.monotonic()
+        assert rep._maybe_reparent() is False
+        assert rep.parent == "localhost:2"
+
+    def test_no_topology_falls_back_to_primary(self):
+        rep = ReplicaServer("localhost:1", parent="localhost:2",
+                            reparent_cooldown_s=0.0)
+        try:
+            assert rep._maybe_reparent() is True
+            assert rep.parent == "localhost:1"
+            # Already at the primary with no candidates: nothing to do.
+            assert rep._maybe_reparent() is False
+        finally:
+            if rep._channel is not None:
+                rep._channel.close()
+
+    def test_pick_parent_prefers_lower_tier_excludes_subtree(self):
+        rep = ReplicaServer("localhost:1", parent="localhost:2")
+        rep.advertise = "me:1"
+        rep._set_tier(2)
+        with rep._lock:
+            rep._topology = {
+                "version": 4, "primary": "localhost:1",
+                "nodes": [
+                    {"address": "a:1", "tier": 1, "lag_steps": 5},
+                    {"address": "b:1", "tier": 1, "lag_steps": 0},
+                    {"address": "me:1", "tier": 2, "parent": "a:1"},
+                    # In OUR subtree via parent pointers: never adopted.
+                    {"address": "kid:1", "tier": 1, "parent": "me:1"},
+                ]}
+        assert rep._pick_parent() == "b:1"       # lowest lag at tier 1
+
+    def test_parent_death_reparents_to_sibling(self):
+        store, svc, server, paddr = _primary()
+        a = b = child = None
+        try:
+            a = ReplicaServer(paddr, poll_interval=0.05,
+                              staleness_bound_s=30.0)
+            aaddr = f"localhost:{a.start()}"
+            b = ReplicaServer(paddr, poll_interval=0.05,
+                              staleness_bound_s=30.0)
+            baddr = f"localhost:{b.start()}"
+            child = ReplicaServer(paddr, poll_interval=0.05,
+                                  staleness_bound_s=30.0, parent=aaddr,
+                                  reparent_after=2,
+                                  reparent_cooldown_s=0.0)
+            caddr = f"localhost:{child.start()}"
+
+            def topo_ready():
+                with child._lock:
+                    topo = child._topology
+                return bool(topo) and baddr in {
+                    n["address"] for n in topo["nodes"]}
+            assert _wait(topo_ready)
+            a.stop()                             # the interior node dies
+            assert _wait(lambda: child.view()["parent"] == baddr)
+            assert child.view()["tier"] == 2
+            gauges = get_registry().snapshot()["gauges"]
+            assert gauges.get("dps_replica_reparents_total") is None
+            counters = get_registry().snapshot()["counters"]
+            assert counters.get("dps_replica_reparents_total", 0) >= 1
+            # The child keeps serving through its new parent.
+            assert _wait(lambda: child.view()["synced"])
+            channel, stub = _fetch_stub(caddr)
+            try:
+                rmeta, payload = unpack_msg(
+                    stub(pack_msg({}), timeout=10.0))
+                assert rmeta["replica"] and len(payload) > 0
+            finally:
+                channel.close()
+        finally:
+            for rep in (child, b, a):
+                if rep is not None:
+                    rep.stop()
+            server.stop(grace=None)
+
+    def test_partitioned_interior_drives_fallback_to_primary(self):
+        # subscribe.partition on the INTERIOR's serve handler: the child
+        # never gets a poll through, fails deterministically, and (with
+        # no adopted topology) falls back to the primary.
+        store, svc, server, paddr = _primary()
+        a = child = None
+        try:
+            a = ReplicaServer(paddr, poll_interval=0.05,
+                              staleness_bound_s=30.0,
+                              faults="subscribe.partition=60@n=1")
+            aaddr = f"localhost:{a.start()}"
+            child = ReplicaServer(paddr, poll_interval=0.05,
+                                  staleness_bound_s=30.0, parent=aaddr,
+                                  reparent_after=2,
+                                  reparent_cooldown_s=0.0,
+                                  rpc_timeout=0.5)
+            child.start()
+            assert _wait(lambda: child.view()["parent"] == paddr)
+            assert _wait(lambda: child.view()["synced"])
+            assert child.view()["tier"] == 1     # now fed by the primary
+        finally:
+            for rep in (child, a):
+                if rep is not None:
+                    rep.stop()
+            server.stop(grace=None)
+
+    def test_client_side_refresh_faults_drive_reparent(self):
+        # refresh.unavailable at the CHILD: polls 1..2 fail injected,
+        # re-parent fires, poll 3 runs against the new (primary) parent.
+        store, svc, server, paddr = _primary()
+        child = None
+        try:
+            child = ReplicaServer(paddr, poll_interval=0.05,
+                                  staleness_bound_s=30.0,
+                                  parent="localhost:1",  # dead on arrival
+                                  reparent_after=2,
+                                  reparent_cooldown_s=0.0,
+                                  faults="refresh.unavailable@n=1,2")
+            child.start()
+            assert _wait(lambda: child.view()["parent"] == paddr)
+            assert _wait(lambda: child.view()["synced"])
+        finally:
+            if child is not None:
+                child.stop()
+            server.stop(grace=None)
+
+
+class TestAnnounceDedup:
+    def _children_gauges(self):
+        return {k: v for k, v in get_registry().snapshot()["gauges"]
+                .items() if k.startswith("dps_replica_children")}
+
+    def test_reparented_row_replaces_and_series_removed(self):
+        sh = ShardInfo(0, 1, ["prim:1"])
+        sh.note_replica("ia:1", 0, 0, parent="prim:1", tier=1)
+        sh.note_replica("ib:1", 0, 0, parent="prim:1", tier=1)
+        sh.note_replica("ie:1", 0, 0, parent="ia:1", tier=2)
+        v0 = sh.version
+        g = self._children_gauges()
+        assert g["dps_replica_children{node=ia:1}"] == 1
+        assert g["dps_replica_children{node=prim:1}"] == 2
+        # The edge re-parents: SAME address, new parent.
+        sh.note_replica("ie:1", 0, 0, parent="ib:1", tier=2)
+        assert sh.version > v0                   # topology edit: bump
+        rows = sh.view()["replicas"]
+        mine = [r for r in rows if r["address"] == "ie:1"]
+        assert len(mine) == 1                    # replaced, not dup'd
+        assert mine[0]["parent"] == "ib:1"
+        g = self._children_gauges()
+        # Old parent lost its LAST child: series removed outright.
+        assert "dps_replica_children{node=ia:1}" not in g
+        assert g["dps_replica_children{node=ib:1}"] == 1
+
+    def test_same_parent_reannounce_does_not_bump(self):
+        sh = ShardInfo(0, 1, ["prim2:1"])
+        sh.note_replica("r2a:1", 0, 0, parent="prim2:1", tier=1)
+        v0 = sh.version
+        sh.note_replica("r2a:1", 1, 1, parent="prim2:1", tier=1)
+        assert sh.version == v0
+
+    def test_fetch_qps_from_consecutive_announces(self):
+        t = [100.0]
+        sh = ShardInfo(0, 1, ["prim3:1"], clock=lambda: t[0])
+        sh.note_replica("r3a:1", 0, 0, tier=1, fetches=100)
+        t[0] += 2.0
+        sh.note_replica("r3a:1", 0, 0, tier=1, fetches=300)
+        row = sh.view()["replicas"][0]
+        assert row["fetch_qps"] == 100.0         # 200 fetches / 2 s
+
+
+def _report(samples_s, mode="delta", targets=("t:1",)):
+    h = Histogram("loadgen_latency", buckets=LATENCY_BUCKETS)
+    for v in samples_s:
+        h.observe(v)
+    return {"targets": list(targets), "mode": mode, "concurrency": 2,
+            "duration_s": 1.0, "fetches_ok": len(samples_s),
+            "fetches_err": 0, "not_modified": 0,
+            "bytes_in": 1000 * len(samples_s), "qps": len(samples_s),
+            "mb_per_s": 1.0, "latency_hist": h.snapshot()}
+
+
+class TestLoadgenScaleOut:
+    def test_child_argv_shape(self):
+        argv = loadgen_child_argv(["a:1", "b:2"], 2.5, 8, "delta",
+                                  job="tenant")
+        assert argv[1:3] == ["-m", "distributed_parameter_server_for_"
+                                   "ml_training_tpu.cli"]
+        assert "loadgen" in argv
+        i = argv.index("--targets")
+        assert argv[i + 1] == "a:1,b:2"
+        assert argv[argv.index("--duration") + 1] == "2.5"
+        assert argv[argv.index("--concurrency") + 1] == "8"
+        assert argv[argv.index("--fetch-mode") + 1] == "delta"
+        assert argv[argv.index("--job") + 1] == "tenant"
+        assert "--job" not in loadgen_child_argv(["a:1"], 1, 1, "full")
+
+    def test_parse_json_last_match_wins(self):
+        text = ("noise\n"
+                f"{LOADGEN_JSON_PREFIX}{{\"qps\": 1}}\n"
+                f"prefix {LOADGEN_JSON_PREFIX}{{\"qps\": 2}}\n")
+        assert parse_loadgen_json(text) == {"qps": 2}
+        assert parse_loadgen_json("no report here") is None
+        assert parse_loadgen_json(f"{LOADGEN_JSON_PREFIX}not json") is None
+
+    def test_merged_percentiles_pin_to_union_ground_truth(self):
+        # Two skewed halves: averaging per-report percentiles would NOT
+        # reproduce the union percentiles; histogram-merge must.
+        fast = [0.001] * 80 + [0.004] * 15 + [0.02] * 5
+        slow = [0.05] * 30 + [0.2] * 10
+        merged = merge_loadgen_reports([_report(fast), _report(slow)])
+        truth = merge_loadgen_reports([_report(fast + slow)])
+        assert merged["latency_ms"] == truth["latency_ms"]
+        assert merged["latency_ms"]["samples"] == len(fast) + len(slow)
+        assert merged["fetches_ok"] == len(fast) + len(slow)
+        assert merged["qps"] == len(fast) + len(slow)  # concurrent sum
+        assert merged["reports"] == 2
+        assert merged["duration_s"] == 1.0             # max, not sum
+
+    def test_merge_refuses_histless_reports(self):
+        r = _report([0.001])
+        del r["latency_hist"]
+        with pytest.raises(ValueError):
+            merge_loadgen_reports([r])
+
+
+class _TreePool:
+    def __init__(self, live=0):
+        self.live = live
+        self.parents = []
+
+    def count(self):
+        return self.live
+
+    def grow(self, parent=None):
+        self.live += 1
+        self.parents.append(parent)
+        return self.live - 1
+
+    def shrink(self):
+        if self.live == 0:
+            return None
+        self.live -= 1
+        return self.live
+
+
+class _TreeShard:
+    def __init__(self, rows, primaries=("p:1",)):
+        self.rows = rows
+        self.primaries = list(primaries)
+
+    def view(self):
+        return {"replicas": self.rows, "primaries": self.primaries,
+                "tiers": {"1": {"replicas": len(self.rows)}}}
+
+
+class TestAutoscalerPlacement:
+    def test_flat_policy_always_primary(self):
+        asc = ReplicaAutoscaler(
+            _TreePool(), AutoscalePolicy(max_tier=1),
+            sharding=_TreeShard([{"address": "i:1", "tier": 1,
+                                  "fetch_qps": 500.0}]),
+            registry=MetricsRegistry())
+        assert asc._pick_parent(1000.0) is None
+
+    def test_hottest_eligible_interior_wins(self):
+        rows = [
+            {"address": "i1:1", "tier": 1, "fetch_qps": 50.0},
+            {"address": "i2:1", "tier": 1, "fetch_qps": 200.0},
+            {"address": "e1:1", "tier": 2, "parent": "i1:1"},
+        ]
+        asc = ReplicaAutoscaler(
+            _TreePool(), AutoscalePolicy(max_tier=2, fanout=2),
+            sharding=_TreeShard(rows), registry=MetricsRegistry())
+        # Primary already feeds i1+i2 = fanout: interior must take it.
+        assert asc._pick_parent(10.0) == "i2:1"
+
+    def test_primary_wins_when_hotter_and_under_fanout(self):
+        rows = [{"address": "i1:1", "tier": 1, "fetch_qps": 20.0}]
+        asc = ReplicaAutoscaler(
+            _TreePool(), AutoscalePolicy(max_tier=2, fanout=3),
+            sharding=_TreeShard(rows), registry=MetricsRegistry())
+        assert asc._pick_parent(1000.0) is None  # primary is hottest
+
+    def test_tier_cap_and_full_nodes_excluded(self):
+        rows = [
+            {"address": "i1:1", "tier": 2, "fetch_qps": 900.0},  # at cap
+            {"address": "i2:1", "tier": 1, "fetch_qps": 5.0},
+            {"address": "e1:1", "tier": 2, "parent": "i2:1"},
+            {"address": "e2:1", "tier": 2, "parent": "i2:1"},    # full
+        ]
+        asc = ReplicaAutoscaler(
+            _TreePool(), AutoscalePolicy(max_tier=2, fanout=2),
+            sharding=_TreeShard(rows), registry=MetricsRegistry())
+        assert asc._pick_parent(1.0) is None
+
+    def test_tick_records_parent_and_tiers(self):
+        pool = _TreePool()
+        rows = [{"address": "i1:1", "tier": 1, "fetch_qps": 400.0},
+                {"address": "i2:1", "tier": 1, "fetch_qps": 1.0}]
+        t, fetches = [0.0], [0.0]
+        asc = ReplicaAutoscaler(
+            pool, AutoscalePolicy(qps_high=10.0, qps_low=1.0,
+                                  cooldown_s=0.0, max_tier=2, fanout=2),
+            sharding=_TreeShard(rows), registry=MetricsRegistry(),
+            clock=lambda: t[0], fetch_total_fn=lambda: fetches[0])
+        asc.tick()
+        t[0] += 1.0
+        fetches[0] += 100.0
+        ev = asc.tick()
+        assert ev["action"] == "replica_grow" and ev["outcome"] == "ok"
+        assert ev["parent"] == "i1:1"            # hottest interior
+        assert pool.parents == ["i1:1"]
+        assert ev["tiers"]["1"]["replicas"] == 2
+        view = asc.view()
+        assert view["max_tier"] == 2 and view["fanout"] == 2
+
+    def test_legacy_one_arg_pool_still_grows(self):
+        class _Flat:
+            def __init__(self):
+                self.grown = 0
+
+            def count(self):
+                return 0
+
+            def grow(self):                      # no parent kwarg
+                self.grown += 1
+                return 0
+
+            def shrink(self):
+                return None
+
+        pool = _Flat()
+        t, fetches = [0.0], [0.0]
+        asc = ReplicaAutoscaler(
+            pool, AutoscalePolicy(qps_high=10.0, qps_low=1.0,
+                                  cooldown_s=0.0),
+            registry=MetricsRegistry(), clock=lambda: t[0],
+            fetch_total_fn=lambda: fetches[0])
+        asc.tick()
+        t[0] += 1.0
+        fetches[0] += 100.0
+        ev = asc.tick()
+        assert ev["outcome"] == "ok" and pool.grown == 1
+
+    def test_policy_validation(self):
+        with pytest.raises(ValueError):
+            AutoscalePolicy(max_tier=0)
+        with pytest.raises(ValueError):
+            AutoscalePolicy(fanout=0)
+
+
+class _FakeProc:
+    def __init__(self, argv, env):
+        self.argv, self.env = argv, env
+        self.rc = None
+
+    def poll(self):
+        return self.rc
+
+    def terminate(self):
+        self.rc = 0
+
+    def wait(self, timeout=None):
+        return self.rc if self.rc is not None else 0
+
+    def kill(self):
+        self.rc = -9
+
+
+class TestReplicaPoolParent:
+    def test_build_replica_argv_parent_flag(self):
+        argv, env = build_replica_argv("h:1", ["--shard-id", "3"], 2,
+                                       parent="i:9")
+        assert env is None
+        assert argv[3:6] == ["replica", "--primary", "h:1"]
+        assert argv[argv.index("--parent") + 1] == "i:9"
+        # The pinned no-parent shape is untouched.
+        argv2, _ = build_replica_argv("h:1", ["--shard-id", "3"], 2)
+        assert "--parent" not in argv2
+
+    def test_grow_threads_parent_to_builder(self):
+        spawned = []
+
+        def spawn(argv, env):
+            p = _FakeProc(argv, env)
+            spawned.append(p)
+            return p
+
+        pool = ReplicaPool(
+            lambda idx, parent=None: build_replica_argv(
+                "localhost:9999", ["--shard-id", "0"], idx,
+                parent=parent),
+            spawn=spawn, log=lambda *a, **k: None)
+        pool.grow()
+        pool.grow(parent="i:7")
+        assert "--parent" not in spawned[0].argv
+        assert spawned[1].argv[spawned[1].argv.index("--parent") + 1] \
+            == "i:7"
+        pool.stop()
+
+
+class TestTreeRender:
+    def _sh(self):
+        return {
+            "primaries": ["localhost:5000"],
+            "replicas": [
+                {"address": "i:1", "step": 10, "lag_steps": 0,
+                 "announce_age_s": 0.5, "tier": 1,
+                 "parent": "localhost:5000", "fetch_qps": 120.0},
+                {"address": "e:1", "step": 9, "lag_steps": 1,
+                 "announce_age_s": 0.2, "tier": 2, "parent": "i:1"},
+                {"address": "o:1", "step": 9, "lag_steps": 1,
+                 "announce_age_s": 0.9, "tier": 2, "parent": "gone:1"},
+            ],
+            "tiers": {"1": {"replicas": 1, "max_lag_steps": 0,
+                            "fetch_qps": 120.0},
+                      "2": {"replicas": 2, "max_lag_steps": 1,
+                            "fetch_qps": 0}},
+        }
+
+    def test_children_indent_under_parent(self):
+        lines = _replica_tree_lines(self._sh())
+        it = next(i for i, ln in enumerate(lines) if "replica i:1" in ln)
+        et = next(i for i, ln in enumerate(lines) if "replica e:1" in ln)
+        assert et == it + 1                      # child directly under
+        indent = len(lines[et]) - len(lines[et].lstrip())
+        assert indent > len(lines[it]) - len(lines[it].lstrip())
+        assert "[tier 1]" in lines[it] and "[tier 2]" in lines[et]
+        assert "120 fetch/s" in lines[it]
+
+    def test_orphans_render_under_explicit_header(self):
+        lines = _replica_tree_lines(self._sh())
+        hdr = next(ln for ln in lines if "orphaned" in ln)
+        assert "gone:1" in hdr                   # names the dead parent
+        assert any("replica o:1" in ln for ln in lines)
+        assert any(ln.strip().startswith("tiers:") for ln in lines)
+
+    def test_pretree_rows_flatten_at_root(self):
+        sh = {"primaries": ["p:1"],
+              "replicas": [{"address": "r1:1", "step": 1, "lag_steps": 0,
+                            "announce_age_s": 0.1},
+                           {"address": "r2:1", "step": 1, "lag_steps": 0,
+                            "announce_age_s": 0.1}]}
+        lines = _replica_tree_lines(sh)
+        assert len(lines) == 2
+        assert all(ln.startswith("  replica ") for ln in lines)
+        assert not any("tiers:" in ln for ln in lines)

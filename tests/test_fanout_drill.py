@@ -1,0 +1,58 @@
+"""Slow wrapper: the recorded fan-out drill must pass live.
+
+Runs ``experiments/run_fanout_drill.py --quick`` as a subprocess — a
+real depth-3 tree (primary -> 2 interiors -> 4 edges) under a
+distributed two-process delta storm, a focused coalescing storm, and a
+mid-drill interior SIGKILL — and asserts every recorded check: the
+>=6x tree-vs-star consumer QPS headline, the >2x coalesce ratio, the
+primary's fetch isolation, zero-error re-parenting without a fast-burn
+SLO breach, announce dedup, and the histogram-union percentile pin
+(ISSUE 17 acceptance).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_fanout_drill_quick(tmp_path):
+    script = os.path.join(REPO, "experiments", "run_fanout_drill.py")
+    cp = subprocess.run(
+        [sys.executable, script, "--quick", "--out-dir", str(tmp_path)],
+        env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=REPO,
+        capture_output=True, text=True, timeout=900)
+    assert cp.returncode == 0, \
+        f"drill failed\nstdout:\n{cp.stdout}\nstderr:\n{cp.stderr}"
+    with open(tmp_path / "fanout_drill.json") as f:
+        record = json.load(f)
+    assert record["all_pass"], record["checks"]
+    checks = record["checks"]
+    assert checks["B_tree_6x_flat_star"]
+    assert checks["B_distributed_generation_merged"]
+    assert checks["B_coalesce_ratio_over_2x"]
+    assert checks["B_primary_sees_only_child_polls"]
+    assert checks["B_edges_announce_tier2"]
+    assert checks["B_status_renders_tree"]
+    assert checks["B_top_renders_tree_fleetwide"]
+    assert checks["C_children_reparent_to_surviving_interior"]
+    assert checks["C_zero_consumer_fetch_errors"]
+    assert checks["C_slo_burn_fast_not_firing"]
+    assert checks["C_announce_dedup_one_row_per_replica"]
+    assert checks["C_dead_parents_children_series_removed"]
+    assert checks["D_merged_percentiles_equal_union_ground_truth"]
+    assert checks["D_histogram_counts_cover_all_fetches"]
+    # the acceptance artifacts were all recorded
+    for name in ("cluster_tree.json", "cluster_after_kill.json",
+                 "loadgen_tree_storm.json", "loadgen_coalesce_storm.json",
+                 "loadgen_kill_drill.json", "status_tree.txt",
+                 "top_tree.txt", "primary_metrics_after_kill.txt"):
+        assert (tmp_path / name).exists(), name

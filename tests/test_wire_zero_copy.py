@@ -195,6 +195,65 @@ class TestCachedNotModifiedReply:
             pack_msg({"have_step": store.global_step}), None) is fresh
 
 
+class TestNotModifiedSingleFlight:
+    """ISSUE 17: identical NM polls arriving while the reply is being
+    encoded must park on the single-flight latch and serve the builder's
+    bytes — the copy budget for a poll storm is ONE encode total, and
+    every parked waiter returns the identical object."""
+
+    def _svc(self):
+        return TestCachedNotModifiedReply._svc(None)
+
+    def test_parked_waiter_serves_builders_bytes(self):
+        import threading
+        import time
+        from distributed_parameter_server_for_ml_training_tpu.comms.service import (
+            pack_msg)
+        _, svc = self._svc()
+        req = pack_msg({"have_step": 0})
+        built = svc.fetch_parameters(req, None)   # populates the cache
+        key = svc._nm_cache[0]
+        hits0 = svc._tm_nm_cache_hits.value
+        # Re-enter the build window: cache empty, builder in flight.
+        with svc._nm_lock:
+            svc._nm_cache = None
+            svc._nm_building = key
+        out = []
+        waiters = [threading.Thread(
+            target=lambda: out.append(svc.fetch_parameters(req, None)))
+            for _ in range(3)]
+        for t in waiters:
+            t.start()
+        time.sleep(0.05)                          # all park on the cond
+        with svc._nm_lock:                        # the builder publishes
+            svc._nm_cache = (key, built)
+            svc._nm_building = None
+            svc._nm_cond.notify_all()
+        for t in waiters:
+            t.join(timeout=5.0)
+        assert len(out) == 3
+        assert all(r is built for r in out), \
+            "a parked waiter re-encoded instead of sharing the build"
+        assert svc._tm_nm_cache_hits.value == hits0 + 3
+
+    def test_stuck_builder_times_out_and_self_heals(self, copy_counts):
+        from distributed_parameter_server_for_ml_training_tpu.comms.service import (
+            pack_msg, unpack_msg)
+        _, svc = self._svc()
+        req = pack_msg({"have_step": 0})
+        svc.fetch_parameters(req, None)
+        key = svc._nm_cache[0]
+        with svc._nm_lock:                        # builder died mid-build
+            svc._nm_cache = None
+            svc._nm_building = key
+        reply = svc.fetch_parameters(req, None)   # parks 0.25s, rebuilds
+        meta, payload = unpack_msg(reply)
+        assert meta["not_modified"] is True and payload == b""
+        assert svc._nm_building is None           # latch released
+        assert svc._nm_cache == (key, reply)      # and the cache healed
+        assert copy_counts == {}                  # still encoder-free
+
+
 class TestDecodeZeroCopy:
     def test_decoded_arrays_are_views_into_payload(self):
         blob = wire.encode_tensor_dict(_payload(n_tensors=4))

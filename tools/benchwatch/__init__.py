@@ -54,8 +54,11 @@ _MULTICHIP_FIELDS = {"n_devices": int, "rc": int, "ok": bool,
 #: design — records that predate a field (or record it null) simply don't
 #: contribute a point, so a new field starts at insufficient_history and
 #: only gates once enough rounds carry it. ``codec_mb_per_s`` (ISSUE 14)
-#: is the device-resident push codec's encode throughput.
-EXTRA_METRIC_FIELDS = {"codec_mb_per_s": "MB/s"}
+#: is the device-resident push codec's encode throughput;
+#: ``fanout_qps`` (ISSUE 17) is the edge-replica delta-serve rate of the
+#: two-tier fan-out probe.
+EXTRA_METRIC_FIELDS = {"codec_mb_per_s": "MB/s",
+                       "fanout_qps": "fetch/s"}
 
 
 def _type_errors(obj: dict, fields: dict, ctx: str) -> list:

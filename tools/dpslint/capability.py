@@ -70,8 +70,20 @@ META_KEY_CATALOG: dict[str, tuple[str, ...]] = {
     # rejection is on (push path).
     "health": ("monitor", "reject_nonfinite"),
     # replica announce riding fetch meta: only meaningful on a sharded
-    # primary (ShardingState present).
-    "replica": ("sharding",),
+    # primary (ShardingState present) or an interior fan-out-tree node
+    # (which ingests child announces tier-tagged; docs/SHARDING.md
+    # "Fan-out trees").
+    "replica": ("sharding", "tier"),
+    # fan-out tree fields (docs/SHARDING.md "Fan-out trees"): parent /
+    # tier ride the replica announce (and the replica's re-packed reply
+    # head); a node only acts on them when it tracks tree position.
+    "parent": ("sharding", "tier"),
+    "tier": ("replica", "sharding"),
+    # topology refresh handshake: same delta idiom as have_shard_map —
+    # the request side is an ungated core field, the reply attachment
+    # is only adopted by a subscribing replica.
+    "have_topology": (),
+    "topology": ("replica",),
     # trace context on the envelope: attached/read only when tracing is
     # enabled end to end.
     "trace": ("trace_enabled", "supports_trace_context"),
