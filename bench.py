@@ -325,6 +325,63 @@ def fanout_probe(duration_s: float = 0.75, concurrency: int = 4) -> dict:
     return out
 
 
+def journal_probe(records: int = 400) -> dict:
+    """Durable-journal companion fields (ISSUE 18): what one journal
+    append costs against tmpfs-or-disk — ``journal_write_us`` (median
+    per-record append wall, line-buffered path, no fsync) and
+    ``journal_bytes_per_tick`` (bytes one realistic cumulative snapshot
+    record costs on disk). Both LOWER-is-better in benchwatch's ledger
+    (EXTRA_METRIC_FIELDS direction), gating docs/OBSERVABILITY.md's
+    <2% overhead claim. Failure-hardened nulls like the other probes —
+    never a cost to the throughput record."""
+    import shutil
+    import tempfile
+
+    out = {"journal_write_us": None, "journal_bytes_per_tick": None}
+    tmp = None
+    try:
+        from distributed_parameter_server_for_ml_training_tpu.telemetry \
+            .journal import JournalWriter
+        from distributed_parameter_server_for_ml_training_tpu.telemetry \
+            .registry import LATENCY_BUCKETS, MetricsRegistry
+
+        # A realistic per-tick payload: a registry snapshot the size a
+        # serving process actually carries (a few counters/gauges plus
+        # pinned-bucket latency histograms).
+        reg = MetricsRegistry()
+        for i in range(8):
+            reg.counter("bench_journal_probe_total", stream=str(i)).inc(i)
+            reg.gauge("bench_journal_probe_gauge", stream=str(i)).set(i)
+            h = reg.histogram("bench_journal_probe_seconds",
+                              buckets=LATENCY_BUCKETS, stream=str(i))
+            for v in (0.001, 0.004, 0.02, 0.11):
+                h.observe(v)
+        payload = {"ts": time.time(), **reg.snapshot()}
+        tmp = tempfile.mkdtemp(prefix="bench-journal-")
+        writer = JournalWriter(tmp, role="bench",
+                               registry=MetricsRegistry())
+        walls = []
+        for _ in range(records):
+            t0 = time.perf_counter()
+            writer.append("snapshot", payload)
+            walls.append(time.perf_counter() - t0)
+        writer.seal()
+        total = sum(
+            os.path.getsize(os.path.join(tmp, n))
+            for n in os.listdir(tmp))
+        walls.sort()
+        out = {"journal_write_us":
+               round(walls[len(walls) // 2] * 1e6, 2),
+               "journal_bytes_per_tick": int(round(total / records))}
+    except Exception as e:  # noqa: BLE001 — probe is best-effort
+        print(f"journal probe failed (recording nulls): {e}",
+              file=sys.stderr)
+    finally:
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
 def lint_probe() -> dict:
     """Static-analysis companion fields: ``lint_clean`` (did the tree
     pass dpslint — live findings or a stale baseline mean False) and
@@ -593,6 +650,16 @@ def run_bench(args) -> dict:
         if not getattr(args, "no_fanout_probe", False):
             fanout_fields = fanout_probe()
 
+        # Durable-journal attribution (ISSUE 18): what one telemetry
+        # journal append costs, so BENCH_r* rounds can watch the
+        # black-box recorder's own overhead (lower-is-better in
+        # benchwatch).
+        stage = "journal_probe"
+        journal_fields = {"journal_write_us": None,
+                          "journal_bytes_per_tick": None}
+        if not getattr(args, "no_journal_probe", False):
+            journal_fields = journal_probe()
+
         result = {
             "metric": "cifar100_resnet18_train_images_per_sec_per_chip",
             "value": round(per_chip, 1),
@@ -645,6 +712,8 @@ def run_bench(args) -> dict:
             **fleet_fields,
             # Fan-out-tree attribution (ISSUE 17): see fanout_probe.
             **fanout_fields,
+            # Durable-journal attribution (ISSUE 18): see journal_probe.
+            **journal_fields,
         }
         # Static-analysis attribution (ISSUE 10 satellite): whether the
         # tree this number was measured from passed dpslint, and what the
@@ -697,6 +766,10 @@ def main() -> int:
     parser.add_argument("--no-fleet-probe", action="store_true",
                         help="skip the fleet-collector probe (fleet_* "
                              "fields recorded as null)")
+    parser.add_argument("--no-journal-probe", action="store_true",
+                        help="skip the telemetry-journal probe "
+                             "(journal_write_us/journal_bytes_per_tick "
+                             "record nulls)")
     parser.add_argument("--profile-dir", default=None,
                         help="capture a jax.profiler trace of the timed "
                              "trials into this directory and record "

@@ -20,6 +20,15 @@ from .device_profile import (
     render_profile_table,
 )
 from .fleet_series import extract_exemplars, resolve_exemplars
+from .incidents import (
+    PHASE_ORDER,
+    build_timeline,
+    classify_event,
+    describe_event,
+    list_incidents,
+    load_incident,
+    render_timeline,
+)
 from .runner import run_cell, run_matrix
 from .traces import (
     PHASES,
@@ -32,13 +41,16 @@ from .traces import (
 )
 from .visualize import ExperimentVisualizer
 
-__all__ = ["OP_CLASSES", "PHASES",
+__all__ = ["OP_CLASSES", "PHASES", "PHASE_ORDER",
            "aggregate_worker_metrics", "alert_timeline",
            "assemble_traces", "attribute_profile",
-           "build_telemetry_timeseries", "classify_op",
+           "build_telemetry_timeseries", "build_timeline",
+           "classify_event", "classify_op",
            "cluster_worker_series",
-           "critical_path_report", "device_time_tables",
+           "critical_path_report", "describe_event",
+           "device_time_tables",
            "extract_exemplars",
+           "list_incidents", "load_incident", "render_timeline",
            "find_trace_dumps", "load_chrome_trace", "load_trace_dumps",
            "resolve_exemplars",
            "parse_cluster_series",

@@ -12,6 +12,7 @@ import jax
 import numpy as np
 
 from ..ps.store import ParameterStore
+from ..telemetry.journal import journal_event
 
 
 class CheckpointManager:
@@ -188,6 +189,8 @@ def save_store(store: ParameterStore, directory: str,
     final = os.path.join(directory, f"store_{step:08d}.npz")
     os.replace(tmp_json, os.path.join(directory, f"store_{step:08d}.json"))
     os.replace(tmp_npz, final)
+    journal_event("checkpoint", step=int(step), path=final,
+                  bytes=size)
     return final
 
 

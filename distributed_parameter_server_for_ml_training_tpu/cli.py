@@ -85,6 +85,13 @@ def build_parser() -> argparse.ArgumentParser:
                        help="write the recorder tail as JSON here on "
                             "SIGTERM/unhandled-fault/atexit "
                             "(trace-<role>-<pid>-<reason>.json)")
+        q.add_argument("--journal-dir",
+                       default=_env("DPS_JOURNAL_DIR", None),
+                       help="durable telemetry journal directory "
+                            "(segmented JSONL; snapshots + alert/"
+                            "remediation/directive/migration/checkpoint "
+                            "events — docs/OBSERVABILITY.md 'Incident "
+                            "forensics'; omit = disabled)")
 
     def add_common(q):
         add_platform(q)
@@ -323,6 +330,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "reports, rule engine, /cluster endpoint, /healthz "
                         "readiness flip — docs/OBSERVABILITY.md); on by "
                         "default")
+    s.add_argument("--incidents-dir",
+                   default=_env("DPS_INCIDENTS_DIR", None),
+                   help="auto-freeze a forensic bundle here when a "
+                        "critical alert fires (journal window, /cluster "
+                        "snapshot, flight-recorder tail; per-rule "
+                        "cooldown dedupe — docs/OBSERVABILITY.md "
+                        "'Incident forensics'; needs the health monitor)")
+    s.add_argument("--incident-window", type=float,
+                   default=_env("DPS_INCIDENT_WINDOW", 120.0, float),
+                   help="seconds of journal history frozen per bundle")
+    s.add_argument("--incident-cooldown", type=float,
+                   default=_env("DPS_INCIDENT_COOLDOWN", 120.0, float),
+                   help="per-rule dedupe window: an alert storm yields "
+                        "one bundle per rule per cooldown")
     s.add_argument("--health-interval", type=float,
                    default=_env("DPS_HEALTH_INTERVAL", 5.0, float),
                    help="seconds between cluster health evaluations (and "
@@ -809,6 +830,23 @@ def build_parser() -> argparse.ArgumentParser:
                          "evaluation over MERGED series")
     ob.add_argument("--slo-slow-window", type=float, default=300.0,
                     help="slow burn window (s)")
+    ob.add_argument("--journal-dir",
+                    default=_env("DPS_JOURNAL_DIR", None),
+                    help="journal every tick's merged /fleet view (minus "
+                         "history rings) + slo_burn edges into this "
+                         "durable journal directory — the `cli top "
+                         "--replay` / `cli query` source")
+    ob.add_argument("--incidents-dir",
+                    default=_env("DPS_INCIDENTS_DIR", None),
+                    help="auto-freeze a forensic bundle here on critical "
+                         "fleet alerts / SLO-burn edges (journal window, "
+                         "/fleet snapshot, target trace dumps; "
+                         "docs/OBSERVABILITY.md 'Incident forensics')")
+    ob.add_argument("--incident-window", type=float, default=120.0,
+                    help="seconds of journal history frozen per bundle")
+    ob.add_argument("--incident-cooldown", type=float, default=120.0,
+                    help="per-rule dedupe window: an alert storm yields "
+                         "one bundle per rule per cooldown")
 
     tp = sub.add_parser(
         "top",
@@ -826,6 +864,86 @@ def build_parser() -> argparse.ArgumentParser:
     tp.add_argument("--json", action="store_true",
                     help="print the raw /fleet JSON instead of the "
                          "dashboard")
+    tp.add_argument("--replay", default=None, metavar="JOURNAL_DIR",
+                    help="scrub a PAST run on the same dashboard: read "
+                         "fleet_tick records from a journal directory "
+                         "(cli observe --journal-dir) instead of polling "
+                         "a live /fleet; --watch steps frames at that "
+                         "interval, one-shot renders the final frame")
+
+    inc = sub.add_parser(
+        "incident",
+        help="incident forensics over auto-captured bundles "
+             "(docs/OBSERVABILITY.md 'Incident forensics'): list "
+             "bundles, show a manifest, or reconstruct the causal "
+             "fault->alert->remediation->resolution timeline from the "
+             "on-disk journal — no live process needed")
+    incsub = inc.add_subparsers(dest="incident_command", required=True)
+    inc_common = {
+        "--dir": dict(default=_env("DPS_INCIDENTS_DIR", "incidents"),
+                      help="incidents directory (bundles live in "
+                           "<dir>/<id>/; env DPS_INCIDENTS_DIR)"),
+        "--json": dict(action="store_true",
+                       help="machine-readable output"),
+    }
+    incl = incsub.add_parser("list", help="one row per bundle")
+    incs = incsub.add_parser("show",
+                             help="manifest + bundle contents for one id")
+    incs.add_argument("id", help="bundle id (or unique prefix)")
+    incr = incsub.add_parser(
+        "report",
+        help="merge the bundle's frozen journal window with the "
+             "journal's post-edge segments and render the ordered "
+             "cross-process postmortem timeline")
+    incr.add_argument("id", nargs="?", default=None,
+                      help="bundle id or unique prefix (default: the "
+                           "newest bundle)")
+    incr.add_argument("--journal-dir", default=None,
+                      help="override the journal directory recorded in "
+                           "the manifest (bundle moved hosts)")
+    for q in (incl, incs, incr):
+        for flag, kw in inc_common.items():
+            q.add_argument(flag, **kw)
+
+    qy = sub.add_parser(
+        "query",
+        help="retro-query a durable journal: list/aggregate series over "
+             "a time range with union-exact percentiles (bucket-exact "
+             "histogram merges across processes), or re-run the SLO "
+             "burn evaluation over history (same windows as the live "
+             "evaluator)")
+    qy.add_argument("--journal", required=True,
+                    help="journal directory (or one segment file)")
+    qy.add_argument("--series", default=None,
+                    help="substring filter on metric keys (e.g. "
+                         "'rpc_server_latency')")
+    qy.add_argument("--since", type=float, default=None,
+                    help="window start (unix seconds; percentiles and "
+                         "counter deltas are computed window-exact "
+                         "against the last snapshot at or before it)")
+    qy.add_argument("--until", type=float, default=None,
+                    help="window end (unix seconds; default newest)")
+    qy.add_argument("--last", type=float, default=None, metavar="SECONDS",
+                    help="shorthand: window = newest snapshot minus N "
+                         "seconds (overrides --since)")
+    qy.add_argument("--percentiles", action="store_true",
+                    help="p50/p95/p99 per selected histogram series, "
+                         "merged union-exact across processes")
+    qy.add_argument("--slo", action="store_true",
+                    help="retroactive SLO burn evaluation over the "
+                         "journal's snapshot history (fast + slow "
+                         "windows, telemetry/slo.py semantics); exit "
+                         "code 2 when any critical window breached")
+    qy.add_argument("--slo-fetch-p99-ms", type=float, default=100.0,
+                    help="fetch-latency objective threshold")
+    qy.add_argument("--slo-availability", type=float, default=0.99,
+                    help="availability objective target")
+    qy.add_argument("--slo-fast-window", type=float, default=60.0,
+                    help="fast burn window (s)")
+    qy.add_argument("--slo-slow-window", type=float, default=300.0,
+                    help="slow burn window (s)")
+    qy.add_argument("--json", action="store_true",
+                    help="machine-readable output (QUERY_JSON line)")
 
     pf = sub.add_parser(
         "perf",
@@ -899,14 +1017,24 @@ def _telemetry_session(args, role: str):
     with nothing written) — and the shutdown hooks extend that guarantee
     to SIGTERM: the recorder tail is dumped and the snapshot emitter
     flushes its final interval instead of silently dropping it."""
-    emitter = http_server = None
+    emitter = http_server = journal = None
     tracing = getattr(args, "trace", False)
     dump_dir = getattr(args, "trace_dump_dir", None)
+    journal_dir = getattr(args, "journal_dir", None)
     if tracing:
         from .telemetry import enable_tracing
         enable_tracing(buffer=getattr(args, "trace_buffer", 4096),
                        role=role)
-    if tracing or dump_dir or getattr(args, "telemetry", False):
+    if journal_dir:
+        # Durable journal (ISSUE 18): installed process-globally BEFORE
+        # the command body so every chokepoint (alert edges, directives,
+        # migrations, checkpoints, fault arming) journals from the
+        # first event on; independent of --telemetry.
+        from .telemetry import JournalWriter, set_journal
+        journal = JournalWriter(journal_dir, role=role)
+        set_journal(journal)
+    if tracing or dump_dir or journal \
+            or getattr(args, "telemetry", False):
         from .telemetry import install_shutdown_hooks
         install_shutdown_hooks(dump_dir=dump_dir, role=role)
     port = getattr(args, "metrics_port", None)
@@ -926,11 +1054,19 @@ def _telemetry_session(args, role: str):
         register_build_info()
         emitter = SnapshotEmitter(
             interval=getattr(args, "telemetry_interval", 5.0),
-            role=role).start()
+            role=role, journal=journal).start()
         # SIGTERM/atexit flush: the final snapshot of a terminating
         # process is never lost (ISSUE 3 satellite; flush_now is a no-op
-        # once stop() below already emitted the final line).
+        # once stop() below already emitted the final line). With a
+        # journal attached the same hook also seals the active segment
+        # (ISSUE 18): a SIGTERM'd process leaves a crash-consistent,
+        # fsync'd tail.
         add_shutdown_flush(emitter.flush_now)
+    if journal is not None and emitter is None:
+        # No emitter to piggyback on: seal the journal directly from
+        # the SIGTERM/atexit shutdown path.
+        from .telemetry import add_shutdown_flush
+        add_shutdown_flush(journal.seal)
     try:
         yield
     finally:
@@ -938,6 +1074,12 @@ def _telemetry_session(args, role: str):
             from .telemetry import remove_shutdown_flush
             emitter.stop(final=True)
             remove_shutdown_flush(emitter.flush_now)
+        if journal is not None:
+            from .telemetry import remove_shutdown_flush, set_journal
+            set_journal(None)
+            journal.seal()
+            if emitter is None:
+                remove_shutdown_flush(journal.seal)
         if http_server is not None:
             http_server.shutdown()
 
@@ -1254,6 +1396,38 @@ def _cmd_serve(args) -> int:
         print(f"remediation: engine on "
               f"(dry_run={engine.policy.dry_run})", file=sys.stderr,
               flush=True)
+    if getattr(args, "faults", None):
+        # The seeded fault plan is the postmortem's root-cause record:
+        # journal it at arm time so `cli incident report` can open the
+        # narrative with the fault that caused everything after it.
+        from .telemetry import journal_event
+        journal_event("fault", spec=args.faults, side="server")
+    incidents_dir = getattr(args, "incidents_dir", None)
+    if incidents_dir:
+        # Incident capture (docs/OBSERVABILITY.md "Incident forensics"):
+        # a critical alert edge freezes journal window + /cluster view +
+        # flight-recorder tail into incidents/<id>/, deduped per rule.
+        if monitor is None:
+            raise SystemExit("--incidents-dir needs the health monitor "
+                             "(drop --no-health-monitor)")
+        from .telemetry import IncidentCapture, get_journal, get_recorder
+        capture = IncidentCapture(
+            incidents_dir, journal=get_journal(),
+            # evaluate=False: the capture runs INSIDE monitor.evaluate()
+            # (listener callback, _eval_lock held) — re-evaluating here
+            # self-deadlocks and hangs every later /cluster request. The
+            # cached state is the as-of-the-edge view anyway.
+            views_fn=lambda: {
+                "cluster": monitor.cluster_view(evaluate=False)},
+            traces_fn=lambda trigger: [
+                (f"flight-server-{os.getpid()}.json",
+                 get_recorder().dump_payload("incident"))],
+            window_s=getattr(args, "incident_window", 120.0),
+            cooldown_s=getattr(args, "incident_cooldown", 120.0),
+            role="server")
+        monitor.add_listener(capture.on_alert_events)
+        print(f"incidents: capture armed -> {incidents_dir}",
+              file=sys.stderr, flush=True)
     if getattr(args, "autoscale", False) and monitor is None:
         raise SystemExit("--autoscale needs the health monitor "
                          "(drop --no-health-monitor)")
@@ -2024,15 +2198,42 @@ def cmd_observe(args) -> int:
         print("observe: --targets needs at least one endpoint",
               file=sys.stderr)
         return 1
+    registry = MetricsRegistry()
+    journal = None
+    if getattr(args, "journal_dir", None):
+        # Durable fleet journal (ISSUE 18): one fleet_tick record per
+        # scrape (the merged view minus history rings) + slo_burn
+        # edges — the `cli top --replay` / `cli query` source.
+        from .telemetry.journal import JournalWriter
+        journal = JournalWriter(args.journal_dir, role="observer",
+                                registry=registry)
+    incidents = None
+    if getattr(args, "incidents_dir", None):
+        from .telemetry.incidents import IncidentCapture
+        incidents = IncidentCapture(
+            args.incidents_dir, journal=journal,
+            window_s=getattr(args, "incident_window", 120.0),
+            cooldown_s=getattr(args, "incident_cooldown", 120.0),
+            role="observer", registry=registry)
     collector = FleetCollector(
         targets, interval_s=args.interval, timeout_s=args.timeout,
         ring_depth=args.ring_depth,
-        registry=MetricsRegistry(),
+        registry=registry,
         objectives=default_objectives(
             fetch_p99_ms=args.slo_fetch_p99_ms,
             availability=args.slo_availability),
         fast_window_s=args.slo_fast_window,
-        slow_window_s=args.slo_slow_window)
+        slow_window_s=args.slo_slow_window,
+        journal=journal, incidents=incidents)
+    if incidents is not None:
+        # Bundle context comes from the collector itself: the merged
+        # /fleet view, and flight-recorder dumps pulled over HTTP from
+        # the (still-reachable) implicated targets.
+        incidents.views_fn = lambda: {"fleet": collector.view()}
+        incidents.traces_fn = \
+            lambda trigger: _fleet_trace_dumps(collector)
+        print(f"observe: incident capture armed -> {args.incidents_dir}",
+              file=sys.stderr, flush=True)
     server, port = start_fleet_server(collector, port=args.port)
     print(f"observe up on :{port} ({len(targets)} seed target(s), "
           f"interval={args.interval:g}s, timeout={args.timeout:g}s)",
@@ -2045,7 +2246,36 @@ def cmd_observe(args) -> int:
     finally:
         stop.set()
         server.shutdown()
+        if journal is not None:
+            journal.seal()
     return 0
+
+
+def _fleet_trace_dumps(collector, limit: int = 4) -> list:
+    """Best-effort ``/debug/trace`` pulls from the fleet's reachable
+    targets for an incident bundle's ``traces/`` directory."""
+    import json as _json
+    import urllib.request as _request
+    out = []
+    try:
+        view = collector.view()
+    except Exception:  # noqa: BLE001 — capture context is best-effort
+        return out
+    for row in view.get("targets", []):
+        if len(out) >= limit:
+            break
+        base = row.get("target")
+        if not base or not row.get("ok"):
+            continue
+        try:
+            with _request.urlopen(base + "/debug/trace",
+                                  timeout=collector.timeout_s) as r:
+                payload = _json.loads(r.read().decode())
+        except Exception:  # noqa: BLE001 — dead target = no dump
+            continue
+        name = base.split("//", 1)[-1].replace(":", "-").replace("/", "_")
+        out.append((f"trace-{name}.json", payload))
+    return out
 
 
 _SPARK_CHARS = "▁▂▃▄▅▆▇█"
@@ -2196,15 +2426,101 @@ def _render_top(view: dict) -> str:
     return "\n".join(lines)
 
 
+def _merge_top_history(local: dict | None, view: dict,
+                       last_ticks: int | None,
+                       depth: int = 600) -> dict:
+    """Client half of the ``?since=<tick>`` protocol (ISSUE 18): merge
+    one ``/fleet`` payload into the locally-kept history rings.
+
+    A capable server echoes ``history_since`` and ships only the
+    entries after that tick — append them. An older server ignores the
+    query and ships its full rings — detected by the missing marker (or
+    a tick counter that went BACKWARDS: collector restart) and degraded
+    to full replacement, pre-ISSUE-18 behaviour. Returns the rings and
+    mutates ``view["history"]`` to the merged view for rendering."""
+    from collections import deque
+    incremental = (local is not None
+                   and view.get("history_since") == last_ticks
+                   and last_ticks is not None
+                   and view.get("ticks", 0) >= last_ticks)
+    if not incremental:
+        local = {k: deque(rows, maxlen=depth)
+                 for k, rows in (view.get("history") or {}).items()}
+    else:
+        for k, rows in (view.get("history") or {}).items():
+            ring = local.setdefault(k, deque(maxlen=depth))
+            ring.extend(rows)
+    view["history"] = {k: list(v) for k, v in local.items()}
+    return local
+
+
+def _top_replay(args) -> int:
+    """``cli top --replay <journal>``: scrub a past run on the same
+    dashboard from the observer's ``fleet_tick`` journal records. The
+    journaled views carry no history rings (that is what keeps
+    journal_bytes_per_tick flat); the rings are rebuilt here by
+    accumulating the per-tick scalars, so sparklines match what a live
+    watcher saw."""
+    import json as _json
+    import time as _time
+
+    from .telemetry.journal import JournalReader
+
+    reader = JournalReader(args.replay)
+    frames = reader.records(types=("fleet_tick",))
+    if not frames:
+        print(f"top: no fleet_tick records in {args.replay}",
+              file=sys.stderr)
+        return 1
+    hist = {"fleet_qps": [], "p99_ms": [], "scrape_ms": []}
+    views = []
+    for rec in frames:
+        v = dict(rec.get("view") or {})
+        hist["fleet_qps"].append(v.get("fleet_qps"))
+        p99 = None
+        for obj in (v.get("slo") or {}).get("objectives", []):
+            if "p99_ms" in obj:
+                p99 = obj["p99_ms"]
+                break
+        hist["p99_ms"].append(p99)
+        hist["scrape_ms"].append((v.get("scrape") or {}).get("last_ms"))
+        v["history"] = {k: list(rows) for k, rows in hist.items()}
+        views.append(v)
+    span = frames[-1].get("ts", 0.0) - frames[0].get("ts", 0.0)
+    if args.json:
+        print(_json.dumps(views[-1], indent=2))
+        return _top_exit_code(views[-1])
+    if args.watch <= 0:
+        print(_render_top(views[-1]))
+        print(f"\n(replayed {len(views)} tick(s) spanning {span:.1f}s "
+              f"from {args.replay})")
+        return _top_exit_code(views[-1])
+    rc = 0
+    try:
+        for i, v in enumerate(views):
+            print("\x1b[2J\x1b[H", end="")  # clear screen, home cursor
+            print(_render_top(v))
+            print(f"\n(replay frame {i + 1}/{len(views)} from "
+                  f"{args.replay} — Ctrl-C to stop)")
+            rc = _top_exit_code(v)
+            if i < len(views) - 1:
+                _time.sleep(args.watch)
+    except KeyboardInterrupt:
+        pass
+    return rc
+
+
 def cmd_top(args) -> int:
-    """Live fleet dashboard over a collector's ``GET /fleet``. Exit
-    codes match ``cli status`` (see ``_top_exit_code``); 1 when the
-    collector is unreachable."""
+    """Live fleet dashboard over a collector's ``GET /fleet`` (or a
+    journal replay with ``--replay``). Exit codes match ``cli status``
+    (see ``_top_exit_code``); 1 when the collector is unreachable."""
     import json as _json
     import time as _time
     from urllib.error import HTTPError, URLError
     from urllib.request import urlopen
 
+    if getattr(args, "replay", None):
+        return _top_replay(args)
     base = args.url
     if not base:
         print("top: need --url (or DPS_FLEET_URL)", file=sys.stderr)
@@ -2212,13 +2528,22 @@ def cmd_top(args) -> int:
     if not base.startswith(("http://", "https://")):
         base = "http://" + base
     url = base.rstrip("/") + "/fleet"
+    state = {"hist": None, "ticks": None}
 
     def poll() -> int:
+        # After the first full fetch, ask only for the history delta
+        # (?since=<tick>); degradation-pinned — _merge_top_history
+        # falls back to full replacement against older servers.
+        q = f"?since={state['ticks']}" if state["ticks"] is not None \
+            else ""
         try:
-            view = _json.loads(urlopen(url, timeout=5).read())
+            view = _json.loads(urlopen(url + q, timeout=5).read())
         except (HTTPError, URLError, OSError, ValueError) as e:
             print(f"top: cannot reach {url}: {e}", file=sys.stderr)
             return 1
+        state["hist"] = _merge_top_history(state["hist"], view,
+                                           state["ticks"])
+        state["ticks"] = view.get("ticks")
         if args.json:
             print(_json.dumps(view, indent=2))
         else:
@@ -2786,6 +3111,313 @@ def cmd_lint(args) -> int:
     return dpslint_main(argv)
 
 
+def cmd_incident(args) -> int:
+    """``cli incident list|show|report`` over auto-captured bundles —
+    postmortems from disk alone (docs/OBSERVABILITY.md)."""
+    import json as _json
+
+    from .analysis.incidents import (build_timeline, list_incidents,
+                                     load_incident, render_timeline)
+
+    rows = list_incidents(args.dir)
+    if args.incident_command == "list":
+        if args.json:
+            print(_json.dumps(rows, indent=2, default=str))
+            return 0
+        if not rows:
+            print(f"no incident bundles under {args.dir}")
+            return 0
+        print(f"{'ID':<44} {'RULE':<16} {'SEV':<9} {'RECORDS':>7} "
+              f"{'FILES':>5}")
+        for m in rows:
+            trig = m.get("trigger") or {}
+            print(f"{m.get('id', '?'):<44} "
+                  f"{str(trig.get('rule', '-')):<16} "
+                  f"{str(trig.get('severity', '-')):<9} "
+                  f"{m.get('records', 0):>7} "
+                  f"{len(m.get('files') or []):>5}")
+        return 0
+    wanted = getattr(args, "id", None)
+    if wanted is None:
+        if not rows:
+            print(f"incident: no bundles under {args.dir}",
+                  file=sys.stderr)
+            return 1
+        manifest = rows[-1]
+    else:
+        matches = [m for m in rows
+                   if str(m.get("id", "")).startswith(wanted)]
+        exact = [m for m in matches if m.get("id") == wanted]
+        if exact:
+            matches = exact
+        if len(matches) != 1:
+            print(f"incident: id {wanted!r} matches "
+                  f"{len(matches)} bundle(s) under {args.dir}",
+                  file=sys.stderr)
+            return 1
+        manifest = matches[0]
+    bundle = manifest["path"]
+    if args.incident_command == "show":
+        if args.json:
+            print(_json.dumps(manifest, indent=2, default=str))
+        else:
+            trig = manifest.get("trigger") or {}
+            print(f"incident {manifest.get('id')}")
+            print(f"  created   {manifest.get('created_ts')} "
+                  f"(role {manifest.get('role')})")
+            print(f"  trigger   {trig.get('rule')} "
+                  f"[{trig.get('severity')}] "
+                  f"worker={trig.get('worker')} "
+                  f"value={trig.get('value')}")
+            print(f"  window    {manifest.get('window_s')}s, "
+                  f"{manifest.get('records')} journal record(s)")
+            print(f"  journal   {manifest.get('journal_dir')}")
+            for f in manifest.get("files") or []:
+                print(f"  file      {f}")
+        return 0
+    # report: frozen window + the journal's post-edge continuation.
+    data = load_incident(bundle,
+                         journal_dir=getattr(args, "journal_dir", None))
+    timeline = build_timeline(data["records"])
+    if args.json:
+        print(_json.dumps({"manifest": data["manifest"],
+                           "timeline": timeline, "stats": data["stats"]},
+                          indent=2, default=str))
+    else:
+        print(render_timeline(timeline, data["manifest"]))
+    return 0
+
+
+def _query_streams(records: list) -> dict:
+    """Snapshot records grouped per process: (role, pid) -> time-sorted
+    list (the journal reader already sorted globally)."""
+    streams: dict = {}
+    for rec in records:
+        streams.setdefault((rec.get("role"), rec.get("pid")),
+                           []).append(rec)
+    return streams
+
+
+def _hist_at(stream: list, key: str, ts: float | None) -> dict | None:
+    """Newest snapshot's histogram ``key`` at or before ``ts`` (None =
+    newest overall) — cumulative, so this IS the prefix total."""
+    best = None
+    for rec in stream:
+        if ts is not None and rec.get("ts", 0.0) > ts:
+            break
+        h = (rec.get("histograms") or {}).get(key)
+        if h is not None:
+            best = h
+    return best
+
+
+def _window_hist(stream: list, key: str, since: float | None,
+                 until: float | None) -> dict | None:
+    """Window-exact bucket counts for one process: cumulative newest
+    minus the cumulative baseline at-or-before the window start. This
+    is the union-exact property the journal's cumulative snapshots buy:
+    no rate estimation, just integer bucket subtraction."""
+    newest = _hist_at(stream, key, until)
+    if newest is None:
+        return None
+    out = {"le": list(newest.get("le") or []),
+           "counts": [int(c) for c in newest.get("counts") or []],
+           "sum": float(newest.get("sum", 0.0)),
+           "count": int(newest.get("count", 0))}
+    if since is not None:
+        base = _hist_at(stream, key, since)
+        if base is not None and list(base.get("le") or []) == out["le"]:
+            out["counts"] = [max(0, a - int(b)) for a, b in
+                             zip(out["counts"], base.get("counts") or [])]
+            out["sum"] = max(0.0, out["sum"]
+                             - float(base.get("sum", 0.0)))
+            out["count"] = max(0, out["count"]
+                               - int(base.get("count", 0)))
+    return out
+
+
+def _retro_slo(records: list, args) -> dict:
+    """Retroactive SLO burn evaluation over journal history, reusing
+    the live evaluator's window semantics (telemetry/slo.py): rebuild
+    the fleet-summed (total, bad) sample sequence the collector keeps
+    in memory, then slide the same fast/slow windows over it."""
+    from .telemetry.registry import MetricsRegistry
+    from .telemetry.slo import SloEvaluator, default_objectives
+
+    objectives = default_objectives(
+        fetch_p99_ms=args.slo_fetch_p99_ms,
+        availability=args.slo_availability)
+    windows = SloEvaluator(objectives, registry=MetricsRegistry(),
+                           fast_window_s=args.slo_fast_window,
+                           slow_window_s=args.slo_slow_window).windows
+    streams = list(_query_streams(records).values())
+    ticks = sorted({rec.get("ts", 0.0) for rec in records})
+    samples = []
+    for t in ticks:
+        sample: dict = {}
+        for obj in objectives:
+            hkey = (f"dps_rpc_server_latency_seconds"
+                    f"{{method={obj.method}}}")
+            ekey = (f"dps_rpc_server_errors_total"
+                    f"{{method={obj.method}}}")
+            total = bad = 0
+            found = False
+            for stream in streams:
+                h = _hist_at(stream, hkey, t)
+                if h is None:
+                    continue
+                found = True
+                n = int(h.get("count", 0))
+                total += n
+                err = 0
+                for rec in stream:
+                    if rec.get("ts", 0.0) > t:
+                        break
+                    err = int((rec.get("counters") or {})
+                              .get(ekey, err))
+                if obj.threshold_s is None:
+                    bad += min(n, err)
+                else:
+                    good, _ = SloEvaluator._good_upto(h, obj.threshold_s)
+                    bad += min(n, (n - good) + err)
+            if found:
+                sample[obj.name] = (total, bad)
+        samples.append((t, sample))
+    out: dict = {"samples": len(samples), "windows": {}}
+    any_critical = False
+    for win in windows:
+        wrow: dict = {}
+        for obj in objectives:
+            max_burn = 0.0
+            breach_ts: list = []
+            for t, _ in samples:
+                d = SloEvaluator._window_delta(samples, obj.name, t,
+                                               win.window_s)
+                if d is None or d["total"] < win.min_events:
+                    continue
+                burn = SloEvaluator._burn(obj, d["bad"], d["total"])
+                max_burn = max(max_burn, burn)
+                if burn >= win.burn_threshold:
+                    breach_ts.append(t)
+            breached = bool(breach_ts)
+            if breached and win.severity == "critical":
+                any_critical = True
+            wrow[obj.name] = {
+                "max_burn": round(max_burn, 2),
+                "burn_threshold": win.burn_threshold,
+                "breached": breached,
+                "severity": win.severity,
+                "first_breach_ts": breach_ts[0] if breach_ts else None,
+                "last_breach_ts": breach_ts[-1] if breach_ts else None,
+                "breach_samples": len(breach_ts),
+            }
+        out["windows"][win.rule] = {"window_s": win.window_s,
+                                    "objectives": wrow}
+    out["any_critical_breach"] = any_critical
+    return out
+
+
+def cmd_query(args) -> int:
+    """``cli query``: retro-query a durable journal — series listing,
+    union-exact windowed percentiles, retroactive SLO burn."""
+    import json as _json
+
+    from .telemetry.journal import JournalReader
+    from .telemetry.stats import histogram_quantile, merge_histograms
+
+    reader = JournalReader(args.journal)
+    snaps = reader.records(types=("snapshot", "fleet_tick"))
+    snaps = [r for r in snaps if r.get("type") == "snapshot"
+             or "histograms" in r]
+    if not snaps:
+        print(f"query: no snapshot records in {args.journal}",
+              file=sys.stderr)
+        return 1
+    newest_ts = max(r.get("ts", 0.0) for r in snaps)
+    until = args.until if args.until is not None else newest_ts
+    since = args.since
+    if args.last is not None:
+        since = until - args.last
+    in_range = [r for r in snaps if r.get("ts", 0.0) <= until]
+    result: dict = {"journal": args.journal,
+                    "window": {"since": since, "until": until},
+                    "reader_stats": reader.stats}
+    if args.slo:
+        result["slo"] = _retro_slo(in_range, args)
+    streams = _query_streams(in_range)
+    selected: dict = {}
+    for stream in streams.values():
+        for rec in stream:
+            for kind in ("counters", "gauges", "histograms"):
+                for key in (rec.get(kind) or {}):
+                    if args.series and args.series not in key:
+                        continue
+                    selected.setdefault(kind, set()).add(key)
+    if args.percentiles:
+        pct_rows: dict = {}
+        for key in sorted(selected.get("histograms", ())):
+            parts = []
+            for stream in streams.values():
+                h = _window_hist(stream, key, since, until)
+                if h is not None and h["count"] > 0:
+                    parts.append(h)
+            if not parts:
+                continue
+            try:
+                merged = merge_histograms(parts)
+            except ValueError:
+                continue
+            row = {"count": int(merged["count"]),
+                   "processes": len(parts)}
+            for pct, name in ((50, "p50"), (95, "p95"), (99, "p99")):
+                q = histogram_quantile(merged["le"], merged["counts"],
+                                       pct)
+                row[name] = None if q is None else round(q, 6)
+            pct_rows[key] = row
+        result["percentiles"] = pct_rows
+    else:
+        series: dict = {}
+        for kind in ("counters", "gauges", "histograms"):
+            for key in sorted(selected.get(kind, ())):
+                n = sum(1 for stream in streams.values()
+                        if any(key in (rec.get(kind) or {})
+                               for rec in stream))
+                series[key] = {"kind": kind[:-1], "processes": n}
+        result["series"] = series
+    rc = 2 if args.slo and result["slo"]["any_critical_breach"] else 0
+    if args.json:
+        print("QUERY_JSON: " + _json.dumps(result, default=str))
+        return rc
+    print(f"journal {args.journal}: {reader.stats['records']} record(s) "
+          f"in {reader.stats['segments']} segment(s) "
+          f"({reader.stats['torn_tails']} torn tail(s), "
+          f"{reader.stats['corrupt_lines']} corrupt line(s) skipped)")
+    if "series" in result:
+        print(f"{'SERIES':<64} {'KIND':<10} {'PROCS':>5}")
+        for key, row in result["series"].items():
+            print(f"{key:<64} {row['kind']:<10} {row['processes']:>5}")
+    if "percentiles" in result:
+        print(f"{'SERIES':<64} {'COUNT':>8} {'P50':>10} {'P95':>10} "
+              f"{'P99':>10}")
+        for key, row in result["percentiles"].items():
+            def _fmt(v):
+                return "-" if v is None else f"{v * 1e3:.2f}ms"
+            print(f"{key:<64} {row['count']:>8} {_fmt(row['p50']):>10} "
+                  f"{_fmt(row['p95']):>10} {_fmt(row['p99']):>10}")
+    if "slo" in result:
+        slo = result["slo"]
+        print(f"retro SLO over {slo['samples']} sample(s):")
+        for rule, wrow in slo["windows"].items():
+            for obj, orow in wrow["objectives"].items():
+                state = "BREACHED" if orow["breached"] else "ok"
+                print(f"  {rule:<14} {obj:<20} max_burn="
+                      f"{orow['max_burn']:<8} (threshold "
+                      f"{orow['burn_threshold']}) {state}")
+        print(f"  any critical breach: "
+              f"{slo['any_critical_breach']}")
+    return rc
+
+
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if getattr(args, "platform", "default") == "cpu":
@@ -2797,6 +3429,7 @@ def main(argv=None) -> int:
             "observe": cmd_observe, "top": cmd_top,
             "loadgen": cmd_loadgen, "reshard": cmd_reshard,
             "infer": cmd_infer, "lint": cmd_lint,
+            "incident": cmd_incident, "query": cmd_query,
             "perf": cmd_perf}[args.command](args)
 
 

@@ -81,6 +81,7 @@ from concurrent import futures
 
 import grpc
 
+from ..telemetry.journal import journal_event
 from .service import GRPC_OPTIONS, SERVICE_NAME, pack_msg, unpack_msg
 
 __all__ = ["CanaryController", "ReplicaServer", "tier_staleness_bound"]
@@ -620,6 +621,8 @@ class ReplicaServer:
         old, self.parent = self.parent, target
         self._connect()
         self._tm_reparents.inc()
+        journal_event("reparent", shard=self.shard_id, old=old,
+                      new=target, tier=self.tier)
         print(f"REPLICA_REPARENTED shard={self.shard_id} old={old} "
               f"new={target} tier={self.tier}", flush=True)
         return True

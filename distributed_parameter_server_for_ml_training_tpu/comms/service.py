@@ -32,6 +32,7 @@ import grpc
 
 from ..ps.sharding import key_slot
 from ..ps.store import ParameterStore
+from ..telemetry.journal import journal_event
 from ..ps.tenancy import DEFAULT_JOB, WID_STRIDE, job_key, \
     normalize_job_id, parse_jobs_spec, split_job_key
 from .wire import decode_tensor_dict, encode_tensor_dict, \
@@ -494,6 +495,7 @@ class ParameterService:
             box = self._directives.setdefault(wid, [])
             box.append({"seq": seq, "action": action, **params})
             del box[:-DIRECTIVES_PER_WORKER_CAP]
+        journal_event("directive", worker=wid, action=action, seq=seq)
         return seq
 
     def directives_for(self, worker_id) -> list[dict]:
@@ -927,6 +929,9 @@ class ParameterService:
                                        "lease_deadline":
                                            now + plan["lease_ttl"],
                                        "started_at": now}
+            if plan is not None:
+                journal_event("migration", id=plan["id"], phase="export",
+                              mig_role="donor", slot_lo=lo, slot_hi=hi)
             keys = self._keys_in_slots(lo, hi)
             params, step = self.store.export_params(keys)
             return pack_msg({"export_step": step,
@@ -955,6 +960,8 @@ class ParameterService:
                                        "lease_deadline":
                                            now + plan["lease_ttl"],
                                        "started_at": now}
+                journal_event("migration", id=plan["id"], phase="import",
+                              mig_role="recipient")
             return pack_msg({"adopted": adopted, "journal_loaded": loaded,
                              **self._shard_fields()})
         if op == "apply_ranges":
@@ -963,6 +970,7 @@ class ParameterService:
             # markers for slots handed away are redundant (the range
             # check disowns), and markers for slots the map says we KEEP
             # would contradict it (an aborted handoff must un-freeze).
+            applied = None
             with self._reshard_lock:
                 self._draining.clear()
                 rec = self._migration
@@ -976,17 +984,26 @@ class ParameterService:
                         # The recipient now OWNS the adopted range — its
                         # half of the migration is complete.
                         self._migration = None
+                    applied = (rec["id"], rec["role"])
+            if applied is not None:
+                journal_event("migration", id=applied[0],
+                              phase="apply_ranges", mig_role=applied[1])
             return pack_msg({"map_version": version,
                              **self._shard_fields()})
         # commit: the recipient holds the range; release the donor copy.
         lo, hi = int(meta["slot_lo"]), int(meta["slot_hi"])
         dropped = self.store.drop_params(self._keys_in_slots(lo, hi))
+        committed = None
         with self._reshard_lock:
             self._draining -= set(range(lo, hi))
             rec = self._migration
             if rec is not None and (plan is None
                                     or rec["id"] == plan["id"]):
+                committed = rec["id"]
                 self._migration = None
+        if committed is not None:
+            journal_event("migration", id=committed, phase="commit",
+                          mig_role="donor", dropped=dropped)
         return pack_msg({"dropped": dropped, **self._shard_fields()})
 
     def _apply_ranges(self, meta: dict) -> int:

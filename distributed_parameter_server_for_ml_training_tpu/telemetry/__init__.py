@@ -45,6 +45,16 @@ from .fleet import (
     start_fleet_server,
 )
 from .stats import histogram_quantile, merge_histograms
+from .journal import (
+    EVENT_CATALOG,
+    JournalReader,
+    JournalWriter,
+    get_journal,
+    journal_event,
+    read_journal,
+    set_journal,
+)
+from .incidents import MANIFEST_FIELDS, IncidentCapture
 from .cluster import (
     ClusterMonitor,
     get_cluster_monitor,
@@ -93,6 +103,7 @@ __all__ = [
     "ClusterMonitor",
     "ClusterState",
     "Counter",
+    "EVENT_CATALOG",
     "ExemplarSampler",
     "FLEET_ROLLUP_FIELDS",
     "FleetCollector",
@@ -101,8 +112,12 @@ __all__ = [
     "HealthRuleEngine",
     "HealthThresholds",
     "Histogram",
+    "IncidentCapture",
+    "JournalReader",
+    "JournalWriter",
     "LATENCY_BUCKETS",
     "LATENCY_BUCKETS_S",
+    "MANIFEST_FIELDS",
     "MetricsRegistry",
     "RULE_CATALOG",
     "RemediationEngine",
@@ -123,18 +138,22 @@ __all__ = [
     "disable_tracing",
     "enable_tracing",
     "get_cluster_monitor",
+    "get_journal",
     "get_recorder",
     "get_registry",
     "histogram_quantile",
     "install_shutdown_hooks",
+    "journal_event",
     "merge_histograms",
     "note_action",
     "now",
     "parse_prometheus_text",
+    "read_journal",
     "register_build_info",
     "remove_shutdown_flush",
     "render_prometheus",
     "set_cluster_monitor",
+    "set_journal",
     "span",
     "start_fleet_server",
     "start_metrics_server",

@@ -42,6 +42,7 @@ from .health import (
     HealthThresholds,
     WorkerState,
 )
+from .journal import journal_event
 from .registry import VALUE_BUCKETS, get_registry
 
 __all__ = [
@@ -348,6 +349,9 @@ class ClusterMonitor:
                     if counter is not None:
                         counter.inc()
                 self._record_event(ev)
+                journal_event("alert",
+                              **{k: v for k, v in ev.items()
+                                 if v is not None})
             self._tm_workers.set(len([w for w in state.workers.values()
                                       if w.in_membership]))
             self._tm_active.set(len(active))

@@ -291,11 +291,19 @@ class FleetCollector:
                  objectives: list | None = None,
                  fast_window_s: float = 60.0,
                  slow_window_s: float = 300.0,
+                 journal=None, incidents=None,
                  clock=time.time):
         self.interval_s = float(interval_s)
         self.timeout_s = float(timeout_s)
         self.ring_depth = int(ring_depth)
         self.rate_window_s = float(rate_window_s)
+        #: Optional JournalWriter: every tick appends one ``fleet_tick``
+        #: record (the merged view minus its history rings) plus
+        #: ``slo_burn`` edge records — the replay/forensics feed.
+        self.journal = journal
+        #: Optional IncidentCapture fed each tick's view (observer-side
+        #: critical alert / SLO-burn capture).
+        self.incidents = incidents
         self.clock = clock
         self.registry = registry if registry is not None else get_registry()
         self.objectives = list(objectives if objectives is not None
@@ -321,6 +329,8 @@ class FleetCollector:
             "p99_ms": deque(maxlen=self.ring_depth),
             "scrape_ms": deque(maxlen=self.ring_depth),
         }
+        # SLO breach identities already journaled as ``slo_burn`` edges.
+        self._journaled_breaches = set()  # guarded by: self._lock
         # Collector's own instruments (scraping the observer works too).
         self._tm_ticks = self.registry.counter("dps_fleet_ticks_total")
         self._tm_targets = self.registry.gauge("dps_fleet_targets")
@@ -433,8 +443,75 @@ class FleetCollector:
                 for s in self._states.values()))
             self._tm_scrape.observe(ms / 1e3)
             ok = sum(1 for s in self._states.values() if s.ok)
-            return {"ok": ok, "failed": len(self._states) - ok,
-                    "scrape_ms": round(ms, 3)}
+            out = {"ok": ok, "failed": len(self._states) - ok,
+                   "scrape_ms": round(ms, 3)}
+        self._post_tick()
+        return out
+
+    def _post_tick(self) -> None:
+        """Forensics fan-out, outside the collector lock: journal this
+        tick's merged view (minus the history rings — replay rebuilds
+        those from consecutive ticks) and new ``slo_burn`` edges, then
+        feed the incident engine. All best-effort: a full disk or a
+        capture failure must never stall the scrape loop."""
+        if self.journal is None and self.incidents is None:
+            return
+        try:
+            v = self.view()
+        except Exception:  # noqa: BLE001 — forensics never stalls ticks
+            return
+        breaches = (v.get("slo") or {}).get("breaches") or []
+        with self._lock:
+            new = [b for b in breaches
+                   if (b["rule"], b["objective"])
+                   not in self._journaled_breaches]
+            self._journaled_breaches = {(b["rule"], b["objective"])
+                                        for b in breaches}
+        if self.journal is not None:
+            try:
+                slim = {k: val for k, val in v.items() if k != "history"}
+                if isinstance(slim.get("rollups"), dict):
+                    slim["rollups"] = self._slim_rollups(slim["rollups"])
+                self.journal.append("fleet_tick",
+                                    {"ts": v["ts"], "view": slim})
+                for b in new:
+                    self.journal.append("slo_burn", dict(b))
+            except Exception:  # noqa: BLE001 — disk full degrades
+                pass
+        if self.incidents is not None:
+            try:
+                self.incidents.on_fleet_view(v)
+            except Exception:  # noqa: BLE001 — capture never stalls
+                pass
+
+    @staticmethod
+    def _slim_rollups(roll: dict) -> dict:
+        """The journaled copy of one tick's rollups, minus the
+        zero-valued counter/histogram vocabulary (same rationale as
+        ``SnapshotEmitter._journal_payload``: the pre-created
+        alert/fault grids dominate the bytes, and replay reads an
+        absent series exactly like a present zero). The live ``/fleet``
+        response keeps its full-vocabulary rollups untouched."""
+        out = dict(roll)
+        ctr = roll.get("counters")
+        if isinstance(ctr, dict):
+            out["counters"] = {
+                k: r for k, r in ctr.items()
+                if not isinstance(r, dict)
+                or r.get("sum") or r.get("rate_per_s")}
+        gauges = roll.get("gauges")
+        if isinstance(gauges, dict):
+            out["gauges"] = {
+                k: r for k, r in gauges.items()
+                if not isinstance(r, dict)
+                or r.get("min") or r.get("max")}
+        hists = roll.get("histograms")
+        if isinstance(hists, dict):
+            out["histograms"] = {
+                k: h for k, h in hists.items()
+                if not isinstance(h, dict)
+                or h.get("count") or "error" in h}
+        return out
 
     def _refresh_discovery_locked(self) -> None:
         """Adopt replica metrics addresses announced via the primaries'
@@ -733,14 +810,41 @@ class FleetCollector:
             stop.wait(max(0.05, self.interval_s - elapsed))
 
 
+def _since_param(query: str) -> int | None:
+    """``since=<tick>`` from a raw query string; None when absent or
+    unparseable (full payload — the pre-ISSUE-18 behaviour)."""
+    for part in query.split("&"):
+        if part.startswith("since="):
+            try:
+                return max(0, int(part[len("since="):]))
+            except ValueError:
+                return None
+    return None
+
+
 class _FleetHandler(BaseHTTPRequestHandler):
     collector: FleetCollector  # set by start_fleet_server
 
     def do_GET(self):  # noqa: N802 (http.server API)
-        path, _, _ = self.path.partition("?")
+        path, _, query = self.path.partition("?")
         if path == "/fleet":
             try:
-                body = json.dumps(self.collector.view()).encode()
+                view = self.collector.view()
+                since = _since_param(query)
+                if since is not None:
+                    # Incremental poll (ISSUE 18): history entry i
+                    # belongs to tick (ticks - len + 1 + i), so a client
+                    # that saw tick N needs exactly the last
+                    # (ticks - N) entries. ``history_since`` is the
+                    # capability marker: an older server ignores the
+                    # query entirely and the client detects the absence
+                    # and degrades to full-ring replacement.
+                    delta = max(0, view["ticks"] - since)
+                    view["history"] = {
+                        k: (rows[-delta:] if delta else [])
+                        for k, rows in view["history"].items()}
+                    view["history_since"] = since
+                body = json.dumps(view).encode()
                 status = 200
             except Exception as e:  # noqa: BLE001
                 body = json.dumps({"error": repr(e)}).encode()

@@ -44,6 +44,7 @@ import time
 from collections import deque
 from dataclasses import dataclass, field
 
+from .journal import journal_event
 from .registry import get_registry
 
 __all__ = [
@@ -118,6 +119,8 @@ def note_action(action: str, outcome: str, registry=None) -> None:
     reg = registry or get_registry()
     reg.counter("dps_remediation_actions_total", action=action,
                 outcome=outcome).inc()
+    journal_event("respawn" if action == "respawn" else "remediation",
+                  action=action, outcome=outcome)
 
 
 @dataclass

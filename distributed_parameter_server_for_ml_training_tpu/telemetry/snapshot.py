@@ -46,13 +46,14 @@ class SnapshotEmitter:
     def __init__(self, registry: MetricsRegistry | None = None,
                  interval: float = 5.0, role: str = "process",
                  proc: dict | None = None, stream: IO | None = None,
-                 clock=time.time):
+                 journal=None, clock=time.time):
         if interval <= 0:
             raise ValueError(f"interval must be > 0, got {interval}")
         self.registry = registry or get_registry()
         self.interval = float(interval)
         self.proc = {"role": role, "pid": os.getpid(), **(proc or {})}
         self.stream = stream
+        self.journal = journal  # guarded by: self._emit_lock
         self.clock = clock
         self.seq = 0  # guarded by: self._emit_lock
         self._t0 = clock()
@@ -73,7 +74,34 @@ class SnapshotEmitter:
                 **self.registry.snapshot(),
             }
             emit_metrics_json(payload, self.stream)
+            if self.journal is not None:
+                try:
+                    self.journal.append("snapshot",
+                                        self._journal_payload(payload))
+                except Exception:  # noqa: BLE001 — durability is
+                    pass           # best-effort beside the live line
             return payload
+
+    @staticmethod
+    def _journal_payload(payload: dict) -> dict:
+        """The journaled copy of one snapshot, minus the zero-valued
+        counter/histogram vocabulary. The live METRICS_JSON line keeps
+        zeros on purpose (scrapes must show the full vocabulary), but
+        journaling the pre-created alert/fault grids re-serializes
+        kilobytes of zeros every interval — measured ~72% of the bytes.
+        Retro-query math is cumulative, so an absent series reads as
+        zero exactly like a present zero did."""
+        out = {k: v for k, v in payload.items() if k != "kind"}
+        for group in ("counters", "gauges"):
+            vals = out.get(group)
+            if isinstance(vals, dict):
+                out[group] = {k: v for k, v in vals.items() if v}
+        hists = out.get("histograms")
+        if isinstance(hists, dict):
+            out["histograms"] = {
+                k: h for k, h in hists.items()
+                if not isinstance(h, dict) or h.get("count")}
+        return out
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval):
@@ -84,9 +112,12 @@ class SnapshotEmitter:
         snapshot unless the emitter was already stopped (whose ``stop``
         emitted the final line). Registered with
         ``telemetry.add_shutdown_flush`` so a SIGTERM'd process's tail
-        interval is never silently dropped (ISSUE 3 satellite)."""
+        interval is never silently dropped (ISSUE 3 satellite). Also
+        seals the journal segment (ISSUE 18): the shutdown path must
+        leave a crash-consistent, fsync'd tail on disk."""
         if not self._stop.is_set():
             self.emit_once()
+        self._seal_journal()
 
     def start(self) -> "SnapshotEmitter":
         if self._thread is not None:
@@ -105,6 +136,15 @@ class SnapshotEmitter:
             self._thread = None
         if final:
             self.emit_once()
+            self._seal_journal()
+
+    def _seal_journal(self) -> None:
+        with self._emit_lock:
+            if self.journal is not None:
+                try:
+                    self.journal.seal()
+                except Exception:  # noqa: BLE001 — shutdown never raises
+                    pass
 
     def __enter__(self) -> "SnapshotEmitter":
         return self.start()

@@ -1,0 +1,300 @@
+"""Incident capture + postmortem timelines (ISSUE 18): bundle
+contents, dedupe under alert storms, fleet-view edge detection, and
+the causal timeline builder behind ``cli incident report``.
+
+Tier-1 throughout: temp dirs, fake clocks, no subprocesses. The live
+cross-process reconstruction lives in the slow recorded-demo wrapper.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import pytest
+
+from distributed_parameter_server_for_ml_training_tpu.analysis import (
+    PHASE_ORDER,
+    build_timeline,
+    classify_event,
+    list_incidents,
+    load_incident,
+    render_timeline,
+)
+from distributed_parameter_server_for_ml_training_tpu.telemetry import (
+    IncidentCapture,
+    JournalWriter,
+    MANIFEST_FIELDS,
+    MetricsRegistry,
+)
+
+CRIT = {"state": "fired", "severity": "critical", "rule": "worker_stale",
+        "worker": "w0", "value": 12.0}
+
+
+def _capture(tmp_path, **kw):
+    kw.setdefault("registry", MetricsRegistry())
+    kw.setdefault("role", "server")
+    return IncidentCapture(str(tmp_path / "incidents"), **kw)
+
+
+def _journal(tmp_path, **kw):
+    kw.setdefault("registry", MetricsRegistry())
+    return JournalWriter(str(tmp_path / "journal"), role="server", **kw)
+
+
+# -- bundle contents ---------------------------------------------------------
+
+def test_capture_freezes_full_bundle(tmp_path):
+    w = _journal(tmp_path)
+    w.append("fault", {"spec": "fetch.delay=0.2@p=1.0", "side": "server"})
+    w.append("alert", dict(CRIT))
+    cap = _capture(
+        tmp_path, journal=w,
+        views_fn=lambda: {"cluster": {"workers": 3}},
+        traces_fn=lambda trig: [("flight-1.json",
+                                 {"spans": [], "rule": trig["rule"]})])
+    bundle = cap.maybe_capture(dict(CRIT))
+    assert bundle is not None
+    with open(os.path.join(bundle, "manifest.json")) as f:
+        manifest = json.load(f)
+    assert set(manifest) == set(MANIFEST_FIELDS)
+    assert manifest["trigger"]["rule"] == "worker_stale"
+    assert manifest["records"] == 2  # fault + alert inside the window
+    assert sorted(manifest["files"]) == [
+        "journal_window.jsonl", "snapshots.json",
+        os.path.join("traces", "flight-1.json")]
+    with open(os.path.join(bundle, "snapshots.json")) as f:
+        assert json.load(f)["cluster"]["workers"] == 3
+    with open(os.path.join(bundle, "traces", "flight-1.json")) as f:
+        assert json.load(f)["rule"] == "worker_stale"
+    # the frozen window is itself a readable journal slice
+    lines = open(os.path.join(bundle,
+                              "journal_window.jsonl")).read().splitlines()
+    assert [json.loads(ln)["type"] for ln in lines] == ["fault", "alert"]
+
+
+def test_capture_degrades_without_sources(tmp_path):
+    cap = _capture(tmp_path)  # no journal, no views, no traces
+    bundle = cap.maybe_capture(dict(CRIT))
+    with open(os.path.join(bundle, "manifest.json")) as f:
+        manifest = json.load(f)
+    assert manifest["files"] == [] and manifest["journal_dir"] is None
+
+
+def test_capture_journals_incident_event(tmp_path):
+    from distributed_parameter_server_for_ml_training_tpu.telemetry \
+        import read_journal, set_journal
+    w = _journal(tmp_path)
+    set_journal(w)
+    try:
+        cap = _capture(tmp_path, journal=w)
+        bundle = cap.maybe_capture(dict(CRIT))
+    finally:
+        set_journal(None)
+        w.seal()
+    incs = read_journal(str(tmp_path / "journal"), types=("incident",))
+    assert len(incs) == 1 and incs[0]["path"] == bundle
+
+
+# -- dedupe under storm ------------------------------------------------------
+
+def test_alert_storm_yields_one_bundle(tmp_path):
+    t = [1000.0]
+    cap = _capture(tmp_path, cooldown_s=120.0, clock=lambda: t[0])
+    storm = []
+    for i in range(25):  # refires every 2s: a classic flap storm
+        t[0] += 2.0
+        storm.append(cap.maybe_capture(dict(CRIT)))
+    bundles = [b for b in storm if b]
+    assert len(bundles) == 1
+    assert cap._tm_captured.value == 1
+    assert cap._tm_suppressed.value == 24
+    # cooldown expiry re-arms the rule
+    t[0] += 121.0
+    assert cap.maybe_capture(dict(CRIT)) is not None
+
+
+def test_distinct_rules_are_independent(tmp_path):
+    cap = _capture(tmp_path, cooldown_s=3600.0)
+    assert cap.maybe_capture(dict(CRIT)) is not None
+    other = dict(CRIT, rule="slo_burn_fast")
+    assert cap.maybe_capture(other) is not None
+    assert cap.maybe_capture(dict(CRIT)) is None  # still cooling down
+
+
+def test_on_alert_events_filters_edges(tmp_path):
+    cap = _capture(tmp_path, cooldown_s=0.0)
+    cap.on_alert_events([
+        {"state": "resolved", "severity": "critical", "rule": "a"},
+        {"state": "fired", "severity": "warning", "rule": "b"},
+        {"state": "fired", "severity": "critical", "rule": "c"},
+    ])
+    rows = list_incidents(str(tmp_path / "incidents"))
+    assert len(rows) == 1 and rows[0]["trigger"]["rule"] == "c"
+
+
+def test_capture_completes_inside_monitor_listener(tmp_path):
+    """The cmd_serve wiring: capture runs INSIDE monitor.evaluate()
+    (listener callback, _eval_lock held), so its views_fn must read the
+    cached view (evaluate=False). A views_fn that re-evaluates
+    self-deadlocks — this pins the fixed wiring by failing (not
+    hanging) if evaluate() never returns."""
+    import threading
+
+    import numpy as np
+
+    from distributed_parameter_server_for_ml_training_tpu.ps.store \
+        import ParameterStore, StoreConfig
+    from distributed_parameter_server_for_ml_training_tpu.telemetry \
+        import ClusterMonitor
+    store = ParameterStore({"w": np.ones(4, np.float32)},
+                           StoreConfig(total_workers=1))
+    mon = ClusterMonitor(store, registry=MetricsRegistry())
+    cap = _capture(
+        tmp_path,
+        views_fn=lambda: {"cluster": mon.cluster_view(evaluate=False)})
+    mon.add_listener(cap.on_alert_events)
+    wid, _ = store.register_worker("w0")
+    assert mon.ingest(wid, {"step": 1, "loss": float("nan")})
+    t = threading.Thread(target=mon.evaluate, daemon=True)
+    t.start()
+    t.join(timeout=30.0)
+    assert not t.is_alive(), "capture deadlocked inside evaluate()"
+    rows = list_incidents(str(tmp_path / "incidents"))
+    assert len(rows) == 1
+    assert rows[0]["trigger"]["rule"] == "nonfinite_loss"
+    assert "snapshots.json" in rows[0]["files"]
+    assert cap._tm_captured.value == 1
+
+
+def test_on_fleet_view_edge_identity_dedupe(tmp_path):
+    cap = _capture(tmp_path, role="observer", cooldown_s=0.0)
+    view = {"alerts": [{"rule": "worker_stale", "severity": "critical",
+                        "worker": "w1", "since": 5.0}],
+            "slo": {"breaches": [
+                {"rule": "slo_burn_fast", "severity": "critical",
+                 "objective": "fetch_latency", "burn": 20.0},
+                {"rule": "slo_burn_slow", "severity": "warning",
+                 "objective": "fetch_latency", "burn": 7.0}]}}
+    cap.on_fleet_view(view)
+    cap.on_fleet_view(view)  # same edges again: identity-deduped
+    rows = list_incidents(str(tmp_path / "incidents"))
+    rules = sorted(r["trigger"]["rule"] for r in rows)
+    assert rules == ["slo_burn_fast", "worker_stale"]  # warning skipped
+    # a NEW edge identity (same rule, later fire) captures again
+    view["alerts"][0]["since"] = 9.0
+    cap.on_fleet_view(view)
+    assert len(list_incidents(str(tmp_path / "incidents"))) == 3
+
+
+# -- timeline builder --------------------------------------------------------
+
+def _ev(ts, type, **payload):
+    return {"v": 1, "type": type, "ts": ts, "role": "server", "pid": 1,
+            "seq": int(ts * 10), **payload}
+
+
+def test_classify_event_phases():
+    assert classify_event(_ev(1, "fault", spec="x")) == "fault"
+    assert classify_event(_ev(1, "alert", state="fired")) == "alert"
+    assert classify_event(_ev(1, "slo_burn")) == "alert"
+    assert classify_event(_ev(1, "respawn", action="respawn")) == \
+        "remediation"
+    assert classify_event(_ev(1, "alert", state="resolved")) == \
+        "resolution"
+    assert classify_event(_ev(1, "checkpoint", step=1)) == "context"
+    assert classify_event(_ev(1, "snapshot")) is None
+
+
+def test_build_timeline_ordered_arc():
+    recs = [
+        _ev(10.0, "snapshot", counters={}),  # series: excluded
+        _ev(11.0, "fault", spec="fetch.delay=0.1@p=1.0"),
+        _ev(13.0, "alert", state="fired", rule="slo_burn_fast",
+            severity="critical", worker="w0"),
+        _ev(14.0, "slo_burn", rule="slo_burn_fast",
+            objective="fetch_latency", burn=20.0, burn_threshold=14.4),
+        _ev(15.0, "remediation", action="quarantine", outcome="ok"),
+        _ev(16.0, "checkpoint", step=3, path="ckpt/3"),
+        _ev(20.0, "alert", state="resolved", rule="slo_burn_fast",
+            severity="critical"),
+    ]
+    tl = build_timeline(recs)
+    assert tl["ordered"] is True
+    assert [p for p in PHASE_ORDER if p in tl["phases"]] == \
+        list(PHASE_ORDER)
+    assert tl["phases"]["fault"]["first_ts"] == 11.0
+    assert tl["phases"]["resolution"]["first_ts"] == 20.0
+    assert len(tl["events"]) == 6  # snapshot excluded
+    assert tl["events"][0]["rel_s"] == 0.0
+    assert tl["counts"]["alert"] == 2
+    assert tl["workers"]["w0"] == [1]
+    text = render_timeline(tl)
+    assert "causal order OK" in text and "quarantine -> ok" in text
+
+
+def test_build_timeline_detects_violated_causality():
+    recs = [
+        _ev(10.0, "remediation", action="respawn", outcome="ok"),
+        _ev(12.0, "alert", state="fired", rule="r",
+            severity="critical"),
+        _ev(14.0, "fault", spec="x"),
+    ]
+    tl = build_timeline(recs)
+    assert tl["ordered"] is False
+    assert "VIOLATED" in render_timeline(tl)
+
+
+def test_build_timeline_merges_processes_deterministically():
+    a = _ev(10.0, "alert", state="fired", rule="r", severity="critical")
+    b = dict(a, pid=2, role="observer")
+    tl = build_timeline([b, a])
+    assert [(e["pid"]) for e in tl["events"]] == [1, 2]  # (ts, pid, seq)
+
+
+# -- bundle loading ----------------------------------------------------------
+
+def test_load_incident_merges_window_and_live_journal(tmp_path):
+    w = _journal(tmp_path)
+    w.append("fault", {"spec": "x", "ts": 100.0})
+    w.append("alert", dict(CRIT, ts=101.0))
+    cap = _capture(tmp_path, journal=w, clock=lambda: 102.0)
+    bundle = cap.maybe_capture(dict(CRIT))
+    # post-edge records live only in the journal, not the frozen window
+    w.append("remediation", {"action": "respawn", "outcome": "ok",
+                             "ts": 103.0})
+    w.append("alert", dict(CRIT, state="resolved", ts=105.0))
+    w.seal()
+    data = load_incident(bundle)
+    types = [r["type"] for r in data["records"]]
+    assert types == ["fault", "alert", "remediation", "alert"]
+    tl = build_timeline(data["records"])
+    assert tl["ordered"] is True and "resolution" in tl["phases"]
+    # the overlap (window ∩ journal) was deduped, not doubled
+    assert tl["counts"]["fault"] == 1
+
+
+def test_load_incident_journal_dir_override(tmp_path):
+    w = _journal(tmp_path)
+    w.append("alert", dict(CRIT))
+    cap = _capture(tmp_path, journal=w)
+    bundle = cap.maybe_capture(dict(CRIT))
+    w.seal()
+    data = load_incident(bundle, journal_dir=str(tmp_path / "nowhere"))
+    assert [r["type"] for r in data["records"]] == ["alert"]
+    assert "journal" not in data["stats"]  # override dir didn't exist
+
+
+def test_list_incidents_reports_unreadable(tmp_path):
+    inc = tmp_path / "incidents"
+    good = _capture(tmp_path)
+    good.maybe_capture(dict(CRIT))
+    bad = inc / "inc-broken"
+    bad.mkdir()
+    (bad / "manifest.json").write_text("{not json")
+    rows = list_incidents(str(inc))
+    assert len(rows) == 2
+    errors = [r for r in rows if "error" in r]
+    assert len(errors) == 1 and errors[0]["id"] == "inc-broken"
+    assert list_incidents(str(tmp_path / "missing")) == []

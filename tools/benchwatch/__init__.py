@@ -15,8 +15,10 @@ check:
 - **Regression** per metric: the median of the newest
   ``recent_window`` usable values vs the median of the
   ``baseline_window`` values before them; flagged when recent <
-  baseline x (1 - tolerance). Medians tolerate single-run noise;
-  the windows are configurable per invocation.
+  baseline x (1 - tolerance) for higher-is-better series, or recent >
+  baseline x (1 + tolerance) for lower-is-better overheads (see
+  ``EXTRA_METRIC_FIELDS``). Medians tolerate single-run noise; the
+  windows are configurable per invocation.
 
 Surfaces: ``python -m tools.benchwatch`` (scripts/lint.sh gate 4 runs
 ``--validate-only``; scripts/tier1.sh runs the full check) and
@@ -49,16 +51,34 @@ _PARSED_FIELDS = {"metric": str, "value": (int, float), "unit": str}
 _MULTICHIP_FIELDS = {"n_devices": int, "rc": int, "ok": bool,
                      "skipped": bool, "tail": str}
 
-#: Secondary higher-is-better series lifted out of ``parsed`` extras and
-#: watched alongside the headline metric: field name -> unit. Optional by
-#: design — records that predate a field (or record it null) simply don't
-#: contribute a point, so a new field starts at insufficient_history and
-#: only gates once enough rounds carry it. ``codec_mb_per_s`` (ISSUE 14)
-#: is the device-resident push codec's encode throughput;
-#: ``fanout_qps`` (ISSUE 17) is the edge-replica delta-serve rate of the
-#: two-tier fan-out probe.
+#: Secondary series lifted out of ``parsed`` extras and watched
+#: alongside the headline metric: field name -> unit string (plain
+#: higher-is-better series) or ``{"unit", "direction": "lower"}`` for
+#: overheads that regress UPWARD (recent > baseline x (1 + tolerance)).
+#: Optional by design — records that predate a field (or record it
+#: null) simply don't contribute a point, so a new field starts at
+#: insufficient_history and only gates once enough rounds carry it.
+#: ``codec_mb_per_s`` (ISSUE 14) is the device-resident push codec's
+#: encode throughput; ``fanout_qps`` (ISSUE 17) is the edge-replica
+#: delta-serve rate of the two-tier fan-out probe;
+#: ``journal_write_us``/``journal_bytes_per_tick`` (ISSUE 18) are the
+#: durable journal's per-record append latency and per-snapshot disk
+#: cost — both lower-is-better, gating the <2% overhead claim.
 EXTRA_METRIC_FIELDS = {"codec_mb_per_s": "MB/s",
-                       "fanout_qps": "fetch/s"}
+                       "fanout_qps": "fetch/s",
+                       "journal_write_us": {"unit": "us",
+                                            "direction": "lower"},
+                       "journal_bytes_per_tick": {"unit": "B",
+                                                  "direction": "lower"}}
+
+
+def _field_spec(spec) -> tuple[str, str]:
+    """(unit, direction) for one EXTRA_METRIC_FIELDS value — a bare
+    string means higher-is-better, the dict form names its direction."""
+    if isinstance(spec, dict):
+        return str(spec.get("unit", "")), str(spec.get("direction",
+                                                       "higher"))
+    return str(spec), "higher"
 
 
 def _type_errors(obj: dict, fields: dict, ctx: str) -> list:
@@ -152,19 +172,21 @@ def check_regressions(ledger: dict, tolerance: float = 0.05,
         parsed = entry["record"]["parsed"]
         by_metric.setdefault(parsed["metric"], []).append(
             {"file": entry["file"], "value": float(parsed["value"]),
-             "unit": parsed.get("unit", "")})
-        for field, unit in EXTRA_METRIC_FIELDS.items():
+             "unit": parsed.get("unit", ""), "direction": "higher"})
+        for field, spec in EXTRA_METRIC_FIELDS.items():
+            unit, direction = _field_spec(spec)
             v = parsed.get(field)
             if isinstance(v, (int, float)) and not isinstance(v, bool):
                 by_metric.setdefault(field, []).append(
                     {"file": entry["file"], "value": float(v),
-                     "unit": unit})
+                     "unit": unit, "direction": direction})
     metrics = {}
     regressions = []
     for metric, points in by_metric.items():
         values = [p["value"] for p in points]
+        direction = points[0].get("direction", "higher")
         row: dict = {"unit": points[0]["unit"], "runs": len(points),
-                     "values": values,
+                     "values": values, "direction": direction,
                      "files": [p["file"] for p in points]}
         if len(values) < baseline_window + recent_window:
             row["status"] = "insufficient_history"
@@ -173,14 +195,21 @@ def check_regressions(ledger: dict, tolerance: float = 0.05,
             recent = statistics.median(values[-recent_window:])
             base = statistics.median(
                 values[-(recent_window + baseline_window):-recent_window])
-            floor = base * (1.0 - tolerance)
+            if direction == "lower":
+                ceiling = base * (1.0 + tolerance)
+                regressed = recent > ceiling
+                bound = {"ceiling": round(ceiling, 3)}
+            else:
+                floor = base * (1.0 - tolerance)
+                regressed = recent < floor
+                bound = {"floor": round(floor, 3)}
             row.update({
                 "recent_median": round(recent, 3),
                 "baseline_median": round(base, 3),
-                "floor": round(floor, 3),
                 "change_fraction": round((recent - base) / base, 4)
                 if base else None,
-                "status": "regression" if recent < floor else "ok",
+                "status": "regression" if regressed else "ok",
+                **bound,
             })
             if row["status"] == "regression":
                 regressions.append(metric)

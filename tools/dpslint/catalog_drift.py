@@ -327,6 +327,26 @@ def check_fleet_rollup_fields(ctx: DriftContext) -> list[Finding]:
                         "### Rollup semantics", "fleet rollup field")
 
 
+def check_event_catalog(ctx: DriftContext) -> list[Finding]:
+    """EVENT_CATALOG (telemetry/journal.py) pinned to the
+    docs/OBSERVABILITY.md event-catalog table — a journal record type
+    cannot exist without documented semantics (postmortems are read by
+    humans who were not there), or stay documented after removal."""
+    return _table_check(ctx, "journal-event",
+                        f"{_PKG}/telemetry/journal.py",
+                        "EVENT_CATALOG", "docs/OBSERVABILITY.md",
+                        "### Event catalog", "journal event type")
+
+
+def check_incident_manifest(ctx: DriftContext) -> list[Finding]:
+    """MANIFEST_FIELDS (telemetry/incidents.py) pinned to the
+    docs/OBSERVABILITY.md incident-manifest table."""
+    return _table_check(ctx, "incident-manifest",
+                        f"{_PKG}/telemetry/incidents.py",
+                        "MANIFEST_FIELDS", "docs/OBSERVABILITY.md",
+                        "### Incident manifest", "incident manifest field")
+
+
 def check_meta_keys(ctx: DriftContext) -> list[Finding]:
     """META_KEY_CATALOG pinned to docs/WIRE_PROTOCOL.md's envelope-meta
     table — a wire field cannot be cataloged without being documented,
@@ -359,6 +379,8 @@ CHECKS = {
     "job-spec-fields": check_job_spec_fields,
     "meta-keys": check_meta_keys,
     "fleet-rollup-fields": check_fleet_rollup_fields,
+    "event-catalog": check_event_catalog,
+    "incident-manifest": check_incident_manifest,
 }
 
 
