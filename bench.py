@@ -539,6 +539,16 @@ def run_bench(args) -> dict:
         stage = "timed_trials"
         best_dt = float("inf")
         timed_wall = 0.0
+        # Goodput ledger over the timed trials (ISSUE 20 satellite b):
+        # a private registry so the bench never pollutes the process
+        # default; trial compute is spanned, everything else the loop
+        # does (prints, min/max bookkeeping) lands in the residual —
+        # goodput_fraction below 1.0 IS the harness overhead.
+        from distributed_parameter_server_for_ml_training_tpu \
+            .telemetry.goodput import GoodputAccount
+        from distributed_parameter_server_for_ml_training_tpu \
+            .telemetry.registry import MetricsRegistry as _GpRegistry
+        gp = GoodputAccount(_GpRegistry())
         profile_ctx = contextlib.nullcontext()
         if getattr(args, "profile_dir", None):
             # Perf observatory (docs/OBSERVABILITY.md): bracket ONLY the
@@ -550,15 +560,21 @@ def run_bench(args) -> dict:
             print(f"profiler: tracing timed trials into "
                   f"{args.profile_dir}", file=sys.stderr)
         with profile_ctx:
+            gp.start_wall()
             for trial in range(args.trials):
                 t0 = time.perf_counter()
-                state, loss = window(state, images, labels, key)
-                final_loss = float(loss)  # forces the whole chain
+                with gp.span("compute"):
+                    state, loss = window(state, images, labels, key)
+                    final_loss = float(loss)  # forces the whole chain
                 dt = time.perf_counter() - t0
                 print(f"trial {trial}: {dt*1e3:.1f} ms, "
                       f"loss {final_loss:.4f}", file=sys.stderr)
                 best_dt = min(best_dt, dt)
                 timed_wall += dt
+                gp.tick_wall()
+        goodput_fraction = gp.fraction()
+        if goodput_fraction is not None:
+            goodput_fraction = round(goodput_fraction, 4)
 
         images_per_sec = args.scan_steps * args.batch_size / best_dt
         per_chip = images_per_sec / n_chips
@@ -606,12 +622,25 @@ def run_bench(args) -> dict:
                 print(f"cost analysis failed (mfu recorded null): {e}",
                       file=sys.stderr)
             try:
-                prof = attribute_profile(args.profile_dir)["profile"]
+                attributed = attribute_profile(args.profile_dir)
+                prof = attributed["profile"]
                 if timed_wall > 0 and prof["total_attributed_s"] > 0:
                     device_time_fraction = round(
                         prof["total_attributed_s"]
                         / (timed_wall * n_chips), 4)
                     attribution_basis = prof.get("basis")
+                # Raw Chrome traces are scratch once attribution
+                # succeeded (ISSUE 20 satellite f) — same prune policy
+                # as `cli perf profile`: keep on failure for debugging.
+                if prof.get("basis") not in (None, "none") \
+                        and not attributed.get("parse_errors"):
+                    from distributed_parameter_server_for_ml_training_tpu \
+                        .telemetry.profiler import prune_capture
+                    pruned = prune_capture(args.profile_dir)
+                    if pruned:
+                        print(f"profiler: pruned {len(pruned)} raw "
+                              f"trace file(s) from {args.profile_dir}",
+                              file=sys.stderr)
             except Exception as e:  # noqa: BLE001 — null, never a crash
                 print(f"profile attribution failed (recording null): "
                       f"{e}", file=sys.stderr)
@@ -659,6 +688,16 @@ def run_bench(args) -> dict:
                           "journal_bytes_per_tick": None}
         if not getattr(args, "no_journal_probe", False):
             journal_fields = journal_probe()
+
+        # Memory companion fields (ISSUE 20): peak device HBM from the
+        # allocator stats (null on CPU — no memory_stats()) and peak
+        # host RSS from /proc/self/status, the same samplers the
+        # memory_growth health rule reads. Failure-hardened nulls.
+        stage = "memory_probe"
+        from distributed_parameter_server_for_ml_training_tpu \
+            .telemetry.memory import read_device_memory, read_host_rss
+        dev_mem = read_device_memory(devices[0]) or {}
+        host_mem = read_host_rss() or {}
 
         result = {
             "metric": "cifar100_resnet18_train_images_per_sec_per_chip",
@@ -714,6 +753,13 @@ def run_bench(args) -> dict:
             **fanout_fields,
             # Durable-journal attribution (ISSUE 18): see journal_probe.
             **journal_fields,
+            # Goodput observatory (ISSUE 20): productive fraction of the
+            # timed-trial wall (compute spans / wall ticks — below 1.0
+            # is harness overhead, tracked higher-is-better by
+            # benchwatch) and the memory peaks at measurement end.
+            "goodput_fraction": goodput_fraction,
+            "peak_hbm_bytes": dev_mem.get("peak_bytes_in_use"),
+            "host_rss_peak_bytes": host_mem.get("peak_rss_bytes"),
         }
         # Static-analysis attribution (ISSUE 10 satellite): whether the
         # tree this number was measured from passed dpslint, and what the

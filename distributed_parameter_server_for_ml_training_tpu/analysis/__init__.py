@@ -16,7 +16,9 @@ from .device_profile import (
     attribute_profile,
     classify_op,
     device_time_tables,
+    diff_profiles,
     load_chrome_trace,
+    render_profile_diff,
     render_profile_table,
 )
 from .fleet_series import extract_exemplars, resolve_exemplars
@@ -48,14 +50,14 @@ __all__ = ["OP_CLASSES", "PHASES", "PHASE_ORDER",
            "classify_event", "classify_op",
            "cluster_worker_series",
            "critical_path_report", "describe_event",
-           "device_time_tables",
+           "device_time_tables", "diff_profiles",
            "extract_exemplars",
            "list_incidents", "load_incident", "render_timeline",
            "find_trace_dumps", "load_chrome_trace", "load_trace_dumps",
            "resolve_exemplars",
            "parse_cluster_series",
            "parse_experiment", "parse_snapshot_series",
-           "render_profile_table",
+           "render_profile_diff", "render_profile_table",
            "save_chrome_trace", "staleness_series", "to_chrome_trace",
            "worker_throughput_series",
            "ExperimentVisualizer", "run_cell", "run_matrix"]

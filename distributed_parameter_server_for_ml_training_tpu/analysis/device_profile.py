@@ -38,7 +38,9 @@ __all__ = [
     "attribute_profile",
     "classify_op",
     "device_time_tables",
+    "diff_profiles",
     "load_chrome_trace",
+    "render_profile_diff",
     "render_profile_table",
 ]
 
@@ -266,6 +268,124 @@ def attribute_profile(logdir: str, critical: dict | None = None,
             if step_wall > 0 else None,
         }
     return out
+
+
+def _inner_profile(artifact: dict) -> dict:
+    """The per-op-class table inside a recorded artifact — accepts a
+    full ``attribute_profile`` result, a ``PROFILE_*.json`` ledger
+    record (same nesting), or the bare inner profile dict."""
+    if not isinstance(artifact, dict):
+        return {}
+    prof = artifact.get("profile")
+    return prof if isinstance(prof, dict) else artifact
+
+
+def diff_profiles(a: dict, b: dict,
+                  unchanged_tolerance: float = 0.01) -> dict:
+    """Per-op-class delta between two attribution artifacts (``a`` the
+    baseline, ``b`` the candidate) — the before/after table every
+    kernel PR cites.
+
+    HONEST-BASIS RULE: artifacts attributed on different bases
+    (``device_lanes`` vs ``host_ops`` vs ``host_execute_proxy``) are
+    not comparable — a host-proxy number against real device lanes
+    would manufacture a regression out of methodology — so a basis
+    mismatch raises ``ValueError`` instead of producing a table.
+    Classes present on one side only are reported as ``new`` /
+    ``vanished``; a class whose time moved less than
+    ``unchanged_tolerance`` (relative) is ``unchanged``. Reconciliation
+    residuals diff too, when both sides carry them.
+    """
+    pa, pb = _inner_profile(a), _inner_profile(b)
+    basis_a = pa.get("basis", "none")
+    basis_b = pb.get("basis", "none")
+    if basis_a != basis_b:
+        raise ValueError(
+            f"attribution basis mismatch: baseline={basis_a!r} vs "
+            f"candidate={basis_b!r} — these artifacts measure different "
+            f"things and cannot be diffed honestly")
+    ops_a = pa.get("op_classes") or {}
+    ops_b = pb.get("op_classes") or {}
+    rows = {}
+    for cls in sorted(set(ops_a) | set(ops_b)):
+        ta = float((ops_a.get(cls) or {}).get("time_s") or 0.0)
+        tb = float((ops_b.get(cls) or {}).get("time_s") or 0.0)
+        if cls not in ops_a:
+            status = "new"
+        elif cls not in ops_b:
+            status = "vanished"
+        elif ta > 0 and abs(tb - ta) / ta <= unchanged_tolerance:
+            status = "unchanged"
+        else:
+            status = "changed"
+        rows[cls] = {
+            "baseline_s": round(ta, 6),
+            "candidate_s": round(tb, 6),
+            "delta_s": round(tb - ta, 6),
+            "ratio": round(tb / ta, 4) if ta > 0 else None,
+            "baseline_fraction": (ops_a.get(cls) or {}).get("fraction"),
+            "candidate_fraction": (ops_b.get(cls) or {}).get("fraction"),
+            "status": status,
+        }
+    total_a = float(pa.get("total_attributed_s") or 0.0)
+    total_b = float(pb.get("total_attributed_s") or 0.0)
+    out = {
+        "basis": basis_a,
+        "op_classes": rows,
+        "total_baseline_s": round(total_a, 6),
+        "total_candidate_s": round(total_b, 6),
+        "total_delta_s": round(total_b - total_a, 6),
+        "new_classes": sorted(c for c, r in rows.items()
+                              if r["status"] == "new"),
+        "vanished_classes": sorted(c for c, r in rows.items()
+                                   if r["status"] == "vanished"),
+    }
+    rec_a = (a or {}).get("reconciliation") if isinstance(a, dict) else None
+    rec_b = (b or {}).get("reconciliation") if isinstance(b, dict) else None
+    if isinstance(rec_a, dict) and isinstance(rec_b, dict):
+        ra = float(rec_a.get("residual_s") or 0.0)
+        rb = float(rec_b.get("residual_s") or 0.0)
+        out["residual"] = {
+            "baseline_s": round(ra, 6),
+            "candidate_s": round(rb, 6),
+            "delta_s": round(rb - ra, 6),
+        }
+    return out
+
+
+def render_profile_diff(diff: dict) -> str:
+    """Human-readable delta table for ``cli perf diff`` — slowest-moving
+    class first, so the regression's culprit is the top row."""
+    lines = [f"attribution basis: {diff.get('basis', 'none')} "
+             f"(both artifacts)"]
+    rows = sorted((diff.get("op_classes") or {}).items(),
+                  key=lambda kv: -abs(kv[1]["delta_s"]))
+    if rows:
+        lines.append(f"{'op class':<15} {'baseline_s':>12} "
+                     f"{'candidate_s':>12} {'delta_s':>11} {'ratio':>7} "
+                     f"{'status':>10}")
+        for cls, r in rows:
+            ratio = "-" if r["ratio"] is None else f"{r['ratio']:.2f}x"
+            lines.append(f"{cls:<15} {r['baseline_s']:>12.6f} "
+                         f"{r['candidate_s']:>12.6f} "
+                         f"{r['delta_s']:>+11.6f} {ratio:>7} "
+                         f"{r['status']:>10}")
+    else:
+        lines.append("(no attributed op classes on either side)")
+    lines.append(f"total attributed: {diff.get('total_baseline_s', 0):.6f}s "
+                 f"-> {diff.get('total_candidate_s', 0):.6f}s "
+                 f"({diff.get('total_delta_s', 0):+.6f}s)")
+    if diff.get("new_classes"):
+        lines.append("new classes: " + ", ".join(diff["new_classes"]))
+    if diff.get("vanished_classes"):
+        lines.append("vanished classes: "
+                     + ", ".join(diff["vanished_classes"]))
+    res = diff.get("residual")
+    if res:
+        lines.append(f"reconciliation residual: {res['baseline_s']:.6f}s "
+                     f"-> {res['candidate_s']:.6f}s "
+                     f"({res['delta_s']:+.6f}s)")
+    return "\n".join(lines)
 
 
 def render_profile_table(report: dict) -> str:

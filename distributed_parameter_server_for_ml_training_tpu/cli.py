@@ -447,6 +447,37 @@ def build_parser() -> argparse.ArgumentParser:
     s.add_argument("--slo-slow-burn", type=float,
                    default=_env("DPS_SLO_SLOW_BURN", 6.0, float),
                    help="burn-rate threshold over the slow window")
+    s.add_argument("--no-memory-telemetry", action="store_true",
+                   help="disable the periodic memory sampler (on by "
+                        "default with the health monitor): host RSS + "
+                        "device HBM gauges, the windowed leak-slope "
+                        "verdict in GET /cluster 'memory', and the "
+                        "memory_growth health rule "
+                        "(docs/OBSERVABILITY.md 'Goodput observatory')")
+    s.add_argument("--profile-triggers", action="store_true",
+                   help="trigger-driven continuous profiling "
+                        "(docs/OBSERVABILITY.md): an slo_burn edge or a "
+                        "fleet goodput-fraction drop captures a bounded "
+                        "jax.profiler window, attributes it per op "
+                        "class, and appends a PROFILE_*.json record to "
+                        "--profiles-dir (per-rule cooldown dedupe; "
+                        "needs the health monitor)")
+    s.add_argument("--profiles-dir",
+                   default=_env("DPS_PROFILES_DIR", "profiles"),
+                   help="profile ledger directory for --profile-triggers")
+    s.add_argument("--profile-window", type=float,
+                   default=_env("DPS_PROFILE_WINDOW", 1.5, float),
+                   help="seconds of device activity each triggered "
+                        "capture brackets")
+    s.add_argument("--profile-cooldown", type=float,
+                   default=_env("DPS_PROFILE_COOLDOWN", 600.0, float),
+                   help="per-rule dedupe window: a degradation storm "
+                        "yields one capture per rule per cooldown")
+    s.add_argument("--goodput-drop-threshold", type=float,
+                   default=_env("DPS_GOODPUT_DROP", 0.5, float),
+                   help="fleet goodput fraction whose falling edge "
+                        "triggers a capture (previous tick at or above, "
+                        "this tick below)")
     add_platform(s)
     add_telemetry(s)
 
@@ -942,8 +973,43 @@ def build_parser() -> argparse.ArgumentParser:
                     help="fast burn window (s)")
     qy.add_argument("--slo-slow-window", type=float, default=300.0,
                     help="slow burn window (s)")
+    qy.add_argument("--goodput", action="store_true",
+                    help="retroactive goodput ledger over the window: "
+                         "per-category wall seconds (counter deltas "
+                         "merged across processes), goodput fraction, "
+                         "residual — answers 'what fraction of the "
+                         "window was productive' from the journal alone")
+    qy.add_argument("--incidents", default=None, metavar="DIR",
+                    help="with --goodput: join incident bundles from DIR "
+                         "and attribute badput seconds to each bundle's "
+                         "capture window (per-incident cost accounting)")
+    qy.add_argument("--goodput-tolerance", type=float, default=0.02,
+                    help="residual fraction above which the goodput "
+                         "report flags the ledger unreconciled "
+                         "(default: 0.02)")
     qy.add_argument("--json", action="store_true",
                     help="machine-readable output (QUERY_JSON line)")
+
+    gp = sub.add_parser(
+        "goodput",
+        help="live goodput ledger from a running process's /metrics.json: "
+             "per-category wall-clock accounting "
+             "(docs/OBSERVABILITY.md 'Goodput observatory'), goodput "
+             "fraction, residual; exit 1 when the endpoint is "
+             "unreachable")
+    gp.add_argument("--url", default=_env("DPS_METRICS_URL", None),
+                    help="base URL of the metrics endpoint, e.g. "
+                         "http://host:9100 (env DPS_METRICS_URL; "
+                         "or use --host/--metrics-port)")
+    gp.add_argument("--host", default="127.0.0.1",
+                    help="metrics host when --url is not given")
+    gp.add_argument("--metrics-port", type=int, default=9100,
+                    help="metrics port when --url is not given")
+    gp.add_argument("--tolerance", type=float, default=0.02,
+                    help="residual fraction above which the ledger is "
+                         "flagged unreconciled (default: 0.02)")
+    gp.add_argument("--json", action="store_true",
+                    help="machine-readable output (GOODPUT_JSON line)")
 
     pf = sub.add_parser(
         "perf",
@@ -973,6 +1039,26 @@ def build_parser() -> argparse.ArgumentParser:
                      help="write the merged JSON artifact here")
     pfp.add_argument("--json", action="store_true",
                      help="print the JSON artifact instead of the table")
+    pfp.add_argument("--keep-traces", action="store_true",
+                     help="keep the raw Chrome traces in --profile-dir "
+                          "after a successful attribution (default: "
+                          "prune them — the artifact is the durable "
+                          "record; traces are kept automatically when "
+                          "attribution fails so they stay debuggable)")
+    pfd = pfsub.add_parser(
+        "diff",
+        help="regression attribution: diff two attribution artifacts "
+             "(cli perf profile --out, or profile-ledger records) into "
+             "a per-op-class delta table — which op class got slower, "
+             "what appeared/vanished, how the residual moved; refuses "
+             "artifacts with mismatched attribution bases")
+    pfd.add_argument("baseline", help="baseline artifact JSON path")
+    pfd.add_argument("candidate", help="candidate artifact JSON path")
+    pfd.add_argument("--tolerance", type=float, default=0.01,
+                     help="fractional |delta|/baseline below which a "
+                          "class is reported unchanged (default: 0.01)")
+    pfd.add_argument("--json", action="store_true",
+                     help="machine-readable diff instead of the table")
     pfc = pfsub.add_parser(
         "check",
         help="bench regression watch over the committed BENCH_*/"
@@ -991,6 +1077,9 @@ def build_parser() -> argparse.ArgumentParser:
                      help="verdict format (default: md)")
     pfc.add_argument("--validate-only", action="store_true",
                      help="schema-validate the ledger and stop")
+    pfc.add_argument("--profiles-root", default=None,
+                     help="committed profile ledger directory (default: "
+                          "<root>/profiles when it exists)")
 
     ln = sub.add_parser(
         "lint",
@@ -1428,6 +1517,35 @@ def _cmd_serve(args) -> int:
         monitor.add_listener(capture.on_alert_events)
         print(f"incidents: capture armed -> {incidents_dir}",
               file=sys.stderr, flush=True)
+    if monitor is not None \
+            and not getattr(args, "no_memory_telemetry", False):
+        # Memory telemetry (docs/OBSERVABILITY.md "Goodput observatory"):
+        # periodic host-RSS + device-HBM sampling on the monitor's tick,
+        # a windowed leak-slope verdict in /cluster "memory", and the
+        # memory_growth rule fed through the same alert pipeline.
+        from .telemetry import MemoryMonitor
+        monitor.memory = MemoryMonitor()
+    if getattr(args, "profile_triggers", False):
+        # Trigger-driven continuous profiling: slo_burn edges (listener)
+        # and fleet goodput-fraction drops (fed each evaluation pass)
+        # freeze a bounded jax.profiler window into the PROFILE ledger,
+        # deduped per rule like incident capture.
+        if monitor is None:
+            raise SystemExit("--profile-triggers needs the health "
+                             "monitor (drop --no-health-monitor)")
+        from .telemetry import ProfileTrigger
+        ptrig = ProfileTrigger(
+            getattr(args, "profiles_dir", "profiles"),
+            window_s=getattr(args, "profile_window", 1.5),
+            cooldown_s=getattr(args, "profile_cooldown", 600.0),
+            goodput_drop_threshold=getattr(args, "goodput_drop_threshold",
+                                           0.5),
+            role="server")
+        monitor.add_listener(ptrig.on_alert_events)
+        monitor.profile_trigger = ptrig
+        print(f"profiles: trigger engine armed -> {ptrig.profiles_dir} "
+              f"(window {ptrig.window_s:.1f}s, cooldown "
+              f"{ptrig.cooldown_s:.0f}s)", file=sys.stderr, flush=True)
     if getattr(args, "autoscale", False) and monitor is None:
         raise SystemExit("--autoscale needs the health monitor "
                          "(drop --no-health-monitor)")
@@ -1865,20 +1983,30 @@ def _render_status(view: dict) -> str:
     table, active alerts. Pure text in, text out (tested directly)."""
     sev_mark = {"critical": "CRIT", "warning": "WARN", "info": "INFO"}
     totals = view.get("alerts_total", {})
+    gpf = view.get("goodput_fraction")
     header = (f"cluster: mode={view.get('mode', '?')} "
               f"global_step={view.get('global_step', 0)} "
               f"workers={len(view.get('workers', []))} "
-              f"alerts: critical={totals.get('critical', 0)} "
+              + (f"goodput={gpf * 100:.1f}% "
+                 if isinstance(gpf, (int, float))
+                 and not isinstance(gpf, bool) else "")
+              + f"alerts: critical={totals.get('critical', 0)} "
               f"warning={totals.get('warning', 0)} "
               f"info={totals.get('info', 0)}")
     # The job column renders only when the server is tenancy-enabled
     # (worker rows carry "job") — a pre-tenancy /cluster payload draws
-    # the exact pre-tenancy table.
+    # the exact pre-tenancy table. The goodput column follows the same
+    # degradation discipline: absent from pre-goodput workers' reports,
+    # absent from the table.
     has_jobs = any("job" in r for r in view.get("workers", []))
+    has_goodput = any("goodput_fraction" in r
+                      for r in view.get("workers", []))
     cols = [("worker", 7)] \
         + ([("job", 10)] if has_jobs else []) \
         + [("alive", 6), ("step", 8), ("epoch", 6),
-           ("loss", 10), ("grad_norm", 11), ("ex/s", 9), ("pipe", 5),
+           ("loss", 10), ("grad_norm", 11), ("ex/s", 9)] \
+        + ([("goodput", 8)] if has_goodput else []) \
+        + [("pipe", 5),
            ("codec", 19), ("reconn", 7), ("hb_err", 7), ("age_s", 7)]
     lines = [header, "-" * len(header)]
     rnd = view.get("round")
@@ -1927,6 +2055,8 @@ def _render_status(view: dict) -> str:
                  else f"{v:.4g}"),
             cell(row.get("examples_per_s"), 9,
                  lambda v: f"{v:.1f}"),
+            *([cell(row.get("goodput_fraction"), 8,
+                    lambda v: f"{v * 100:.1f}%")] if has_goodput else []),
             cell(row.get("pipeline_depth"), 5),
             cell(row.get("push_codec"), 19),
             cell(row.get("reconnects"), 7),
@@ -2368,10 +2498,16 @@ def _render_top(view: dict) -> str:
             job = f" job={w['job']}" if w.get("job") else ""
             rep = w.get("report") or {}
             step = rep.get("step", w.get("step"))
+            # Goodput column (degradation-pinned: absent from a
+            # pre-goodput worker's report, absent from the row).
+            gpf = rep.get("goodput_fraction", w.get("goodput_fraction"))
+            gp = (f" goodput={gpf * 100:.1f}%"
+                  if isinstance(gpf, (int, float))
+                  and not isinstance(gpf, bool) else "")
             lines.append(
                 f"  worker {w.get('worker')}: "
                 f"{'alive' if w.get('alive') else 'DOWN'}"
-                f"{job} step={step} (via {w.get('via')})")
+                f"{job} step={step}{gp} (via {w.get('via')})")
     jobs = (view.get("tiers") or {}).get("jobs") or {}
     if jobs:
         lines.append("")
@@ -3019,6 +3155,8 @@ def cmd_infer(args) -> int:
 def cmd_perf(args) -> int:
     if args.perf_command == "check":
         return _cmd_perf_check(args)
+    if args.perf_command == "diff":
+        return _cmd_perf_diff(args)
     return _cmd_perf_profile(args)
 
 
@@ -3043,6 +3181,8 @@ def _cmd_perf_check(args) -> int:
             "--format", args.format]
     if args.validate_only:
         argv.append("--validate-only")
+    if getattr(args, "profiles_root", None):
+        argv += ["--profiles-root", args.profiles_root]
     return benchwatch_main(argv)
 
 
@@ -3089,6 +3229,49 @@ def _cmd_perf_profile(args) -> int:
         print(_json.dumps(report, indent=2))
     else:
         print(render_profile_table(report))
+    # Raw Chrome traces are scratch once the attribution artifact exists
+    # (ISSUE 20 satellite f): prune on success, keep on failure so a
+    # basis=none / parse-error capture stays debuggable.
+    if (not getattr(args, "keep_traces", False)
+            and report["profile"].get("basis") not in (None, "none")
+            and not report.get("parse_errors")):
+        from .telemetry.profiler import prune_capture
+        pruned = prune_capture(args.profile_dir)
+        if pruned:
+            print(f"perf profile: pruned {len(pruned)} raw trace "
+                  f"file(s) from {args.profile_dir} (--keep-traces to "
+                  f"keep)", file=sys.stderr)
+    return 0
+
+
+def _cmd_perf_diff(args) -> int:
+    """``cli perf diff BASELINE CANDIDATE`` — per-op-class regression
+    attribution between two recorded artifacts. Refuses to compare
+    artifacts whose attribution bases differ (they measure different
+    things; a refusal is more honest than a misleading table)."""
+    import json as _json
+
+    from .analysis.device_profile import diff_profiles, render_profile_diff
+
+    arts = []
+    for path in (args.baseline, args.candidate):
+        try:
+            with open(path) as f:
+                arts.append(_json.load(f))
+        except (OSError, ValueError) as e:
+            print(f"perf diff: cannot read artifact {path}: {e}",
+                  file=sys.stderr)
+            return 1
+    try:
+        diff = diff_profiles(arts[0], arts[1],
+                             unchanged_tolerance=args.tolerance)
+    except ValueError as e:
+        print(f"perf diff: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(_json.dumps(diff, indent=2))
+    else:
+        print(render_profile_diff(diff))
     return 0
 
 
@@ -3317,6 +3500,136 @@ def _retro_slo(records: list, args) -> dict:
     return out
 
 
+def _goodput_counters_at(stream: list, ts: float | None) -> dict:
+    """Per-process goodput counter prefix totals at-or-before ``ts``:
+    the newest value of every ``dps_goodput_*`` counter key (cumulative,
+    so the latest observation IS the prefix total — same property
+    ``_hist_at`` leans on)."""
+    from .telemetry.goodput import GOODPUT_METRIC, GOODPUT_WALL_METRIC
+
+    out: dict = {}
+    for rec in stream:
+        if ts is not None and rec.get("ts", 0.0) > ts:
+            break
+        for key, val in (rec.get("counters") or {}).items():
+            if key.startswith((GOODPUT_METRIC, GOODPUT_WALL_METRIC)):
+                out[key] = val
+    return out
+
+
+def _retro_goodput(records: list, since: float | None,
+                   until: float | None, tolerance: float = 0.02) -> dict:
+    """Retroactive goodput ledger over a journal window: per-process
+    counter deltas (newest-at-``until`` minus baseline-at-``since``,
+    clamped like every other window-exact query) summed across
+    processes, then folded through the same ``goodput_report`` math the
+    live ``cli goodput`` uses — one code path, two time machines."""
+    from .telemetry.goodput import delta_counters, report_from_counters
+
+    merged: dict = {}
+    processes = 0
+    for stream in _query_streams(records).values():
+        newest = _goodput_counters_at(stream, until)
+        if not newest:
+            continue
+        base = _goodput_counters_at(stream, since) if since is not None \
+            else {}
+        delta = delta_counters(newest, base)
+        if not any(v > 0 for v in delta.values()):
+            continue
+        processes += 1
+        for key, val in delta.items():
+            merged[key] = merged.get(key, 0.0) + val
+    report = report_from_counters(merged, tolerance=tolerance)
+    report["processes"] = processes
+    return report
+
+
+def _incident_badput(records: list, incidents_dir: str,
+                     tolerance: float = 0.02) -> list:
+    """Join incident bundles against the goodput ledger: for each
+    bundle, the badput seconds inside its frozen capture window
+    ``[created_ts - window_s, created_ts]`` — what the incident *cost*
+    in non-productive wall, per category."""
+    from .analysis.incidents import list_incidents
+
+    rows = []
+    for m in list_incidents(incidents_dir):
+        created = m.get("created_ts")
+        window_s = m.get("window_s")
+        if not isinstance(created, (int, float)) \
+                or not isinstance(window_s, (int, float)):
+            continue
+        rep = _retro_goodput(records, created - window_s, created,
+                             tolerance=tolerance)
+        trig = m.get("trigger") or {}
+        rows.append({"id": m.get("id"),
+                     "rule": trig.get("rule"),
+                     "severity": trig.get("severity"),
+                     "window": {"since": created - window_s,
+                                "until": created},
+                     "wall_s": rep["wall_s"],
+                     "badput_s": rep["badput_s"],
+                     "goodput_fraction": rep["goodput_fraction"],
+                     "categories": rep["categories"]})
+    return rows
+
+
+def _render_goodput_report(report: dict, title: str = "goodput") -> str:
+    """Shared renderer for the live (``cli goodput``) and retro
+    (``cli query --goodput``) ledgers — same table, two time machines."""
+    gpf = report.get("goodput_fraction")
+    head = "-" if gpf is None else f"{gpf * 100:.1f}%"
+    lines = [f"{title}: wall={report['wall_s']:.1f}s "
+             f"goodput={head} badput={report['badput_s']:.1f}s"]
+    lines.append(f"  {'CATEGORY':<20} {'SECONDS':>10} {'FRACTION':>9}")
+    for cat, row in report.get("categories", {}).items():
+        if row["seconds"] <= 0:
+            continue
+        lines.append(f"  {cat:<20} {row['seconds']:>10.2f} "
+                     f"{row['fraction'] * 100:>8.1f}%")
+    lines.append(f"  residual={report['residual_s']:.2f}s "
+                 f"({report['residual_fraction'] * 100:.1f}% of wall, "
+                 f"folded into 'other') "
+                 f"overshoot={report['overshoot_s']:.2f}s "
+                 f"reconciled={report['reconciled']}")
+    return "\n".join(lines)
+
+
+def cmd_goodput(args) -> int:
+    """``cli goodput``: the live goodput ledger from one process's
+    ``/metrics.json`` — what fraction of wall since start was
+    productive, where the rest went (docs/OBSERVABILITY.md 'Goodput
+    observatory'). Exit 1 when the endpoint is unreachable."""
+    import json as _json
+    from urllib.error import HTTPError, URLError
+    from urllib.request import urlopen
+
+    from .telemetry.goodput import report_from_counters
+
+    base = args.url or f"http://{args.host}:{args.metrics_port}"
+    if not base.startswith(("http://", "https://")):
+        base = "http://" + base
+    url = base.rstrip("/") + "/metrics.json"
+    try:
+        snap = _json.loads(urlopen(url, timeout=5).read())
+    except (HTTPError, URLError, OSError, ValueError) as e:
+        print(f"goodput: cannot reach {url}: {e}", file=sys.stderr)
+        return 1
+    report = report_from_counters(snap.get("counters") or {},
+                                  tolerance=args.tolerance)
+    if args.json:
+        print("GOODPUT_JSON: " + _json.dumps(report))
+        return 0
+    if report["wall_s"] <= 0:
+        print(f"goodput: no goodput counters at {url} — the process "
+              f"has no GoodputAccount wall yet (worker/trainer roles "
+              f"publish one)", file=sys.stderr)
+        return 0
+    print(_render_goodput_report(report, title=f"goodput @ {base}"))
+    return 0
+
+
 def cmd_query(args) -> int:
     """``cli query``: retro-query a durable journal — series listing,
     union-exact windowed percentiles, retroactive SLO burn."""
@@ -3344,6 +3657,13 @@ def cmd_query(args) -> int:
                     "reader_stats": reader.stats}
     if args.slo:
         result["slo"] = _retro_slo(in_range, args)
+    if args.goodput:
+        result["goodput"] = _retro_goodput(
+            in_range, since, until, tolerance=args.goodput_tolerance)
+        if args.incidents:
+            result["incident_badput"] = _incident_badput(
+                in_range, args.incidents,
+                tolerance=args.goodput_tolerance)
     streams = _query_streams(in_range)
     selected: dict = {}
     for stream in streams.values():
@@ -3404,6 +3724,17 @@ def cmd_query(args) -> int:
                 return "-" if v is None else f"{v * 1e3:.2f}ms"
             print(f"{key:<64} {row['count']:>8} {_fmt(row['p50']):>10} "
                   f"{_fmt(row['p95']):>10} {_fmt(row['p99']):>10}")
+    if "goodput" in result:
+        print(_render_goodput_report(
+            result["goodput"],
+            title=f"retro goodput over "
+                  f"{result['goodput']['processes']} process(es)"))
+        for row in result.get("incident_badput", ()):
+            gpf = row["goodput_fraction"]
+            gpf = "-" if gpf is None else f"{gpf * 100:.1f}%"
+            print(f"  incident {row['id']}: rule={row['rule']} "
+                  f"badput={row['badput_s']:.1f}s of "
+                  f"{row['wall_s']:.1f}s wall (goodput {gpf})")
     if "slo" in result:
         slo = result["slo"]
         print(f"retro SLO over {slo['samples']} sample(s):")
@@ -3430,6 +3761,7 @@ def main(argv=None) -> int:
             "loadgen": cmd_loadgen, "reshard": cmd_reshard,
             "infer": cmd_infer, "lint": cmd_lint,
             "incident": cmd_incident, "query": cmd_query,
+            "goodput": cmd_goodput,
             "perf": cmd_perf}[args.command](args)
 
 

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import threading
 import time
+from contextlib import nullcontext
 from dataclasses import dataclass, field
 
 import jax
@@ -34,6 +35,7 @@ from ..ops.compression import (  # hot-path imports hoisted, like ps/store
 )
 from ..ops.device_codec import DeviceCodec, DevicePayload, is_device_tree
 from ..telemetry import (
+    GoodputAccount,
     current_wire_trace,
     now as _tnow,
     trace_span,
@@ -44,6 +46,10 @@ from ..train.steps import make_eval_step, make_fused_local_step, \
     make_grad_step
 from ..utils.pytree import flatten_params, unflatten_params
 from .store import ParameterStore
+
+# Shared no-op bracket for goodput spans before telemetry init (and on
+# the comms-pipeline thread, whose seconds overlap training compute).
+_NULL_GP = nullcontext()
 
 
 @dataclass
@@ -513,6 +519,10 @@ class PSWorker(threading.Thread):
         # Injected per-step compute slowdown (comms/faults.py COMPUTE_OP):
         # set in _run from the store's fault injector, if any.
         self._compute_faults = None
+        # Goodput ledger (telemetry/goodput.py): created at
+        # _init_telemetry; every second of the training thread's wall is
+        # classified into GOODPUT_CATEGORIES.
+        self._goodput: GoodputAccount | None = None
         ns = self.config.nan_inject_step
         if ns is None:
             import os as _os
@@ -685,6 +695,31 @@ class PSWorker(threading.Thread):
                            action=a)
             for a in DIRECTIVE_CATALOG
         }
+        # Wall-clock goodput ledger: the shared cumulative counters sum
+        # worker-seconds across every account in the process; the
+        # instance keeps its own totals so _note_health reports an
+        # honest per-worker goodput fraction.
+        self._goodput = GoodputAccount(reg)
+
+    def _gp(self, category: str):
+        """Goodput bracket for the TRAINING thread's wall. The
+        comms-pipeline thread's overlapped work is deliberately NOT
+        charged — those seconds run under the window's compute, and
+        charging them would make the categories sum past the wall."""
+        gp = self._goodput
+        if gp is None:
+            return _NULL_GP
+        pipe = self._pipe
+        if pipe is not None and threading.current_thread() is pipe._thread:
+            return _NULL_GP
+        return gp.span(category)
+
+    def _compute_category(self) -> str:
+        """Quarantined windows still burn device seconds, but their
+        pushes are dropped at the boundary — that wall is idle-by-
+        directive, not goodput."""
+        return "quarantine_idle" if self._quarantine_windows > 0 \
+            else "compute"
 
     # -- worker health report (docs/OBSERVABILITY.md) ------------------------
 
@@ -741,6 +776,8 @@ class PSWorker(threading.Thread):
         self._health_rate = (now, steps)
         pipe = self._pipe
         depth = 0 if pipe is None or pipe._done.is_set() else 1
+        gpf = self._goodput.fraction() if self._goodput is not None \
+            else None
         with self._health_lock:
             h = self._health
             h["step"] = steps
@@ -762,6 +799,10 @@ class PSWorker(threading.Thread):
                 else getattr(self.store, "push_codec", "none")
             h["push_codec"] = codec + ("+ef" if self._ef is not None
                                        else "")
+            if gpf is not None:
+                # Productive fraction of this worker's wall so far
+                # (telemetry/goodput.py) — the status/top goodput column.
+                h["goodput_fraction"] = round(gpf, 4)
             h.setdefault("heartbeat_errors", 0)
             self._health_rev += 1
 
@@ -819,6 +860,7 @@ class PSWorker(threading.Thread):
               f"seq={d.get('seq')}", flush=True)
 
     def _run(self) -> None:
+        t_run0 = _tnow()
         cfg = self.config
         worker_id, total_workers = self.store.register_worker(self.worker_name)
         self.result.worker_id = worker_id
@@ -905,6 +947,14 @@ class PSWorker(threading.Thread):
         # can drain and rebuild it (docs/ROBUSTNESS.md).
         self._pipe = _CommsPipeline(self, worker_id) if cfg.overlap else None
 
+        gp = self._goodput
+        if gp is not None:
+            # Everything from _run entry to here — registration, codec
+            # negotiation, model/template init, pipeline spin-up — is the
+            # startup bucket; backdating the wall anchor puts it INSIDE
+            # the wall so the ledger reconciles end to end.
+            gp.add("startup", _tnow() - t_run0)
+            gp.start_wall(t_run0)
         try:
             for epoch in range(cfg.num_epochs):
                 t_epoch = time.time()
@@ -982,7 +1032,8 @@ class PSWorker(threading.Thread):
                                 accum = jax.tree_util.tree_map(
                                     jnp.zeros_like, local_params)
                                 accum_n = 0
-                            with trace_span("worker.compute") as _csp:
+                            with trace_span("worker.compute") as _csp, \
+                                    self._gp(self._compute_category()):
                                 (local_params, accum, batch_stats, loss,
                                  acc) = self._fused_step(
                                     local_params, accum, batch_stats,
@@ -993,7 +1044,8 @@ class PSWorker(threading.Thread):
                                     jax.block_until_ready(accum)
                             grads = None
                         else:
-                            with trace_span("worker.compute") as _csp:
+                            with trace_span("worker.compute") as _csp, \
+                                    self._gp(self._compute_category()):
                                 grads, batch_stats, loss, acc = \
                                     self._grad_step(
                                         params, batch_stats, xb, yb, rng,
@@ -1077,6 +1129,10 @@ class PSWorker(threading.Thread):
                                 worker_id, grads, fetched_step, params)
                             worker_id = self.result.worker_id
 
+                    if gp is not None:
+                        # Wall accrues step by step whether or not a
+                        # category claimed it (residual -> 'other').
+                        gp.tick_wall()
                     if self._draining or self._epoch_break:
                         # Directive: stop this epoch's batch loop at the
                         # step boundary (rebalance_shard resumes at the
@@ -1112,7 +1168,8 @@ class PSWorker(threading.Thread):
                 self._tm_epochs.inc()
                 if cfg.eval_each_epoch:
                     with trace_span("worker.eval", root=True,
-                                    worker=worker_id, epoch=epoch):
+                                    worker=worker_id, epoch=epoch), \
+                            self._gp("compute"):
                         self.result.test_accuracies.append(
                             self.evaluate(params, batch_stats))
                     self._tm_acc.set(self.result.test_accuracies[-1])
@@ -1126,11 +1183,15 @@ class PSWorker(threading.Thread):
                       f"epoch={epoch + 1}/{cfg.num_epochs} "
                       f"time={self.result.epoch_times[-1]:.1f}s{acc}",
                       flush=True)
+                if gp is not None:
+                    gp.tick_wall()  # eval + epoch bookkeeping wall
                 if self._draining:
                     print(f"DRAINED worker={self.worker_name} "
                           f"id={worker_id} epoch={epoch + 1}", flush=True)
                     break
         finally:
+            if self._goodput is not None:
+                self._goodput.tick_wall()
             if self._pipe is not None:
                 self._pipe.close()
 
@@ -1220,7 +1281,8 @@ class PSWorker(threading.Thread):
         delay = cfg.reconnect_backoff
         attempts = 0
         with trace_span("worker.reconnect", root=True,
-                        worker=old_id) as sp:
+                        worker=old_id) as sp, \
+                self._gp("reconnect_recovery"):
             while True:
                 attempts += 1
                 try:
@@ -1283,28 +1345,30 @@ class PSWorker(threading.Thread):
         A pending ``refetch_params`` directive bypasses the delta basis
         (and any prefetched result) with a full fresh fetch."""
         try:
-            pipe = self._pipe
-            if pipe is not None and pipe.params_pending():
-                # The prefetch issued right after the window's push — its
-                # latency ran under the window's compute instead of on
-                # the critical path.
-                result = pipe.await_params()
-                if not self._force_full_fetch:
-                    self._poll_directives()
+            with self._gp("fetch_wait"):
+                pipe = self._pipe
+                if pipe is not None and pipe.params_pending():
+                    # The prefetch issued right after the window's push —
+                    # its latency ran under the window's compute instead
+                    # of on the critical path.
+                    result = pipe.await_params()
                     if not self._force_full_fetch:
-                        return result
-            elif pipe is not None:
-                pipe.flush()  # a fetch must never overtake a push
-            if self._force_full_fetch:
-                self._force_full_fetch = False
-                result = self._fetch_params(worker_id)
-            else:
-                result = self._fetch_params(
-                    worker_id,
-                    have_step=fetched_step if params is not None else None,
-                    current=params)
-            self._poll_directives()
-            return result
+                        self._poll_directives()
+                        if not self._force_full_fetch:
+                            return result
+                elif pipe is not None:
+                    pipe.flush()  # a fetch must never overtake a push
+                if self._force_full_fetch:
+                    self._force_full_fetch = False
+                    result = self._fetch_params(worker_id)
+                else:
+                    result = self._fetch_params(
+                        worker_id,
+                        have_step=fetched_step if params is not None
+                        else None,
+                        current=params)
+                self._poll_directives()
+                return result
         except Exception as e:  # noqa: BLE001 — session recovery
             return self._recover_session(e)
 
@@ -1322,7 +1386,7 @@ class PSWorker(threading.Thread):
         overlap win, visible per step in the trace)."""
         if self._skip_quarantined_push():
             return params, fetched_step
-        with trace_span("worker.push_wait"):
+        with trace_span("worker.push_wait"), self._gp("push_wait"):
             item = grads_tree
             try:
                 if self._pipe is None:
@@ -1347,7 +1411,7 @@ class PSWorker(threading.Thread):
                             fetched_step: int, params):
         if self._skip_quarantined_push():
             return params, fetched_step
-        with trace_span("worker.push_wait"):
+        with trace_span("worker.push_wait"), self._gp("push_wait"):
             item = None
             try:
                 if self._pipe is None:
@@ -1417,7 +1481,7 @@ class PSWorker(threading.Thread):
                 return current, fetched_step
         else:
             flat, fetched_step = self.store.fetch(worker_id)
-        with trace_span("worker.codec", stage="decode"):
+        with trace_span("worker.codec", stage="decode"), self._gp("codec"):
             if (getattr(self.store, "fetch_codec", "none")
                     in ("fp16", "bf16")
                     and not getattr(self.store, "decompresses_fetches",
@@ -1480,7 +1544,7 @@ class PSWorker(threading.Thread):
             flat, plan=plan, scales=self._gradient_scales())
 
     def _push(self, worker_id, grads_tree, fetched_step) -> None:
-        with trace_span("worker.codec", stage="encode"):
+        with trace_span("worker.codec", stage="encode"), self._gp("codec"):
             if getattr(self.store, "keeps_device_arrays", False):
                 # Device-resident store: hand over the device arrays
                 # untouched — no host round-trip, no wire, no codec.

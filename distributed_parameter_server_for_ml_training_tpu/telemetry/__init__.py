@@ -55,6 +55,15 @@ from .journal import (
     set_journal,
 )
 from .incidents import MANIFEST_FIELDS, IncidentCapture
+from .goodput import (
+    GOODPUT_CATEGORIES,
+    GoodputAccount,
+    goodput_report,
+    parse_goodput_counters,
+    report_from_counters,
+)
+from .memory import MemoryMonitor, read_device_memory, read_host_rss
+from .proftrigger import PROFILE_RECORD_FIELDS, ProfileTrigger
 from .cluster import (
     ClusterMonitor,
     get_cluster_monitor,
@@ -108,7 +117,9 @@ __all__ = [
     "FLEET_ROLLUP_FIELDS",
     "FleetCollector",
     "FlightRecorder",
+    "GOODPUT_CATEGORIES",
     "Gauge",
+    "GoodputAccount",
     "HealthRuleEngine",
     "HealthThresholds",
     "Histogram",
@@ -118,7 +129,10 @@ __all__ = [
     "LATENCY_BUCKETS",
     "LATENCY_BUCKETS_S",
     "MANIFEST_FIELDS",
+    "MemoryMonitor",
     "MetricsRegistry",
+    "PROFILE_RECORD_FIELDS",
+    "ProfileTrigger",
     "RULE_CATALOG",
     "RemediationEngine",
     "RemediationPolicy",
@@ -141,15 +155,20 @@ __all__ = [
     "get_journal",
     "get_recorder",
     "get_registry",
+    "goodput_report",
     "histogram_quantile",
     "install_shutdown_hooks",
     "journal_event",
     "merge_histograms",
     "note_action",
     "now",
+    "parse_goodput_counters",
     "parse_prometheus_text",
+    "read_device_memory",
+    "read_host_rss",
     "read_journal",
     "register_build_info",
+    "report_from_counters",
     "remove_shutdown_flush",
     "render_prometheus",
     "set_cluster_monitor",

@@ -72,6 +72,9 @@ REPORT_FIELDS = {
     # "int4+ef" or "adaptive(topk)+ef" (docs/WIRE_PROTOCOL.md); length-
     # capped on ingest so a hostile peer can't balloon the view.
     "push_codec": str,
+    # Productive fraction of this worker's wall so far (telemetry/
+    # goodput.py) — the `cli status`/`cli top` goodput column.
+    "goodput_fraction": float,
 }
 
 
@@ -199,6 +202,18 @@ class ClusterMonitor:
         #: set, the background tick drives its control loop and
         #: cluster_view() carries its state under "worker_autoscale".
         self.worker_autoscaler = None
+        #: Optional MemoryMonitor (telemetry/memory.py); when set, every
+        #: evaluation pass folds its self-paced sample verdict into the
+        #: ClusterState (-> memory_growth alerts) and cluster_view()
+        #: carries it under "memory" (cli serve wires it unless
+        #: --no-memory-telemetry).
+        self.memory = None
+        #: Optional ProfileTrigger (telemetry/proftrigger.py); when set,
+        #: every evaluation feeds it the fleet-merged goodput fraction so
+        #: a goodput-drop edge freezes a device-profile window (cli serve
+        #: --profile-triggers). Its slo_burn edge source attaches via
+        #: add_listener separately.
+        self.profile_trigger = None
 
         reg = registry or get_registry()
         # Alert counters pre-created for every rule so a scrape shows the
@@ -321,6 +336,12 @@ class ClusterMonitor:
                 slo_breaches = self.slo.evaluate(now)
             except Exception:  # noqa: BLE001 — SLO math must not stop health
                 slo_breaches = []
+        memory = None
+        if self.memory is not None:
+            try:
+                memory = self.memory.observe(now)
+            except Exception:  # noqa: BLE001 — sampling must not stop health
+                memory = None
         return ClusterState(
             ts=now,
             global_step=int(getattr(self.store, "global_step", 0)),
@@ -330,7 +351,8 @@ class ClusterMonitor:
             pushes_accepted_delta=max(0, acc - acc0),
             pushes_rejected_delta=max(0, rej - rej0),
             corrupt_frames_delta=max(0, corrupt_total - c0),
-            slo_breaches=slo_breaches)
+            slo_breaches=slo_breaches,
+            memory=memory)
 
     def evaluate(self) -> list[dict]:
         """One evaluation pass; returns the new edge events. Serialized
@@ -366,6 +388,21 @@ class ClusterMonitor:
                     try:
                         fn(events)
                     except Exception:  # noqa: BLE001
+                        pass
+            if self.profile_trigger is not None:
+                fracs = [w.report.get("goodput_fraction")
+                         for w in state.workers.values() if w.report]
+                fracs = [f for f in fracs
+                         if isinstance(f, (int, float))
+                         and not isinstance(f, bool)]
+                if fracs:
+                    try:
+                        # Fleet-merged productive fraction (mean of the
+                        # reporting workers): a fall through the trigger's
+                        # threshold captures a profile window.
+                        self.profile_trigger.observe_goodput(
+                            sum(fracs) / len(fracs), now=now)
+                    except Exception:  # noqa: BLE001 — capture is best-effort
                         pass
             self._state_cache = state
             return events
@@ -450,6 +487,13 @@ class ClusterMonitor:
             "alerts": alerts,
             "alerts_total": totals,
         }
+        gfs = [r.get("goodput_fraction") for r in rows]
+        gfs = [f for f in gfs if isinstance(f, (int, float))
+               and not isinstance(f, bool)]
+        if gfs:
+            # Fleet-merged productive fraction (mean over reporting
+            # workers) — the `cli status` header goodput figure.
+            out["goodput_fraction"] = round(sum(gfs) / len(gfs), 4)
         # Self-healing surfaces (docs/ROBUSTNESS.md): live quorum-round
         # state from the store and the remediation engine's active/recent
         # actions. Both best-effort — the health view must render even if
@@ -478,6 +522,11 @@ class ClusterMonitor:
         if self.slo is not None:
             try:
                 out["slo"] = self.slo.view()
+            except Exception:  # noqa: BLE001
+                pass
+        if self.memory is not None:
+            try:
+                out["memory"] = self.memory.observe(now)
             except Exception:  # noqa: BLE001
                 pass
         if self.jobs is not None:

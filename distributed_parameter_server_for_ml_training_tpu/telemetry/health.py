@@ -75,6 +75,10 @@ RULE_CATALOG = {
     "wire_corrupt": (
         "warning", "a push payload failed the wire CRC check this window "
                    "and was refused (dps_wire_corrupt_total)"),
+    "memory_growth": (
+        "warning", "host RSS grew faster than memory_growth_bytes_per_s "
+                   "over the sampling window (telemetry/memory.py leak "
+                   "slope; an OOM in the making)"),
     "loss_plateau": (
         "info", "best loss improved less than plateau_min_improvement over "
                 "plateau_window_s of reports"),
@@ -119,6 +123,14 @@ class HealthThresholds:
     #: cluster is otherwise alive is declared dead (membership expiry
     #: reported by the store fires the same rule immediately).
     dead_after_s: float = 30.0
+    #: Sustained host-RSS growth slope above this fires memory_growth
+    #: (8 MiB/s leaks a v4 host's 400-ish GB in under a day — early
+    #: enough to act, far above healthy allocator jitter).
+    memory_growth_bytes_per_s: float = 8388608.0
+    #: The slope is meaningless over a blip: the sampling window must
+    #: span at least this long and hold this many samples first.
+    memory_growth_min_window_s: float = 20.0
+    memory_growth_min_samples: int = 5
     #: Re-emit cooldown per (rule, worker): an alert that KEEPS firing
     #: produces at most one event per interval (dedupe/rate-limit).
     realert_interval_s: float = 60.0
@@ -158,6 +170,9 @@ class ClusterState:
     #: SLO burn-rate breaches from the attached SloEvaluator this pass
     #: (telemetry/slo.py ``evaluate()`` dicts); empty when no evaluator.
     slo_breaches: list = field(default_factory=list)
+    #: Memory verdict from the attached MemoryMonitor
+    #: (telemetry/memory.py ``observe()`` dict); None when no monitor.
+    memory: dict | None = None
 
 
 @dataclass
@@ -485,6 +500,29 @@ class HealthRuleEngine:
                  f"{state.corrupt_frames_delta} corrupt push frame(s) "
                  f"refused this window (wire CRC mismatch)",
                  value=float(state.corrupt_frames_delta), threshold=0.0)
+
+        # 6c) host memory leak slope (telemetry/memory.py, attached by
+        # the monitor). Server-scope like the SLO rules: the verdict is
+        # THIS process's RSS, so worker identity is None. Gated on a
+        # minimum window span + sample count — two samples a second
+        # apart during an allocation burst are not a leak.
+        mem = state.memory if isinstance(state.memory, dict) else None
+        if mem:
+            slope = mem.get("growth_bytes_per_s")
+            span = mem.get("window_span_s")
+            n = mem.get("samples")
+            if _finite(slope) and _finite(span) \
+                    and isinstance(n, int) \
+                    and span >= t.memory_growth_min_window_s \
+                    and n >= t.memory_growth_min_samples \
+                    and slope > t.memory_growth_bytes_per_s:
+                fire("memory_growth", None,
+                     f"host RSS growing {slope / 1048576.0:.1f} MiB/s "
+                     f"over a {span:.0f}s window "
+                     f"(rss {(mem.get('rss_bytes') or 0) / 1048576.0:.0f}"
+                     f" MiB)",
+                     value=round(float(slope), 1),
+                     threshold=t.memory_growth_bytes_per_s)
 
         # 7) SLO burn-rate breaches (telemetry/slo.py, attached by the
         # monitor). One aggregated alert per rule — alert identity is

@@ -103,6 +103,8 @@ EVENT_CATALOG = {
              "(spec string, PR 13 grammar)",
     "incident": "incident capture engine froze a forensic bundle "
                 "(id, rule, path)",
+    "profile": "profile trigger engine captured and attributed a "
+               "device-profile window (id, rule, path)",
 }
 
 _SNAPSHOT_TYPES = ("snapshot", "fleet_tick")

@@ -1,0 +1,197 @@
+"""Memory telemetry: device HBM stats + host RSS, with a leak-slope rule.
+
+Until now memory was entirely unobserved: a worker whose host arrays
+leak (a codec residual pile-up, an unbounded history deque) or whose
+device allocator creeps toward its HBM limit dies by OOM with no
+recorded warning. This module is the sampling half of the
+``memory_growth`` health rule (:mod:`.health`):
+
+- **Device HBM** via ``device.memory_stats()`` — present on TPU/GPU
+  backends, ``None`` on CPU — exported as
+  ``dps_device_memory_bytes{kind=...}`` gauges for the
+  :data:`DEVICE_MEMORY_KINDS` it reports.
+- **Host RSS** from ``/proc/self/status`` (``VmRSS``/``VmHWM``, stdlib
+  only, graceful ``None`` off Linux) exported as
+  ``dps_host_rss_bytes``.
+- **Leak slope**: a least-squares line through the RSS samples in a
+  sliding window; the slope (bytes/s) rides the monitor's
+  ``ClusterState.memory`` verdict into the rule engine, which fires
+  ``memory_growth`` when sustained growth crosses the threshold.
+
+Attached to :class:`~.cluster.ClusterMonitor` like the SLO evaluator
+(``monitor.memory = MemoryMonitor(...)``); ``observe()`` self-paces on
+``interval_s`` so the monitor can call it every evaluation tick.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import deque
+
+from .registry import MetricsRegistry, get_registry
+
+__all__ = [
+    "DEVICE_MEMORY_KINDS",
+    "DEVICE_MEMORY_METRIC",
+    "HOST_RSS_METRIC",
+    "MemoryMonitor",
+    "read_device_memory",
+    "read_host_rss",
+]
+
+HOST_RSS_METRIC = "dps_host_rss_bytes"
+DEVICE_MEMORY_METRIC = "dps_device_memory_bytes"
+
+#: ``memory_stats()`` keys exported as gauge labels (the stable core of
+#: the jax allocator stats; backends may report more — ignored, so a
+#: new runtime can't mint unbounded label sets).
+DEVICE_MEMORY_KINDS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit")
+
+
+def read_host_rss() -> dict | None:
+    """``{"rss_bytes", "peak_rss_bytes"}`` from ``/proc/self/status``
+    (``VmRSS`` / ``VmHWM``, kB lines), or None off Linux / on any read
+    failure. Stdlib only — no psutil dependency."""
+    try:
+        with open("/proc/self/status", encoding="ascii",
+                  errors="replace") as f:
+            text = f.read()
+    except OSError:
+        return None
+    out = {}
+    for line in text.splitlines():
+        for field, key in (("VmRSS:", "rss_bytes"),
+                           ("VmHWM:", "peak_rss_bytes")):
+            if line.startswith(field):
+                parts = line.split()
+                try:
+                    out[key] = int(parts[1]) * 1024
+                except (IndexError, ValueError):
+                    pass
+    return out if "rss_bytes" in out else None
+
+
+def read_device_memory(device=None) -> dict | None:
+    """One device's ``memory_stats()`` restricted to
+    :data:`DEVICE_MEMORY_KINDS` plus the device kind, or None when the
+    backend has no allocator stats (CPU) or jax is unavailable."""
+    try:
+        import jax
+        if device is None:
+            devices = jax.local_devices()
+            if not devices:
+                return None
+            device = devices[0]
+        stats = device.memory_stats()
+    except Exception:  # noqa: BLE001 — backend-optional surface
+        return None
+    if not isinstance(stats, dict):
+        return None
+    out = {k: int(stats[k]) for k in DEVICE_MEMORY_KINDS
+           if isinstance(stats.get(k), int)}
+    if not out:
+        return None
+    out["device_kind"] = str(getattr(device, "device_kind", "unknown"))
+    return out
+
+
+def _slope_bytes_per_s(samples) -> float | None:
+    """Least-squares slope through ``[(ts, bytes), ...]``; None below
+    two distinct timestamps."""
+    n = len(samples)
+    if n < 2:
+        return None
+    t0 = samples[0][0]
+    xs = [t - t0 for t, _ in samples]
+    ys = [float(v) for _, v in samples]
+    mean_x = sum(xs) / n
+    mean_y = sum(ys) / n
+    denom = sum((x - mean_x) ** 2 for x in xs)
+    if denom <= 0:
+        return None
+    return sum((x - mean_x) * (y - mean_y)
+               for x, y in zip(xs, ys)) / denom
+
+
+class MemoryMonitor:
+    """Periodic sampler + windowed leak-slope detector.
+
+    ``rss_fn`` / ``device_fn`` are injectable for tests (fake clocks and
+    seeded leaks); real callers take the defaults. Not thread-safe by
+    itself — the cluster monitor calls ``observe`` under its own lock,
+    the same discipline as the rule engine.
+    """
+
+    def __init__(self, registry: MetricsRegistry | None = None,
+                 interval_s: float = 5.0, window_s: float = 120.0,
+                 clock=time.time, rss_fn=read_host_rss,
+                 device_fn=read_device_memory):
+        if interval_s <= 0 or window_s <= 0:
+            raise ValueError("interval_s and window_s must be > 0")
+        reg = registry or get_registry()
+        self.interval_s = float(interval_s)
+        self.window_s = float(window_s)
+        self.clock = clock
+        self._rss_fn = rss_fn
+        self._device_fn = device_fn
+        # Literal names at the registration sites (== HOST_RSS_METRIC /
+        # DEVICE_MEMORY_METRIC): the metric<->doc drift pin extracts
+        # registrations textually.
+        self._tm_rss = reg.gauge("dps_host_rss_bytes")
+        self._tm_device = {
+            k: reg.gauge("dps_device_memory_bytes", kind=k)
+            for k in DEVICE_MEMORY_KINDS
+        }
+        self._samples: deque = deque()  # (ts, rss_bytes)
+        self._last_sample_ts: float | None = None
+        self._last: dict = {}
+
+    def sample(self, now: float | None = None) -> dict:
+        """Take one sample unconditionally; returns the verdict."""
+        now = self.clock() if now is None else now
+        self._last_sample_ts = now
+        host = None
+        try:
+            host = self._rss_fn()
+        except Exception:  # noqa: BLE001 — sampling must never raise out
+            host = None
+        device = None
+        try:
+            device = self._device_fn()
+        except Exception:  # noqa: BLE001 — sampling must never raise out
+            device = None
+        if host:
+            self._tm_rss.set(host["rss_bytes"])
+            self._samples.append((now, host["rss_bytes"]))
+            cutoff = now - self.window_s
+            while self._samples and self._samples[0][0] < cutoff:
+                self._samples.popleft()
+        if device:
+            for k in DEVICE_MEMORY_KINDS:
+                if k in device:
+                    self._tm_device[k].set(device[k])
+        self._last = self._verdict(host, device)
+        return self._last
+
+    def observe(self, now: float | None = None) -> dict:
+        """Self-paced sample: re-samples only once per ``interval_s``,
+        otherwise returns the last verdict (the monitor calls this every
+        evaluation tick)."""
+        now = self.clock() if now is None else now
+        if self._last_sample_ts is None \
+                or now - self._last_sample_ts >= self.interval_s:
+            return self.sample(now)
+        return self._last
+
+    def _verdict(self, host, device) -> dict:
+        span = 0.0
+        if len(self._samples) >= 2:
+            span = self._samples[-1][0] - self._samples[0][0]
+        return {
+            "rss_bytes": (host or {}).get("rss_bytes"),
+            "peak_rss_bytes": (host or {}).get("peak_rss_bytes"),
+            "growth_bytes_per_s": _slope_bytes_per_s(self._samples),
+            "window_span_s": round(span, 3),
+            "samples": len(self._samples),
+            "device": device,
+        }

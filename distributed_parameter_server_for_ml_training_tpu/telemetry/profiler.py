@@ -32,6 +32,7 @@ __all__ = [
     "find_profile_dumps",
     "mfu",
     "peak_flops",
+    "prune_capture",
 ]
 
 #: device_kind -> peak dense-matmul FLOP/s at the precision the training
@@ -72,6 +73,43 @@ def find_profile_dumps(logdir: str) -> list[str]:
     # Newest capture first: the run timestamp is the parent dir name.
     return sorted(set(found), key=lambda p: (os.path.dirname(p), p),
                   reverse=True)
+
+
+def prune_capture(logdir: str) -> list[str]:
+    """Delete the raw profiler dump under a capture dir once attribution
+    has JOINED it into an artifact; returns the paths removed.
+
+    The capture dirs are big (one xplane.pb + one multi-MB Chrome trace
+    per host per capture) and, before this, only the codec-profile
+    experiment cleaned up after itself — every other capture path
+    (``cli perf profile``, bench, the trigger engine) left them on disk
+    forever. Callers prune ONLY after a successful attribution: a
+    failed parse keeps the raw dump as the evidence. Removes the whole
+    ``plugins/`` capture tree plus any direct ``*.trace.json[.gz]``
+    files; never raises (a half-pruned dir degrades to stray files, not
+    a failed capture)."""
+    import shutil
+
+    removed: list[str] = []
+    if os.path.isfile(logdir):
+        try:
+            os.remove(logdir)
+            return [logdir]
+        except OSError:
+            return []
+    plugins = os.path.join(logdir, "plugins")
+    if os.path.isdir(plugins):
+        shutil.rmtree(plugins, ignore_errors=True)
+        if not os.path.exists(plugins):
+            removed.append(plugins)
+    for pat in ("*.trace.json.gz", "*.trace.json", "*.xplane.pb"):
+        for path in glob.glob(os.path.join(logdir, pat)):
+            try:
+                os.remove(path)
+                removed.append(path)
+            except OSError:
+                pass
+    return removed
 
 
 def _as_cost_dict(cost) -> dict:

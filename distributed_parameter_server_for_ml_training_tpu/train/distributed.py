@@ -146,7 +146,8 @@ class SyncTrainer:
         # Live telemetry (telemetry/): the sync trainer IS the whole
         # server+workers deployment here, so one set of mode-labeled
         # instruments gives the snapshot stream its throughput series.
-        from ..telemetry import get_registry, now as _tnow, trace_span
+        from ..telemetry import (GoodputAccount, get_registry,
+                                 now as _tnow, trace_span)
         reg = get_registry()
         tm_step_s = reg.histogram("dps_trainer_step_seconds", mode="sync")
         tm_steps = reg.counter("dps_trainer_steps_total", mode="sync")
@@ -154,6 +155,13 @@ class SyncTrainer:
         tm_epoch = reg.gauge("dps_trainer_epoch", mode="sync")
         tm_acc = reg.gauge("dps_trainer_test_accuracy", mode="sync")
         tm_gstep = reg.gauge("dps_store_global_step", backend="spmd")
+
+        # Goodput ledger (telemetry/goodput.py): the sync trainer's wall
+        # classifies into compute / checkpoint / other — no comms phases
+        # exist outside the compiled program, so a large residual here
+        # means host-side input/bookkeeping drag.
+        gp = GoodputAccount(reg)
+        gp.start_wall()
 
         t_start = time.time()
         per_worker_epochs = []   # per epoch: {"loss": [N], "accuracy": [N]}
@@ -171,7 +179,8 @@ class SyncTrainer:
                 # trace's value is the step-time series itself — same
                 # dispatch-to-return caveat as the histogram below.
                 with trace_span("trainer.step", root=True, mode="sync",
-                                step=self.global_steps, epoch=epoch):
+                                step=self.global_steps, epoch=epoch), \
+                        gp.span("compute"):
                     self.state, m = self._step(self.state, bi, bl, rng)
                 losses.append(m["loss"])
                 # Span = dispatch-to-return; appending m["loss"] keeps a
@@ -187,6 +196,7 @@ class SyncTrainer:
                     wa.append(m["worker_accuracy"])
                 self.global_steps += 1
                 tm_gstep.set(self.global_steps)
+                gp.tick_wall()
             if wl:
                 per_worker_epochs.append({
                     "loss": np.mean(np.asarray(wl, np.float32), axis=0),
@@ -198,7 +208,8 @@ class SyncTrainer:
             if self.multihost and jax.process_index() != 0:
                 acc = float("nan")
             else:
-                acc = self.evaluate()
+                with gp.span("compute"):
+                    acc = self.evaluate()
             self.epoch_times.append(time.time() - t0)
             self.test_accuracies.append(acc)
             tm_epoch.set(epoch + 1)
@@ -210,7 +221,9 @@ class SyncTrainer:
                       f"test {acc:.2%} ({self.epoch_times[-1]:.1f}s)")
             if mgr is not None and jax.process_index() == 0:
                 # State is replicated; process 0's copy is the full model.
-                mgr.save(self.state)
+                with gp.span("checkpoint"):
+                    mgr.save(self.state)
+            gp.tick_wall()
         total = time.time() - t_start
         if mgr is not None:
             mgr.close()

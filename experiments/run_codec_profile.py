@@ -149,10 +149,16 @@ def main() -> int:
         with open(merged_out) as f:
             merged = json.load(f)
     # The raw jax.profiler capture is tens of MB for a full epoch; the
-    # merged artifact above is the committed evidence. Prune the capture
-    # once it has been joined (the span dumps stay — they're small).
-    if merged:
-        shutil.rmtree(dev["profile_dir"], ignore_errors=True)
+    # merged artifact above is the committed evidence. Prune via the
+    # uniform policy (telemetry/profiler.prune_capture, ISSUE 20
+    # satellite f): only after a SUCCESSFUL attribution — a basis=none
+    # or parse-error join keeps the raw traces debuggable. The span
+    # dumps stay — they're small.
+    if merged and (merged.get("profile") or {}).get("basis") \
+            not in (None, "none") and not merged.get("parse_errors"):
+        from distributed_parameter_server_for_ml_training_tpu \
+            .telemetry.profiler import prune_capture
+        prune_capture(dev["profile_dir"])
         dev["profile_dir"] = "pruned after join (see merged_profile)"
 
     checks = []

@@ -150,8 +150,12 @@ def cell_a() -> tuple[dict, dict]:
     cost = compiled_cost(step.lower(params, x).compile())
 
     out_json = os.path.join(OUT_DIR, "a_perf_profile.json")
+    # --keep-traces: the cost join below re-reads the same capture; we
+    # prune ourselves after the LAST consumer (uniform policy,
+    # telemetry/profiler.prune_capture).
     p = _run_cli(["perf", "profile", "--profile-dir", prof_dir,
-                  "--trace-dump-dir", dump_dir, "--out", out_json])
+                  "--trace-dump-dir", dump_dir, "--out", out_json,
+                  "--keep-traces"])
     with open(os.path.join(OUT_DIR, "a_table.txt"), "w") as f:
         f.write(p.stdout)
     report = {}
@@ -180,6 +184,14 @@ def cell_a() -> tuple[dict, dict]:
     with open(os.path.join(OUT_DIR, "a_perf_profile_with_cost.json"),
               "w") as f:
         json.dump(costed, f, indent=2)
+    # Both artifacts written — prune the raw capture if the attribution
+    # actually succeeded (keep it on failure so the traces stay
+    # debuggable; ISSUE 20 satellite f).
+    if (costed.get("profile") or {}).get("basis") not in (None, "none") \
+            and not costed.get("parse_errors"):
+        from distributed_parameter_server_for_ml_training_tpu \
+            .telemetry.profiler import prune_capture
+        prune_capture(prof_dir)
 
     prof = report.get("profile") or {}
     rec_block = report.get("reconciliation") or {}

@@ -92,7 +92,7 @@ fi
 
 # --- 3. slow-marker audit -------------------------------------------------
 for f in tests/*.py; do
-  if grep -qE 'run_loadgen|run_chaos_soak|run_shard_scale|chaos_soak|elastic_serve|reshard_chaos|tenancy_demo|fleet_demo|fanout_drill|incident_demo' "$f"; then
+  if grep -qE 'run_loadgen|run_chaos_soak|run_shard_scale|chaos_soak|elastic_serve|reshard_chaos|tenancy_demo|fleet_demo|fanout_drill|incident_demo|goodput_demo' "$f"; then
     if ! grep -qE 'pytest\.mark\.slow|pytestmark *= *\[?pytest\.mark\.slow' "$f"; then
       echo "MARKER AUDIT FAIL: $f imports the load generator, chaos" \
            "soaks, or a recorded demo but carries no 'slow'" \

@@ -38,7 +38,9 @@ __all__ = [
     "EXTRA_METRIC_FIELDS",
     "check_regressions",
     "load_ledger",
+    "load_profile_ledger",
     "render_markdown",
+    "validate_profile_record",
     "validate_record",
 ]
 
@@ -64,12 +66,16 @@ _MULTICHIP_FIELDS = {"n_devices": int, "rc": int, "ok": bool,
 #: ``journal_write_us``/``journal_bytes_per_tick`` (ISSUE 18) are the
 #: durable journal's per-record append latency and per-snapshot disk
 #: cost — both lower-is-better, gating the <2% overhead claim.
+#: ``goodput_fraction`` (ISSUE 20) is the productive fraction of the
+#: bench's timed-trial wall (harness overhead shows up as the gap below
+#: 1.0) — higher-is-better like the headline metric.
 EXTRA_METRIC_FIELDS = {"codec_mb_per_s": "MB/s",
                        "fanout_qps": "fetch/s",
                        "journal_write_us": {"unit": "us",
                                             "direction": "lower"},
                        "journal_bytes_per_tick": {"unit": "B",
-                                                  "direction": "lower"}}
+                                                  "direction": "lower"},
+                       "goodput_fraction": "fraction"}
 
 
 def _field_spec(spec) -> tuple[str, str]:
@@ -139,6 +145,93 @@ def load_ledger(root: str) -> dict:
             "malformed": [e for e in entries if e["errors"]]}
 
 
+#: Required shape of one committed ``profiles/PROFILE_*.json`` ledger
+#: record (the ProfileTrigger writes these; field semantics are
+#: drift-pinned in telemetry/proftrigger.py PROFILE_RECORD_FIELDS —
+#: NOT imported here, benchwatch stays jax-free by construction).
+_PROFILE_FIELDS = {"id": str, "created_ts": (int, float), "rule": str,
+                   "profile": dict}
+
+
+def validate_profile_record(obj) -> list:
+    """Schema errors for one profile-ledger record ('' list = valid)."""
+    if not isinstance(obj, dict):
+        return [f"profile record is {type(obj).__name__}, wanted object"]
+    errs = _type_errors(obj, _PROFILE_FIELDS, "profile")
+    prof = obj.get("profile")
+    if isinstance(prof, dict):
+        ocs = prof.get("op_classes")
+        if not isinstance(ocs, dict):
+            errs.append("profile.profile: missing 'op_classes' object")
+        else:
+            for cls, row in ocs.items():
+                t = row.get("time_s") if isinstance(row, dict) else None
+                if not isinstance(t, (int, float)) \
+                        or isinstance(t, bool):
+                    errs.append(f"profile.profile.op_classes[{cls!r}]: "
+                                f"missing numeric 'time_s'")
+    return errs
+
+
+def load_profile_ledger(root: str) -> dict:
+    """All committed ``PROFILE_*.json`` records under ``root``, oldest
+    first (the id stamp sorts lexically), same entry shape as
+    :func:`load_ledger`."""
+    entries = []
+    for path in sorted(glob.glob(os.path.join(root, "PROFILE_*.json"))):
+        entry = {"file": os.path.basename(path), "kind": "profile",
+                 "record": None, "errors": []}
+        try:
+            with open(path) as f:
+                obj = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            entry["errors"] = [f"unreadable: {e}"]
+            entries.append(entry)
+            continue
+        entry["record"] = obj
+        entry["errors"] = validate_profile_record(obj)
+        entries.append(entry)
+    return {"root": root, "entries": entries,
+            "malformed": [e for e in entries if e["errors"]]}
+
+
+def _profile_points(profile_ledger: dict, skipped: list) -> dict:
+    """Per-op-class ``time_s`` series from the profile ledger, keyed
+    ``profile:<class>.time_s`` (lower-is-better — a class whose device
+    time grows across captures regressed). Bases must agree to compare:
+    records whose attribution basis differs from the NEWEST usable
+    record's are skipped and reported, never silently mixed — the same
+    honesty rule ``cli perf diff`` enforces with a refusal."""
+    usable = []
+    for entry in profile_ledger["entries"]:
+        if entry["errors"]:
+            continue
+        rec = entry["record"]
+        basis = (rec.get("profile") or {}).get("basis")
+        if basis in (None, "none"):
+            skipped.append({"file": entry["file"],
+                            "reason": "basis=none (attribution failed; "
+                                      "not comparable)"})
+            continue
+        usable.append((entry["file"], basis, rec))
+    if not usable:
+        return {}
+    ref_basis = usable[-1][1]
+    by_metric: dict[str, list] = {}
+    for fname, basis, rec in usable:
+        if basis != ref_basis:
+            skipped.append({"file": fname,
+                            "reason": f"basis={basis!r} != newest "
+                                      f"{ref_basis!r} (different "
+                                      f"measurements; not comparable)"})
+            continue
+        for cls, row in rec["profile"]["op_classes"].items():
+            by_metric.setdefault(f"profile:{cls}.time_s", []).append(
+                {"file": fname, "value": float(row["time_s"]),
+                 "unit": "s", "direction": "lower"})
+    return by_metric
+
+
 def _usable_bench(entry: dict) -> tuple[bool, str]:
     """(usable, reason-if-not) for one valid bench entry."""
     rec = entry["record"]
@@ -156,12 +249,18 @@ def _usable_bench(entry: dict) -> tuple[bool, str]:
 
 def check_regressions(ledger: dict, tolerance: float = 0.05,
                       baseline_window: int = 3,
-                      recent_window: int = 1) -> dict:
-    """The verdict over one loaded ledger (see module docstring)."""
+                      recent_window: int = 1,
+                      profile_ledger: dict | None = None) -> dict:
+    """The verdict over one loaded ledger (see module docstring). With
+    ``profile_ledger`` (:func:`load_profile_ledger`), the committed
+    per-op-class ``time_s`` series regression-check alongside the bench
+    metrics — lower-is-better, same median windows."""
     if tolerance < 0 or baseline_window < 1 or recent_window < 1:
         raise ValueError("tolerance must be >= 0 and windows >= 1")
     skipped = []
     by_metric: dict[str, list] = {}
+    if profile_ledger is not None:
+        by_metric.update(_profile_points(profile_ledger, skipped))
     for entry in ledger["entries"]:
         if entry["kind"] != "bench" or entry["errors"]:
             continue
@@ -216,6 +315,9 @@ def check_regressions(ledger: dict, tolerance: float = 0.05,
         metrics[metric] = row
     malformed = [{"file": e["file"], "errors": e["errors"]}
                  for e in ledger["malformed"]]
+    if profile_ledger is not None:
+        malformed += [{"file": e["file"], "errors": e["errors"]}
+                      for e in profile_ledger["malformed"]]
     status = "malformed" if malformed else (
         "regression" if regressions else "pass")
     return {

@@ -11,7 +11,10 @@ import argparse
 import json
 import sys
 
-from . import check_regressions, load_ledger, render_markdown
+import os
+
+from . import (check_regressions, load_ledger, load_profile_ledger,
+               render_markdown)
 
 
 def main(argv=None) -> int:
@@ -34,24 +37,40 @@ def main(argv=None) -> int:
     ap.add_argument("--validate-only", action="store_true",
                     help="schema-validate the ledger and stop (the "
                          "scripts/lint.sh gate)")
+    ap.add_argument("--profiles-root", default=None,
+                    help="committed profile ledger directory (default: "
+                         "<root>/profiles when it exists); PROFILE_*."
+                         "json records are schema-validated and their "
+                         "per-op-class time_s series regression-checked "
+                         "lower-is-better")
     args = ap.parse_args(argv)
 
     ledger = load_ledger(args.root)
+    profiles_root = args.profiles_root \
+        or os.path.join(args.root, "profiles")
+    profiles = load_profile_ledger(profiles_root) \
+        if os.path.isdir(profiles_root) else None
     if args.validate_only:
-        if ledger["malformed"]:
-            for e in ledger["malformed"]:
+        malformed = list(ledger["malformed"])
+        if profiles is not None:
+            malformed += profiles["malformed"]
+        if malformed:
+            for e in malformed:
                 for err in e["errors"]:
                     print(f"benchwatch: {e['file']}: {err}",
                           file=sys.stderr)
             return 1
         n = len(ledger["entries"])
-        print(f"benchwatch: ledger OK ({n} records)")
+        np_ = len(profiles["entries"]) if profiles is not None else 0
+        print(f"benchwatch: ledger OK ({n} records, "
+              f"{np_} profile records)")
         return 0
 
     verdict = check_regressions(
         ledger, tolerance=args.tolerance,
         baseline_window=args.baseline_window,
-        recent_window=args.recent_window)
+        recent_window=args.recent_window,
+        profile_ledger=profiles)
     if args.format == "json":
         print(json.dumps(verdict, indent=2))
     else:

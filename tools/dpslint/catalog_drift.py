@@ -363,6 +363,29 @@ def check_meta_keys(ctx: DriftContext) -> list[Finding]:
                  "docs/WIRE_PROTOCOL.md", "envelope-meta key", heading)
 
 
+def check_goodput_categories(ctx: DriftContext) -> list[Finding]:
+    """GOODPUT_CATEGORIES (telemetry/goodput.py) pinned to the
+    docs/OBSERVABILITY.md goodput-categories table — a wall-clock
+    category cannot be charged without documented semantics (the ledger
+    is read by humans attributing badput), or stay documented after
+    removal."""
+    return _table_check(ctx, "goodput-category",
+                        f"{_PKG}/telemetry/goodput.py",
+                        "GOODPUT_CATEGORIES", "docs/OBSERVABILITY.md",
+                        "### Goodput categories", "goodput category")
+
+
+def check_profile_record(ctx: DriftContext) -> list[Finding]:
+    """PROFILE_RECORD_FIELDS (telemetry/proftrigger.py) pinned to the
+    docs/OBSERVABILITY.md profile-ledger table — the committed
+    PROFILE_*.json records are longitudinal evidence; their schema
+    cannot drift undocumented."""
+    return _table_check(ctx, "profile-record",
+                        f"{_PKG}/telemetry/proftrigger.py",
+                        "PROFILE_RECORD_FIELDS", "docs/OBSERVABILITY.md",
+                        "### Profile ledger", "profile record field")
+
+
 CHECKS = {
     "metrics": check_metrics,
     "spans": check_spans,
@@ -381,6 +404,8 @@ CHECKS = {
     "fleet-rollup-fields": check_fleet_rollup_fields,
     "event-catalog": check_event_catalog,
     "incident-manifest": check_incident_manifest,
+    "goodput-categories": check_goodput_categories,
+    "profile-record": check_profile_record,
 }
 
 
